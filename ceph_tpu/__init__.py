@@ -1,0 +1,14 @@
+"""ceph_tpu: a TPU-native (JAX/XLA/Pallas) erasure-coding + CRUSH placement
+framework with the capabilities of Ceph's ErasureCodePlugin registry and
+CRUSH placement engine (reference: /root/reference, v15 octopus dev).
+
+Subpackages:
+  gf        GF(2^8) tables + RS matrix algebra (host, exact)
+  ops       jit'd device kernels + RSCodec
+  plugins   ErasureCodeInterface / plugin registry (jax_rs, xor, lrc, ...)
+  crush     bit-exact CRUSH: rjenkins hash, straw2, choose, OSDMap chain
+  backend   ECBackend-shaped batching pipeline + in-memory shard store
+  parallel  device-mesh sharding of codec batches
+  bench     ceph_erasure_code_benchmark-compatible CLI
+"""
+__version__ = "0.1.0"

@@ -1,0 +1,5 @@
+from .rs_kernels import gf_apply, gf_apply_bitslice, gf_apply_lookup, xor_reduce
+from .codec import RSCodec, TECHNIQUES
+
+__all__ = ["gf_apply", "gf_apply_bitslice", "gf_apply_lookup", "xor_reduce",
+           "RSCodec", "TECHNIQUES"]

@@ -1,0 +1,138 @@
+"""RSCodec: the device-resident Reed-Solomon codec.
+
+Combines host-side matrix algebra (construction + erasure-signature-cached
+inversion, mirroring the isa plugin's table cache,
+reference: src/erasure-code/isa/ErasureCodeIsaTableCache.h:35-65) with the
+jit'd device kernels from rs_kernels.  Shapes are static per (k, m, N);
+matrices are traced, so one compilation covers all erasure signatures.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..gf import matrix as gfm
+from ..gf import ref as gfref
+from . import rs_kernels
+
+TECHNIQUES = {
+    "reed_sol_van": gfm.rs_vandermonde_jerasure,
+    "vandermonde": gfm.rs_vandermonde_isa,
+    "cauchy": gfm.cauchy1,
+}
+
+# Matches the isa decode-table LRU capacity, "sufficient up to (12,4)"
+# (reference: src/erasure-code/isa/ErasureCodeIsaTableCache.h:46-48).
+DECODE_CACHE_SIZE = 2516
+
+
+class RSCodec:
+    """Systematic RS(k, m) over GF(2^8), poly 0x11D.
+
+    device='jax' runs the jit'd TPU kernels; device='numpy' is the exact CPU
+    fallback used for latency-bound single small stripes.
+    """
+
+    def __init__(self, k: int, m: int, technique: str = "reed_sol_van",
+                 device: str = "jax", variant: str = "auto"):
+        if k < 2 or m < 1 or k + m > 256:
+            raise ValueError(f"bad RS parameters k={k} m={m}")
+        if technique not in TECHNIQUES:
+            raise ValueError(f"unknown technique {technique!r}")
+        if technique == "vandermonde":
+            # ISA-L's geometric-progression matrix is only MDS inside this
+            # envelope (reference: src/erasure-code/isa/ErasureCodeIsa.cc:323-364).
+            if k > 32 or m > 4 or (m == 4 and k > 21):
+                raise ValueError(
+                    f"technique 'vandermonde' requires k<=32, m<=4 "
+                    f"(m=4 => k<=21); got k={k} m={m}")
+        self.k, self.m, self.technique = k, m, technique
+        self.device, self.variant = device, variant
+        self.parity_mat = TECHNIQUES[technique](k, m)          # [m, k] uint8
+        self._parity_dev = None
+        self._decode_cache: collections.OrderedDict = collections.OrderedDict()
+        self._lock = threading.Lock()
+
+    # -- encode ------------------------------------------------------------
+
+    def encode(self, data: np.ndarray) -> np.ndarray:
+        """data [k, N] (or [B, k, N]) uint8 -> parity [m, N] (or [B, m, N])."""
+        data = np.ascontiguousarray(data, dtype=np.uint8)
+        if data.ndim == 3:
+            b, k, n = data.shape
+            out = self.encode(np.swapaxes(data, 0, 1).reshape(k, b * n))
+            return np.swapaxes(out.reshape(self.m, b, n), 0, 1)
+        if self.device == "numpy":
+            return gfref.encode(self.parity_mat, data)
+        if self._parity_dev is None:
+            self._parity_dev = jnp.asarray(self.parity_mat)
+        out = rs_kernels.gf_apply(self._parity_dev, data, self.variant)
+        return np.asarray(jax.device_get(out))
+
+    def encode_device(self, data: jax.Array) -> jax.Array:
+        """Device-to-device encode (no host transfer), for pipeline use."""
+        if self._parity_dev is None:
+            self._parity_dev = jnp.asarray(self.parity_mat)
+        return rs_kernels.gf_apply(self._parity_dev, data, self.variant)
+
+    # -- decode ------------------------------------------------------------
+
+    def decode_matrix(self, erasures, available=None):
+        """Signature-LRU-cached (decode matrix, source chunk list)."""
+        sig = (tuple(sorted(int(e) for e in erasures)),
+               None if available is None else tuple(sorted(int(a) for a in available)))
+        with self._lock:
+            hit = self._decode_cache.get(sig)
+            if hit is not None:
+                self._decode_cache.move_to_end(sig)
+                return hit
+        D, src = gfm.decode_matrix(self.parity_mat, list(erasures), available)
+        with self._lock:
+            self._decode_cache[sig] = (D, src)
+            if len(self._decode_cache) > DECODE_CACHE_SIZE:
+                self._decode_cache.popitem(last=False)
+        return D, src
+
+    def decode(self, chunks: dict[int, np.ndarray],
+               erasures: list[int]) -> dict[int, np.ndarray]:
+        """Recover the erased chunk indices from surviving chunks.
+
+        chunks: {index: [N] uint8} (>= k survivors), erasures: lost indices.
+        """
+        erasures = sorted(int(e) for e in erasures)
+        if not erasures:
+            return {}
+        D, src = self.decode_matrix(erasures, available=list(chunks))
+        stack = np.stack([np.asarray(chunks[i], dtype=np.uint8) for i in src])
+        if self.device == "numpy":
+            rec = gfref.apply_matrix(D, stack)
+        else:
+            rec = np.asarray(jax.device_get(
+                rs_kernels.gf_apply(jnp.asarray(D), stack, self.variant)))
+        return {e: rec[i] for i, e in enumerate(erasures)}
+
+    def decode_batch(self, stack: np.ndarray, src: list[int],
+                     erasures: list[int]) -> np.ndarray:
+        """Batched decode with one shared erasure signature.
+
+        stack: [B, k, N] survivors in ``src`` order -> [B, len(erasures), N].
+        """
+        src = [int(s) for s in src]
+        D, src_expected = self.decode_matrix(erasures, available=src)
+        if src != src_expected:
+            # decode_matrix always works in sorted-src order; permute the
+            # caller's rows to match (and drop extras beyond the k used).
+            stack = stack[:, [src.index(s) for s in src_expected], :]
+        b, k, n = stack.shape
+        folded = np.ascontiguousarray(
+            np.swapaxes(stack, 0, 1).reshape(k, b * n), dtype=np.uint8)
+        if self.device == "numpy":
+            rec = gfref.apply_matrix(D, folded)
+        else:
+            rec = np.asarray(jax.device_get(
+                rs_kernels.gf_apply(jnp.asarray(D), folded, self.variant)))
+        return np.swapaxes(rec.reshape(len(erasures), b, n), 0, 1)

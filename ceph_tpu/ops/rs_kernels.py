@@ -1,0 +1,119 @@
+"""TPU device kernels for GF(2^8) matrix application (RS encode/decode).
+
+The one primitive both encode and decode need is
+
+    out[i, :] = XOR_j  mat[i, j] * data[j, :]     (GF(2^8))
+
+with ``mat`` tiny ([m, k] for encode, [n_lost, k] for decode) and ``data``
+huge ([k, N] bytes).  Two TPU-first realisations, both jit'd with the matrix
+as a *traced* argument so a single compilation per (r, k, N) shape serves
+every coefficient matrix and every erasure signature (the reference instead
+caches per-signature CPU decode tables, src/erasure-code/isa/ErasureCodeIsa.cc:227-304):
+
+- ``bitslice``: expand the GF(2^8) matrix to its GF(2) bit-matrix [8r, 8k]
+  (each coefficient becomes the 8x8 binary matrix of "multiply by c"), unpack
+  data bytes to bit-planes, and compute the GF(2) product as a bf16 matmul on
+  the MXU with f32 accumulation (exact: 0/1 values, <=2^8 terms), then mod-2
+  and repack.  This turns erasure coding into the MXU's native operation.
+- ``lookup``: gather-based VPU path: per-coefficient 256-entry product tables
+  (rows of the global 256x256 table) indexed by the data bytes, XOR-reduced
+  over j.  Fewer memory blowups, no MXU; wins for small r*k.
+
+Data layout convention everywhere: uint8 arrays [chunks, chunk_bytes]; a
+batch of stripes is folded into the byte axis (the matrix is the same for
+every stripe, so [k, B*N] == B stripes of [k, N]).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..gf.tables import MUL_TABLE
+
+def _mul_dev():
+    """The 256x256 GF(2^8) product table as a trace-time constant (64 KiB)."""
+    return jnp.asarray(MUL_TABLE)
+
+
+def _expand_bits_device(mat: jax.Array) -> jax.Array:
+    """Traced GF(2^8) matrix [r, k] -> GF(2) bit-matrix [8r, 8k] (uint8 0/1).
+
+    B[8i+bi, 8j+bj] = bit bi of (mat[i,j] * 2^bj).
+    """
+    r, k = mat.shape
+    powers = jnp.asarray([1 << j for j in range(8)], dtype=jnp.uint8)
+    # mv[i, j, bj] = mat[i,j] * 2^bj in GF(2^8)
+    mv = _mul_dev()[mat.astype(jnp.int32)[:, :, None],
+                    powers.astype(jnp.int32)[None, None, :]]
+    bi = jnp.arange(8, dtype=jnp.uint8)[None, :, None, None]
+    bits = (mv[:, None, :, :] >> bi) & 1          # [r, bi, k, bj]
+    return bits.reshape(8 * r, 8 * k)
+
+
+def _unpack_bits(data: jax.Array) -> jax.Array:
+    """uint8 [k, N] -> bit-planes [8k, N] (row 8j+bj = bit bj of chunk j)."""
+    k, n = data.shape
+    bj = jnp.arange(8, dtype=jnp.uint8)[None, :, None]
+    bits = (data[:, None, :] >> bj) & 1           # [k, 8, N]
+    return bits.reshape(8 * k, n)
+
+
+def _pack_bits(bits: jax.Array) -> jax.Array:
+    """int32 bit-planes [8r, N] -> uint8 [r, N]."""
+    rr, n = bits.shape
+    r = rr // 8
+    w = jnp.asarray([1 << i for i in range(8)], dtype=jnp.int32)[None, :, None]
+    return (bits.reshape(r, 8, n) * w).sum(axis=1).astype(jnp.uint8)
+
+
+@jax.jit
+def gf_apply_bitslice(mat: jax.Array, data: jax.Array) -> jax.Array:
+    """MXU path: out = mat @GF data via GF(2) bf16 matmul."""
+    B = _expand_bits_device(mat).astype(jnp.bfloat16)      # [8r, 8k]
+    x = _unpack_bits(data).astype(jnp.bfloat16)            # [8k, N]
+    acc = jax.lax.dot_general(
+        B, x, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)                # exact integer sums
+    bits = acc.astype(jnp.int32) & 1                       # mod 2
+    return _pack_bits(bits)
+
+
+@jax.jit
+def gf_apply_lookup(mat: jax.Array, data: jax.Array) -> jax.Array:
+    """VPU path: per-coefficient 256-entry product-table gathers, XOR-reduced."""
+    tables = _mul_dev()[mat.astype(jnp.int32)]             # [r, k, 256]
+
+    def one(tab_j, d_j):                                   # [r,256], [N] -> [r,N]
+        return jnp.take(tab_j, d_j.astype(jnp.int32), axis=1)
+
+    terms = jax.vmap(one, in_axes=(1, 0))(tables, data)    # [k, r, N]
+    return jax.lax.reduce(terms, np.uint8(0), jax.lax.bitwise_xor, [0])
+
+
+@jax.jit
+def xor_reduce(data: jax.Array) -> jax.Array:
+    """XOR of all chunk rows: [k, N] -> [1, N] (m=1 / parity-row-of-ones path,
+    cf. the isa plugin's region_xor short-circuit, ErasureCodeIsa.cc:119-131)."""
+    return jax.lax.reduce(data, np.uint8(0), jax.lax.bitwise_xor, [0])[None, :]
+
+
+def gf_apply(mat, data, variant: str = "auto"):
+    """Apply a GF(2^8) matrix to chunk data on the device.
+
+    mat: [r, k] uint8 (numpy or jax), data: [k, N] uint8 -> [r, N] uint8.
+    variant: 'bitslice' (MXU), 'lookup' (VPU), or 'auto'.
+    """
+    mat = jnp.asarray(mat, dtype=jnp.uint8)
+    data = jnp.asarray(data, dtype=jnp.uint8)
+    if variant == "auto":
+        # The MXU path amortises its unpack/pack overhead once the GF(2)
+        # matmul is big enough; tiny matrices with short rows stay on the VPU.
+        variant = "bitslice" if mat.shape[0] * mat.shape[1] >= 8 else "lookup"
+    if variant == "bitslice":
+        return gf_apply_bitslice(mat, data)
+    if variant == "lookup":
+        return gf_apply_lookup(mat, data)
+    raise ValueError(f"unknown variant {variant!r}")

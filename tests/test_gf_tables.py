@@ -1,0 +1,77 @@
+"""GF(2^8) field axioms and table consistency.
+
+Mirrors the role of the reference's GF unit coverage (the gf-complete
+submodule tests); the field itself (poly 0x11D) is pinned by the jerasure
+w=8 / isa-l choice (SURVEY.md §7 hard parts: bit-exactness)."""
+import numpy as np
+import pytest
+
+from ceph_tpu.gf import (EXP_TABLE, LOG_TABLE, MUL_TABLE, gf_mul, gf_div,
+                         gf_inv, gf_pow, mul_bitmatrix, expand_bitmatrix)
+
+
+def test_exp_log_roundtrip():
+    for a in range(1, 256):
+        assert EXP_TABLE[LOG_TABLE[a]] == a
+    # generator is 2: exp[1] == 2
+    assert EXP_TABLE[0] == 1
+    assert EXP_TABLE[1] == 2
+    assert EXP_TABLE[255] == EXP_TABLE[0]
+
+
+def test_known_products():
+    # hand-checked values in GF(2^8)/0x11D
+    assert gf_mul(2, 128) == 0x1D          # x * x^7 = x^8 = poly tail
+    assert gf_mul(0x80, 0x02) == 0x1D
+    assert gf_mul(3, 7) == 9               # (x+1)(x^2+x+1) = x^3+1... carryless
+    assert gf_mul(0, 77) == 0 and gf_mul(77, 0) == 0
+    assert gf_mul(1, 77) == 77
+
+
+def test_mul_table_matches_scalar():
+    rng = np.random.default_rng(0)
+    for _ in range(500):
+        a, b = int(rng.integers(256)), int(rng.integers(256))
+        assert MUL_TABLE[a, b] == gf_mul(a, b)
+
+
+def test_field_axioms_sampled():
+    rng = np.random.default_rng(1)
+    for _ in range(200):
+        a, b, c = (int(x) for x in rng.integers(0, 256, 3))
+        assert gf_mul(a, b) == gf_mul(b, a)
+        assert gf_mul(a, gf_mul(b, c)) == gf_mul(gf_mul(a, b), c)
+        assert gf_mul(a, b ^ c) == gf_mul(a, b) ^ gf_mul(a, c)
+
+
+def test_inverse():
+    for a in range(1, 256):
+        assert gf_mul(a, gf_inv(a)) == 1
+        assert gf_div(1, a) == gf_inv(a)
+    with pytest.raises(ZeroDivisionError):
+        gf_inv(0)
+
+
+def test_pow():
+    assert gf_pow(2, 0) == 1
+    assert gf_pow(2, 8) == 0x1D
+    for n in range(1, 20):
+        assert gf_pow(3, n) == gf_mul(gf_pow(3, n - 1), 3)
+
+
+def test_bitmatrix_is_multiplication():
+    rng = np.random.default_rng(2)
+    for _ in range(100):
+        c, d = int(rng.integers(256)), int(rng.integers(256))
+        M = mul_bitmatrix(c)
+        x = np.array([(d >> i) & 1 for i in range(8)], dtype=np.uint8)
+        y = (M @ x) % 2
+        got = sum(int(y[i]) << i for i in range(8))
+        assert got == gf_mul(c, d)
+
+
+def test_expand_bitmatrix_shape():
+    A = np.array([[1, 2], [3, 4]], dtype=np.uint8)
+    B = expand_bitmatrix(A)
+    assert B.shape == (16, 16)
+    assert set(np.unique(B)) <= {0, 1}

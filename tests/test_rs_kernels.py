@@ -1,0 +1,107 @@
+"""Device kernels vs the numpy oracle: bitslice (MXU) and lookup (VPU) paths,
+plus the RSCodec facade with its erasure-signature decode cache."""
+import itertools
+
+import numpy as np
+import pytest
+
+from ceph_tpu.gf import rs_vandermonde_isa, cauchy1
+from ceph_tpu.gf import ref
+from ceph_tpu.ops import gf_apply, xor_reduce, RSCodec
+
+
+@pytest.mark.parametrize("variant", ["bitslice", "lookup"])
+@pytest.mark.parametrize("shape", [(1, 2, 128), (4, 8, 1024), (3, 10, 333)])
+def test_gf_apply_matches_numpy(variant, shape):
+    r, k, n = shape
+    rng = np.random.default_rng(42)
+    mat = rng.integers(0, 256, size=(r, k), dtype=np.uint8)
+    data = rng.integers(0, 256, size=(k, n), dtype=np.uint8)
+    want = ref.apply_matrix(mat, data)
+    got = np.asarray(gf_apply(mat, data, variant=variant))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_xor_reduce():
+    rng = np.random.default_rng(1)
+    data = rng.integers(0, 256, size=(5, 64), dtype=np.uint8)
+    want = data[0] ^ data[1] ^ data[2] ^ data[3] ^ data[4]
+    np.testing.assert_array_equal(np.asarray(xor_reduce(data))[0], want)
+
+
+@pytest.mark.parametrize("technique", ["reed_sol_van", "vandermonde", "cauchy"])
+@pytest.mark.parametrize("device", ["numpy", "jax"])
+def test_codec_roundtrip(technique, device):
+    k, m, n = 4, 2, 256
+    codec = RSCodec(k, m, technique=technique, device=device)
+    rng = np.random.default_rng(5)
+    data = rng.integers(0, 256, size=(k, n), dtype=np.uint8)
+    par = codec.encode(data)
+    assert par.shape == (m, n)
+    full = {i: data[i] for i in range(k)} | {k + i: par[i] for i in range(m)}
+    for lost in itertools.combinations(range(k + m), m):
+        chunks = {i: v for i, v in full.items() if i not in lost}
+        rec = codec.decode(chunks, list(lost))
+        for e in lost:
+            np.testing.assert_array_equal(rec[e], full[e])
+
+
+def test_codec_batched_encode_matches_loop():
+    codec = RSCodec(8, 4, technique="cauchy", device="jax")
+    rng = np.random.default_rng(9)
+    batch = rng.integers(0, 256, size=(6, 8, 128), dtype=np.uint8)
+    got = codec.encode(batch)
+    assert got.shape == (6, 4, 128)
+    for b in range(6):
+        np.testing.assert_array_equal(got[b], codec.encode(batch[b]))
+
+
+def test_codec_decode_batch_shared_signature():
+    codec = RSCodec(4, 2, technique="cauchy", device="jax")
+    rng = np.random.default_rng(11)
+    batch = rng.integers(0, 256, size=(3, 4, 64), dtype=np.uint8)
+    par = codec.encode(batch)                      # [3, 2, 64]
+    erasures = [1, 4]
+    src = [0, 2, 3, 5]
+    full = np.concatenate([batch, par], axis=1)    # [3, 6, 64]
+    stack = full[:, src, :]
+    rec = codec.decode_batch(stack, src, erasures)
+    np.testing.assert_array_equal(rec[:, 0, :], full[:, 1, :])
+    np.testing.assert_array_equal(rec[:, 1, :], full[:, 4, :])
+
+
+def test_decode_cache_hits():
+    codec = RSCodec(4, 2)
+    D1, s1 = codec.decode_matrix([0, 1])
+    D2, s2 = codec.decode_matrix([0, 1])
+    assert D1 is D2 and s1 is s2
+
+
+def test_bad_params_rejected():
+    with pytest.raises(ValueError):
+        RSCodec(1, 1)
+    with pytest.raises(ValueError):
+        RSCodec(2, 0)
+    with pytest.raises(ValueError):
+        RSCodec(4, 2, technique="nope")
+
+
+def test_decode_batch_unsorted_src():
+    # regression: caller-supplied src order must not corrupt decode output
+    codec = RSCodec(4, 2, technique="cauchy", device="numpy")
+    rng = np.random.default_rng(13)
+    batch = rng.integers(0, 256, size=(2, 4, 32), dtype=np.uint8)
+    par = codec.encode(batch)
+    full = np.concatenate([batch, par], axis=1)
+    src = [2, 0, 3, 5]
+    rec = codec.decode_batch(full[:, src, :], src, [1, 4])
+    np.testing.assert_array_equal(rec[:, 0, :], full[:, 1, :])
+    np.testing.assert_array_equal(rec[:, 1, :], full[:, 4, :])
+
+
+def test_isa_vandermonde_envelope_enforced():
+    with pytest.raises(ValueError):
+        RSCodec(22, 4, technique="vandermonde")
+    with pytest.raises(ValueError):
+        RSCodec(33, 2, technique="vandermonde")
+    RSCodec(21, 4, technique="vandermonde")  # boundary is allowed
