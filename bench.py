@@ -1,0 +1,88 @@
+"""Driver benchmark: north-star metric as ONE JSON line.
+
+Metric (BASELINE.json): encode+decode MiB/s at k=8, m=4, 1 MiB stripes.
+Measured with device-resident buffers (the sidecar keeps persistent device
+buffers; host<->device transfer over the dev tunnel is not representative
+of a production PCIe/DMA path and is reported separately on stderr).
+
+vs_baseline: ratio against the in-process CPU reference codec (numpy,
+table-based — the stand-in for the reference's CPU plugins; the repository
+publishes no absolute ISA numbers, BASELINE.md).
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def measure(fn, iters: int = 10, warmup: int = 2) -> float:
+    for _ in range(warmup):
+        fn()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        fn()
+    return (time.perf_counter() - t0) / iters
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+    from ceph_tpu.ops import RSCodec
+
+    k, m = 8, 4
+    stripe_bytes = 1024 * 1024
+    n = stripe_bytes // k                      # 128 KiB chunks
+    batch = 64                                 # stripes per dispatch
+    rng = np.random.default_rng(0)
+    data = rng.integers(0, 256, size=(k, batch * n), dtype=np.uint8)
+
+    codec = RSCodec(k, m, technique="cauchy", device="jax")
+    dev = jax.device_put(jnp.asarray(data))
+
+    # encode: [k, B*N] -> [m, B*N]
+    enc_t = measure(lambda: codec.encode_device(dev).block_until_ready())
+    enc_mibs = batch * (stripe_bytes / 2**20) / enc_t
+
+    # decode: 2 erasures (1 data + 1 parity), device-resident
+    parity = codec.encode_device(dev)
+    full = jnp.concatenate([dev, parity], axis=0)
+    erasures = [0, 9]
+    D, src = codec.decode_matrix(erasures)
+    survivors = full[np.asarray(src)]
+    dmat = jnp.asarray(D)
+    from ceph_tpu.ops import rs_kernels
+    dec_t = measure(
+        lambda: rs_kernels.gf_apply(dmat, survivors).block_until_ready())
+    dec_mibs = batch * (stripe_bytes / 2**20) / dec_t
+
+    combined = 2.0 / (1.0 / enc_mibs + 1.0 / dec_mibs)
+
+    # CPU baseline: same work through the exact numpy codec, 1 stripe
+    cpu = RSCodec(k, m, technique="cauchy", device="numpy")
+    cdata = data[:, :n]
+    cpu_enc_t = measure(lambda: cpu.encode(cdata), iters=3, warmup=1)
+    cpu_enc = (stripe_bytes / 2**20) / cpu_enc_t
+    cfull = np.concatenate([cdata, cpu.encode(cdata)], axis=0)
+    csurv = cfull[src]
+    from ceph_tpu.gf import ref
+    cpu_dec_t = measure(lambda: ref.apply_matrix(D, csurv), iters=3, warmup=1)
+    cpu_dec = (stripe_bytes / 2**20) / cpu_dec_t
+    cpu_combined = 2.0 / (1.0 / cpu_enc + 1.0 / cpu_dec)
+
+    print(f"# encode {enc_mibs:.0f} MiB/s, decode {dec_mibs:.0f} MiB/s, "
+          f"cpu-ref encode {cpu_enc:.0f} decode {cpu_dec:.0f} MiB/s "
+          f"(device={jax.devices()[0].platform})", file=sys.stderr)
+    print(json.dumps({
+        "metric": "rs_k8m4_1MiB_encode_decode_device_resident",
+        "value": round(combined, 1),
+        "unit": "MiB/s",
+        "vs_baseline": round(combined / cpu_combined, 3),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

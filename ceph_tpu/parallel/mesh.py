@@ -1,0 +1,80 @@
+"""Device-mesh sharding of codec batches.
+
+The TPU-native equivalent of the reference's cluster fan-out: where Ceph's
+primary OSD fans ECSubWrites out to shard OSDs over the async messenger
+(reference: src/osd/ECBackend.cc:2036-2070), a multi-chip TPU deployment
+shards the stripe batch over a `jax.sharding.Mesh` and lets XLA insert ICI
+collectives (SURVEY.md §5 "distributed communication backend").
+
+Mesh axes:
+  dp   data parallel over stripes  — independent stripes on different chips
+  sp   "sequence" parallel over chunk bytes — one huge stripe split along
+       its byte axis (the long-context analog: stripes too big for one chip)
+
+The encode step runs the GF(2) bitslice matmul on each chip's local block,
+then reduces a placement checksum over sp (psum) and rotates parity shards
+around the dp ring (ppermute) the way the primary hands sub-writes to its
+peers.  All collectives ride ICI; nothing touches the host.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..ops import rs_kernels
+
+
+def make_mesh(n_devices: int | None = None, dp: int | None = None) -> Mesh:
+    """A (dp, sp) mesh over the first n_devices devices."""
+    devices = jax.devices()
+    n = n_devices or len(devices)
+    if dp is None:
+        dp = 1
+        for cand in range(int(np.sqrt(n)), 0, -1):
+            if n % cand == 0:
+                dp = cand
+                break
+    sp = n // dp
+    arr = np.array(devices[:n]).reshape(dp, sp)
+    return Mesh(arr, axis_names=("dp", "sp"))
+
+
+def sharded_encode_step(mesh: Mesh, parity_mat: np.ndarray):
+    """Build a jit'd multi-chip encode step.
+
+    Returns step(data) where data is [B, k, N] uint8, sharded
+    [B@dp, k, N@sp].  Output: (parity [B, m, N] with the same sharding,
+    checksum [B] int32 psum'd over sp, rotated parity from the dp ring).
+    """
+    mat = jnp.asarray(parity_mat, dtype=jnp.uint8)
+    m, k = parity_mat.shape
+
+    def local_step(data_blk):
+        # data_blk: [B/dp, k, N/sp] on this chip
+        b, kk, n = data_blk.shape
+        folded = data_blk.swapaxes(0, 1).reshape(kk, b * n)
+        parity = rs_kernels.gf_apply_bitslice(mat, folded)
+        parity = parity.reshape(m, b, n).swapaxes(0, 1)     # [B/dp, m, N/sp]
+        # placement checksum: reduce over the byte axis, then over sp —
+        # the integrity cross-check a deep-scrub would do per shard
+        # (reference: src/osd/ECBackend.cc:2461 be_deep_scrub crc recompute)
+        local_sum = parity.astype(jnp.int32).sum(axis=(1, 2))
+        checksum = jax.lax.psum(local_sum, axis_name="sp")
+        # sub-write fan-out analog: hand this chip's parity to the next
+        # dp-ring neighbour (primary -> shard OSD hop over ICI)
+        ndp = jax.lax.psum(1, axis_name="dp")
+        rotated = jax.lax.ppermute(
+            parity, axis_name="dp",
+            perm=[(i, (i + 1) % ndp) for i in range(ndp)])
+        return parity, checksum, rotated
+
+    from jax.experimental.shard_map import shard_map
+    step = shard_map(
+        local_step, mesh=mesh,
+        in_specs=(P("dp", None, "sp"),),
+        out_specs=(P("dp", None, "sp"), P("dp"), P("dp", None, "sp")))
+    return jax.jit(step)

@@ -1,0 +1,8 @@
+from .interface import ErasureCodeInterface, ErasureCodeProfile
+from .base import ErasureCode, SIMD_ALIGN, TPU_LANE_ALIGN
+from .registry import (ErasureCodePlugin, ErasureCodePluginRegistry,
+                       default_registry)
+
+__all__ = ["ErasureCodeInterface", "ErasureCodeProfile", "ErasureCode",
+           "SIMD_ALIGN", "TPU_LANE_ALIGN", "ErasureCodePlugin",
+           "ErasureCodePluginRegistry", "default_registry"]

@@ -1,0 +1,211 @@
+"""ErasureCode base class: shared default behaviour for all plugins.
+
+Python mirror of the reference base class (reference:
+src/erasure-code/ErasureCode.{h,cc}): profile parsing helpers, chunk
+remapping via ``mapping=DDD_D_`` strings, ``encode_prepare`` padding,
+first-k-available ``minimum_to_decode`` and ``decode_concat``.
+
+Alignment divergence (deliberate, TPU-first): the reference aligns chunks to
+SIMD_ALIGN=32 bytes for AVX (ErasureCode.cc:42); we align to 128 bytes — the
+TPU lane width — so chunk buffers tile cleanly onto the VPU/MXU minor
+dimension.  get_chunk_size(n)*k >= n still holds, which is the only contract
+the interface requires (ErasureCodeInterface.h:278).
+"""
+from __future__ import annotations
+
+from typing import Mapping
+
+import numpy as np
+
+from .interface import ErasureCodeInterface, ErasureCodeProfile
+
+SIMD_ALIGN = 32          # reference AVX alignment (ErasureCode.cc:42)
+TPU_LANE_ALIGN = 128     # TPU minor-dim tile width; our chunk alignment
+
+
+class ErasureCode(ErasureCodeInterface):
+    DEFAULT_RULE_ROOT = "default"
+    DEFAULT_RULE_FAILURE_DOMAIN = "host"
+
+    def __init__(self):
+        self._profile: ErasureCodeProfile = {}
+        self.chunk_mapping: list[int] = []
+        self.rule_root = self.DEFAULT_RULE_ROOT
+        self.rule_failure_domain = self.DEFAULT_RULE_FAILURE_DOMAIN
+        self.rule_device_class = ""
+
+    # -- profile helpers (ErasureCode.cc:295-343) --------------------------
+
+    @staticmethod
+    def to_int(name: str, profile: ErasureCodeProfile, default: str) -> int:
+        if not profile.get(name):
+            profile[name] = default
+        try:
+            return int(profile[name])
+        except ValueError as e:
+            raise ValueError(f"could not convert {name}={profile[name]} to int") from e
+
+    @staticmethod
+    def to_bool(name: str, profile: ErasureCodeProfile, default: str) -> bool:
+        if not profile.get(name):
+            profile[name] = default
+        return profile[name] in ("yes", "true")
+
+    @staticmethod
+    def to_string(name: str, profile: ErasureCodeProfile, default: str) -> str:
+        if not profile.get(name):
+            profile[name] = default
+        return profile[name]
+
+    @staticmethod
+    def sanity_check_k_m(k: int, m: int) -> None:
+        if k < 2:
+            raise ValueError(f"k={k} must be >= 2")
+        if m < 1:
+            raise ValueError(f"m={m} must be >= 1")
+
+    # -- init / rules ------------------------------------------------------
+
+    def init(self, profile: ErasureCodeProfile) -> None:
+        self.rule_root = self.to_string("crush-root", profile,
+                                        self.DEFAULT_RULE_ROOT)
+        self.rule_failure_domain = self.to_string("crush-failure-domain", profile,
+                                                  self.DEFAULT_RULE_FAILURE_DOMAIN)
+        self.rule_device_class = self.to_string("crush-device-class", profile, "")
+        self._profile = profile
+
+    def get_profile(self) -> ErasureCodeProfile:
+        return self._profile
+
+    def create_rule(self, name: str, crush) -> int:
+        """ErasureCode::create_rule semantics (ErasureCode.cc:64-83): an
+        'indep' rule rooted at crush-root over crush-failure-domain."""
+        return crush.add_simple_rule(
+            name, self.rule_root, self.rule_failure_domain,
+            self.rule_device_class, mode="indep",
+            num_rep=self.get_chunk_count())
+
+    # -- chunk mapping (ErasureCode.cc:274-293) ----------------------------
+
+    def parse_mapping(self, profile: ErasureCodeProfile) -> None:
+        mapping = profile.get("mapping")
+        if not mapping:
+            return
+        data_pos, coding_pos = [], []
+        for position, ch in enumerate(mapping):
+            (data_pos if ch == "D" else coding_pos).append(position)
+        self.chunk_mapping = data_pos + coding_pos
+
+    def chunk_index(self, i: int) -> int:
+        return self.chunk_mapping[i] if len(self.chunk_mapping) > i else i
+
+    def get_chunk_mapping(self) -> list[int]:
+        return self.chunk_mapping
+
+    # -- sizes -------------------------------------------------------------
+
+    def get_alignment(self) -> int:
+        return TPU_LANE_ALIGN
+
+    def get_chunk_size(self, object_size: int) -> int:
+        """Per-chunk-aligned sizing (cf. ErasureCodeJerasure.cc:80-104
+        per_chunk_alignment branch, with the TPU lane width as alignment)."""
+        k = self.get_data_chunk_count()
+        alignment = self.get_alignment()
+        chunk_size = (object_size + k - 1) // k
+        modulo = chunk_size % alignment
+        if modulo:
+            chunk_size += alignment - modulo
+        return max(chunk_size, alignment)
+
+    # -- minimum_to_decode (ErasureCode.cc:103-146) ------------------------
+
+    def _minimum_to_decode(self, want_to_read: set, available: set) -> set:
+        want_to_read = set(want_to_read)
+        available = set(available)
+        if want_to_read <= available:
+            return set(want_to_read)
+        k = self.get_data_chunk_count()
+        if len(available) < k:
+            raise IOError(
+                f"cannot decode: {len(available)} chunks available, need {k}")
+        return set(sorted(available)[:k])
+
+    def minimum_to_decode(self, want_to_read: set, available: set
+                          ) -> dict[int, list[tuple[int, int]]]:
+        minimum = self._minimum_to_decode(want_to_read, available)
+        sub = [(0, self.get_sub_chunk_count())]
+        return {i: list(sub) for i in sorted(minimum)}
+
+    def minimum_to_decode_with_cost(self, want_to_read: set,
+                                    available: Mapping[int, int]) -> set:
+        return self._minimum_to_decode(want_to_read, set(available))
+
+    # -- encode (ErasureCode.cc:151-204) -----------------------------------
+
+    def encode_prepare(self, raw: bytes) -> dict[int, np.ndarray]:
+        """Split+pad ``raw`` into k data chunks and allocate m parity chunks,
+        with the reference's padding layout (ErasureCode.cc:151-186): chunks
+        fully covered by the payload are slices; the straddling chunk is
+        zero-padded; fully-padded chunks are zeros."""
+        k = self.get_data_chunk_count()
+        m = self.get_coding_chunk_count()
+        raw = np.frombuffer(raw, dtype=np.uint8) if isinstance(raw, (bytes, bytearray)) \
+            else np.asarray(raw, dtype=np.uint8)
+        blocksize = self.get_chunk_size(len(raw))
+        padded_chunks = k - len(raw) // blocksize
+        encoded: dict[int, np.ndarray] = {}
+        for i in range(k - padded_chunks):
+            encoded[self.chunk_index(i)] = raw[i * blocksize:(i + 1) * blocksize].copy()
+        if padded_chunks:
+            remainder = len(raw) - (k - padded_chunks) * blocksize
+            buf = np.zeros(blocksize, dtype=np.uint8)
+            buf[:remainder] = raw[(k - padded_chunks) * blocksize:]
+            encoded[self.chunk_index(k - padded_chunks)] = buf
+            for i in range(k - padded_chunks + 1, k):
+                encoded[self.chunk_index(i)] = np.zeros(blocksize, dtype=np.uint8)
+        for i in range(k, k + m):
+            encoded[self.chunk_index(i)] = np.zeros(blocksize, dtype=np.uint8)
+        return encoded
+
+    def encode(self, want_to_encode: set, data: bytes) -> dict[int, np.ndarray]:
+        encoded = self.encode_prepare(data)
+        self.encode_chunks(set(range(self.get_chunk_count())), encoded)
+        return {i: encoded[i] for i in want_to_encode}
+
+    # -- decode (ErasureCode.cc:212-253) -----------------------------------
+
+    def _decode(self, want_to_read: set,
+                chunks: Mapping[int, np.ndarray]) -> dict[int, np.ndarray]:
+        chunks = {i: np.asarray(v, dtype=np.uint8) for i, v in chunks.items()}
+        if set(want_to_read) <= set(chunks):
+            return {i: chunks[i] for i in want_to_read}
+        k = self.get_data_chunk_count()
+        m = self.get_coding_chunk_count()
+        blocksize = len(next(iter(chunks.values())))
+        decoded: dict[int, np.ndarray] = {}
+        for i in range(k + m):
+            if i in chunks:
+                decoded[i] = chunks[i]
+            else:
+                decoded[i] = np.zeros(blocksize, dtype=np.uint8)
+        self.decode_chunks(set(want_to_read), chunks, decoded)
+        return {i: decoded[i] for i in want_to_read}
+
+    def decode(self, want_to_read: set, chunks: Mapping[int, np.ndarray],
+               chunk_size: int = 0) -> dict[int, np.ndarray]:
+        return self._decode(want_to_read, chunks)
+
+    def decode_concat(self, chunks: Mapping[int, np.ndarray]) -> bytes:
+        """Decode and concatenate the data chunks (ErasureCode.cc:345-361)."""
+        k = self.get_data_chunk_count()
+        want = {self.chunk_index(i) for i in range(k)}
+        decoded = self._decode(want, chunks)
+        return b"".join(decoded[self.chunk_index(i)].tobytes() for i in range(k))
+
+    # subclasses must provide encode_chunks/decode_chunks and the counts
+    def encode_chunks(self, want_to_encode, encoded):
+        raise NotImplementedError("encode_chunks not implemented")
+
+    def decode_chunks(self, want_to_read, chunks, decoded):
+        raise NotImplementedError("decode_chunks not implemented")

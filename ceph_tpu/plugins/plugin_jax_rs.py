@@ -1,0 +1,135 @@
+"""jax_rs: the flagship TPU Reed-Solomon plugin.
+
+The TPU-native sibling of the reference's jerasure/isa plugins
+(reference: src/erasure-code/jerasure/ErasureCodeJerasure.{h,cc},
+src/erasure-code/isa/ErasureCodeIsa.{h,cc}): systematic RS over GF(2^8)
+whose encode_chunks/decode_chunks run as jit'd XLA kernels (MXU bitslice or
+VPU lookup) via ceph_tpu.ops.RSCodec.
+
+Profile parameters:
+  k, m        chunk counts (defaults 7/3, jerasure's defaults,
+              ErasureCodeJerasure.h:81)
+  technique   reed_sol_van (systematic ext-Vandermonde; default) |
+              vandermonde (ISA gf_gen_rs_matrix) | cauchy (gf_gen_cauchy1)
+  w           Galois field width; only 8 is supported (the reference accepts
+              {8,16,32}, ErasureCodeJerasure.cc:191-197 — GF(2^8) is the only
+              field ISA-L supports and the one every corpus profile uses)
+  device      jax (TPU) | numpy (exact CPU fallback) | auto (numpy below
+              jax-threshold bytes per call, jax above — the latency-vs-
+              throughput split from SURVEY.md §7 "dispatch economics")
+  jax-threshold   byte cutoff for device=auto (default 65536)
+  variant     bitslice | lookup | auto (kernel choice)
+  mapping     DDD_D_-style chunk remapping (ErasureCode.cc:274-293)
+"""
+from __future__ import annotations
+
+from typing import Mapping
+
+import numpy as np
+
+from .. import __version__
+from ..ops.codec import RSCodec, TECHNIQUES
+from .base import ErasureCode
+from .interface import ErasureCodeProfile
+from .registry import ErasureCodePlugin, ErasureCodePluginRegistry
+
+
+class ErasureCodeJaxRS(ErasureCode):
+    DEFAULT_K = "7"
+    DEFAULT_M = "3"
+
+    def __init__(self, technique: str = "reed_sol_van"):
+        super().__init__()
+        self.technique = technique
+        self.k = 0
+        self.m = 0
+        self.w = 8
+        self.codec: RSCodec | None = None
+        self.device = "auto"
+        self.jax_threshold = 65536
+        self.variant = "auto"
+
+    # -- init --------------------------------------------------------------
+
+    def init(self, profile: ErasureCodeProfile) -> None:
+        super().init(profile)
+        self.parse_mapping(profile)
+        self.k = self.to_int("k", profile, self.DEFAULT_K)
+        self.m = self.to_int("m", profile, self.DEFAULT_M)
+        self.w = self.to_int("w", profile, "8")
+        if self.w != 8:
+            raise ValueError(f"w={self.w} must be 8 (GF(2^8))")
+        if self.chunk_mapping and len(self.chunk_mapping) != self.k + self.m:
+            raise ValueError(
+                f"mapping {profile.get('mapping')} maps "
+                f"{len(self.chunk_mapping)} chunks instead of {self.k + self.m}")
+        self.sanity_check_k_m(self.k, self.m)
+        technique = self.to_string("technique", profile, self.technique)
+        if technique not in TECHNIQUES:
+            raise ValueError(
+                f"technique={technique} must be one of {sorted(TECHNIQUES)}")
+        self.technique = technique
+        self.device = self.to_string("device", profile, "auto")
+        if self.device not in ("jax", "numpy", "auto"):
+            raise ValueError(f"device={self.device} must be jax|numpy|auto")
+        self.jax_threshold = self.to_int("jax-threshold", profile, "65536")
+        self.variant = self.to_string("variant", profile, "auto")
+        # one codec per backend; 'auto' keeps both and routes per call size
+        dev = "numpy" if self.device == "numpy" else "jax"
+        self.codec = RSCodec(self.k, self.m, technique=self.technique,
+                             device=dev, variant=self.variant)
+        self._cpu_codec = self.codec if dev == "numpy" else \
+            RSCodec(self.k, self.m, technique=self.technique, device="numpy")
+        profile["plugin"] = profile.get("plugin", "jax_rs")
+        self._profile = profile
+
+    def _route(self, nbytes: int) -> RSCodec:
+        if self.device == "auto" and nbytes < self.jax_threshold:
+            return self._cpu_codec
+        return self.codec
+
+    # -- counts ------------------------------------------------------------
+
+    def get_chunk_count(self) -> int:
+        return self.k + self.m
+
+    def get_data_chunk_count(self) -> int:
+        return self.k
+
+    # -- encode/decode -----------------------------------------------------
+
+    def encode_chunks(self, want_to_encode: set,
+                      encoded: dict[int, np.ndarray]) -> None:
+        k, m = self.k, self.m
+        data = np.stack([encoded[self.chunk_index(i)] for i in range(k)])
+        parity = self._route(data.nbytes).encode(data)
+        for i in range(m):
+            encoded[self.chunk_index(k + i)][:] = parity[i]
+
+    def decode_chunks(self, want_to_read: set, chunks: Mapping[int, np.ndarray],
+                      decoded: dict[int, np.ndarray]) -> None:
+        erasures = [i for i in range(self.k + self.m) if i not in chunks]
+        if not erasures:
+            return
+        avail = {i: decoded[i] for i in chunks}
+        nbytes = sum(v.nbytes for v in avail.values())
+        rec = self._route(nbytes).decode(avail, erasures)
+        for e, buf in rec.items():
+            decoded[e][:] = buf
+
+
+class ErasureCodePluginJaxRS(ErasureCodePlugin):
+    def factory(self, directory: str,
+                profile: ErasureCodeProfile) -> ErasureCodeJaxRS:
+        technique = profile.get("technique", "reed_sol_van")
+        instance = ErasureCodeJaxRS(technique)
+        instance.init(dict(profile))
+        return instance
+
+
+def __erasure_code_version__() -> str:
+    return __version__
+
+
+def __erasure_code_init__(name: str, directory: str) -> None:
+    ErasureCodePluginRegistry.instance().add(name, ErasureCodePluginJaxRS())

@@ -1,0 +1,60 @@
+"""jerasure: profile-compatible plugin mapped onto the TPU codec.
+
+Accepts the reference jerasure plugin's profile shape
+(reference: src/erasure-code/jerasure/ErasureCodeJerasure.h:81-252):
+techniques reed_sol_van (default, k=7 m=3), reed_sol_r6_op (m forced to 2,
+parity rows P=XOR / Q=sum 2^j d_j — exactly the geometric Vandermonde rows),
+cauchy_orig/cauchy_good (Cauchy matrices).  The bitmatrix-only techniques
+(liberation, blaum_roth, liber8tion) target word-level XOR scheduling that
+has no TPU analog and are rejected with a clear error.
+"""
+from __future__ import annotations
+
+from .. import __version__
+from .plugin_jax_rs import ErasureCodeJaxRS
+from .interface import ErasureCodeProfile
+from .registry import ErasureCodePlugin, ErasureCodePluginRegistry
+
+_TECHNIQUE_MAP = {
+    "reed_sol_van": "reed_sol_van",
+    "reed_sol_r6_op": "vandermonde",
+    "cauchy_orig": "cauchy",
+    "cauchy_good": "cauchy",
+}
+_UNSUPPORTED = ("liberation", "blaum_roth", "liber8tion")
+
+
+class ErasureCodeJerasureCompat(ErasureCodeJaxRS):
+    def init(self, profile: ErasureCodeProfile) -> None:
+        technique = profile.get("technique") or "reed_sol_van"
+        if technique in _UNSUPPORTED:
+            raise ValueError(
+                f"technique={technique} is a CPU bitmatrix/XOR-schedule "
+                f"technique with no TPU mapping; use one of "
+                f"{sorted(_TECHNIQUE_MAP)}")
+        if technique not in _TECHNIQUE_MAP:
+            raise ValueError(f"unknown jerasure technique {technique}")
+        if technique == "reed_sol_r6_op":
+            # RAID6: m is always 2 (ErasureCodeJerasure.h:111-140)
+            profile["m"] = "2"
+        profile = dict(profile)
+        profile["technique"] = _TECHNIQUE_MAP[technique]
+        super().init(profile)
+        # report the jerasure-visible technique name in the profile
+        self._profile["technique"] = technique
+
+
+class ErasureCodePluginJerasure(ErasureCodePlugin):
+    def factory(self, directory: str,
+                profile: ErasureCodeProfile) -> ErasureCodeJerasureCompat:
+        instance = ErasureCodeJerasureCompat()
+        instance.init(dict(profile))
+        return instance
+
+
+def __erasure_code_version__() -> str:
+    return __version__
+
+
+def __erasure_code_init__(name: str, directory: str) -> None:
+    ErasureCodePluginRegistry.instance().add(name, ErasureCodePluginJerasure())

@@ -1,0 +1,6 @@
+"""Broken plugin: init raises (mirrors ErasureCodePluginFailToInitialize.cc)."""
+from ceph_tpu import __version__
+def __erasure_code_version__():
+    return __version__
+def __erasure_code_init__(name, directory):
+    raise RuntimeError("-ESRCH: deliberate init failure")

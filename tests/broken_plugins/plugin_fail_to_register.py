@@ -1,0 +1,6 @@
+"""Broken plugin: init succeeds but never registers (mirrors ErasureCodePluginFailToRegister.cc)."""
+from ceph_tpu import __version__
+def __erasure_code_version__():
+    return __version__
+def __erasure_code_init__(name, directory):
+    pass

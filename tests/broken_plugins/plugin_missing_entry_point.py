@@ -1,0 +1,4 @@
+"""Broken plugin: no __erasure_code_init__ (mirrors ErasureCodePluginMissingEntryPoint.cc)."""
+from ceph_tpu import __version__
+def __erasure_code_version__():
+    return __version__

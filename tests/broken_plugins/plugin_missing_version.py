@@ -1,0 +1,3 @@
+"""Broken plugin: no __erasure_code_version__ (mirrors ErasureCodePluginMissingVersion.cc)."""
+def __erasure_code_init__(name, directory):
+    pass

@@ -1,0 +1,5 @@
+"""Broken plugin: version mismatch (mirrors the __erasure_code_version checks)."""
+def __erasure_code_version__():
+    return "0.0.0-not-this"
+def __erasure_code_init__(name, directory):
+    pass

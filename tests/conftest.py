@@ -1,6 +1,11 @@
 """Test configuration: run JAX on a virtual 8-device CPU mesh.
 
-Must run before any jax import (pytest loads conftest first).
+Two layers of defence, both needed in this environment:
+- env vars must be set before jax import;
+- the axon sitecustomize (PYTHONPATH=/root/.axon_site) overrides platform
+  selection via jax.config (jax_platforms="axon,cpu"), which would make the
+  first backend init dial the TPU tunnel even for CPU-only tests — so the
+  config must be forced back to cpu after import, too.
 """
 import os
 
@@ -9,3 +14,8 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+if jax.config.jax_platforms != "cpu":
+    jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,201 @@
+"""Plugin layer: interface roundtrips, profile validation, registry load
+paths (including the deliberately-broken plugins, mirroring
+src/test/erasure-code/TestErasureCodePlugin*.cc and the per-plugin
+TestErasureCode*.cc roundtrip strategy)."""
+import os
+
+import numpy as np
+import pytest
+
+from ceph_tpu.plugins import ErasureCodePluginRegistry
+from ceph_tpu.plugins.plugin_jax_rs import ErasureCodeJaxRS
+
+BROKEN_DIR = os.path.join(os.path.dirname(__file__), "broken_plugins")
+
+
+@pytest.fixture
+def registry():
+    reg = ErasureCodePluginRegistry()  # fresh, not the singleton
+    return reg
+
+
+def _payload(n=4000, seed=0):
+    return np.random.default_rng(seed).integers(0, 256, n, dtype=np.uint8).tobytes()
+
+
+# -- registry ---------------------------------------------------------------
+
+def test_registry_singleton():
+    a = ErasureCodePluginRegistry.instance()
+    b = ErasureCodePluginRegistry.instance()
+    assert a is b
+
+
+def test_factory_loads_and_instantiates(registry):
+    ec = registry.factory("jax_rs", "", {"k": "4", "m": "2", "device": "numpy"})
+    assert ec.get_chunk_count() == 6
+    assert ec.get_data_chunk_count() == 4
+    assert ec.get_profile()["plugin"] == "jax_rs"
+
+
+def test_factory_unknown_plugin(registry):
+    with pytest.raises(FileNotFoundError):
+        registry.factory("no_such_plugin", "", {})
+
+
+def test_factory_profile_plugin_mismatch(registry):
+    with pytest.raises(ValueError):
+        registry.factory("jax_rs", "", {"plugin": "other", "k": "4", "m": "2"})
+
+
+def test_double_add_rejected(registry):
+    registry.load("xor")
+    with pytest.raises(ValueError):
+        registry.load("xor").factory  # second load -> add collides
+        registry.add("xor", object())
+
+
+def test_preload(registry):
+    registry.preload(["jax_rs", "xor"])
+    assert registry.get("jax_rs") is not None
+    assert registry.get("xor") is not None
+    registry.preload(["jax_rs"])  # idempotent
+
+
+@pytest.mark.parametrize("name,err,match", [
+    ("missing_version", RuntimeError, "version"),
+    ("missing_entry_point", RuntimeError, "init"),
+    ("fail_to_initialize", RuntimeError, "ESRCH"),
+    ("fail_to_register", RuntimeError, "register"),
+    ("wrong_version", RuntimeError, "0.0.0"),
+])
+def test_broken_plugins(registry, name, err, match):
+    with pytest.raises(err, match=match):
+        registry.load(name, BROKEN_DIR)
+
+
+def test_load_from_missing_directory(registry):
+    with pytest.raises(FileNotFoundError):
+        registry.load("whatever", "/nonexistent/dir")
+
+
+# -- jax_rs plugin ----------------------------------------------------------
+
+@pytest.mark.parametrize("technique", ["reed_sol_van", "vandermonde", "cauchy"])
+def test_jax_rs_encode_decode_roundtrip(registry, technique):
+    profile = {"k": "4", "m": "2", "technique": technique, "device": "numpy"}
+    ec = registry.factory("jax_rs", "", profile)
+    data = _payload()
+    want = set(range(6))
+    encoded = ec.encode(want, data)
+    assert set(encoded) == want
+    chunk_size = ec.get_chunk_size(len(data))
+    assert chunk_size * 4 >= len(data)
+    assert all(len(v) == chunk_size for v in encoded.values())
+    # erase two chunks, decode, compare content (TestErasureCodeJerasure.cc:80-135)
+    available = {i: encoded[i] for i in want if i not in (0, 1)}
+    decoded = ec.decode({0, 1}, available)
+    np.testing.assert_array_equal(decoded[0], encoded[0])
+    np.testing.assert_array_equal(decoded[1], encoded[1])
+    # full payload recovery
+    assert ec.decode_concat(available)[:len(data)] == data
+
+
+def test_jax_rs_defaults(registry):
+    ec = registry.factory("jax_rs", "", {"device": "numpy"})
+    assert ec.get_data_chunk_count() == 7   # jerasure defaults k=7 m=3
+    assert ec.get_coding_chunk_count() == 3
+    assert ec.get_profile()["k"] == "7"
+
+
+def test_jax_rs_rejects_bad_profile(registry):
+    for bad in ({"k": "1", "m": "1"}, {"k": "4", "m": "0"},
+                {"k": "4", "m": "2", "w": "16"},
+                {"k": "4", "m": "2", "technique": "liberation"},
+                {"k": "4", "m": "2", "device": "gpu"}):
+        with pytest.raises(ValueError):
+            registry.factory("jax_rs", "", dict(bad))
+
+
+def test_jax_rs_chunk_mapping(registry):
+    profile = {"k": "2", "m": "1", "mapping": "D_D", "device": "numpy"}
+    ec = registry.factory("jax_rs", "", profile)
+    assert ec.get_chunk_mapping() == [0, 2, 1]
+    data = _payload(1000)
+    encoded = ec.encode(set(range(3)), data)
+    # chunk 1 holds parity now; erasing it and decoding data still works
+    available = {0: encoded[0], 2: encoded[2]}
+    assert ec.decode_concat(available)[:1000] == data
+
+
+def test_jax_rs_minimum_to_decode(registry):
+    ec = registry.factory("jax_rs", "", {"k": "4", "m": "2", "device": "numpy"})
+    # all wanted available: want itself
+    assert set(ec.minimum_to_decode({0, 1}, {0, 1, 2, 3})) == {0, 1}
+    # missing chunk: first k available
+    got = ec.minimum_to_decode({0}, {1, 2, 3, 4, 5})
+    assert set(got) == {1, 2, 3, 4}
+    assert got[1] == [(0, 1)]
+    with pytest.raises(IOError):
+        ec.minimum_to_decode({0}, {1, 2, 3})
+    assert ec.minimum_to_decode_with_cost({0}, {1: 1, 2: 1, 3: 1, 4: 9}) == {1, 2, 3, 4}
+
+
+def test_jax_rs_padding_edge_cases(registry):
+    ec = registry.factory("jax_rs", "", {"k": "4", "m": "2", "device": "numpy"})
+    for n in (1, 127, 128, 129, 511, 512, 513, 4096):
+        data = _payload(n, seed=n)
+        encoded = ec.encode(set(range(6)), data)
+        available = {i: encoded[i] for i in (2, 3, 4, 5)}
+        assert ec.decode_concat(available)[:n] == data, f"n={n}"
+
+
+# -- xor plugin -------------------------------------------------------------
+
+def test_xor_roundtrip(registry):
+    ec = registry.factory("xor", "", {"k": "3"})
+    data = _payload(999)
+    encoded = ec.encode(set(range(4)), data)
+    for lost in range(4):
+        available = {i: v for i, v in encoded.items() if i != lost}
+        decoded = ec.decode({lost}, available)
+        np.testing.assert_array_equal(decoded[lost], encoded[lost])
+    with pytest.raises(IOError):
+        ec.decode({0, 1}, {i: encoded[i] for i in (2, 3)})
+    with pytest.raises(ValueError):
+        registry.factory("xor", "", {"k": "2", "m": "2"})
+
+
+# -- jerasure / isa compat plugins ------------------------------------------
+
+def test_jerasure_compat(registry):
+    ec = registry.factory("jerasure", "",
+                          {"k": "4", "m": "2", "technique": "reed_sol_van",
+                           "device": "numpy"})
+    data = _payload()
+    encoded = ec.encode(set(range(6)), data)
+    available = {i: encoded[i] for i in (1, 2, 4, 5)}
+    assert ec.decode_concat(available)[:len(data)] == data
+    assert ec.get_profile()["technique"] == "reed_sol_van"
+    # RAID6 technique forces m=2
+    r6 = registry.factory("jerasure", "",
+                          {"k": "4", "m": "3", "technique": "reed_sol_r6_op",
+                           "device": "numpy"})
+    assert r6.get_coding_chunk_count() == 2
+    with pytest.raises(ValueError, match="bitmatrix"):
+        registry.factory("jerasure", "", {"k": "4", "m": "2",
+                                          "technique": "liber8tion"})
+
+
+def test_isa_compat(registry):
+    ec = registry.factory("isa", "", {"k": "8", "m": "4", "device": "numpy"})
+    data = _payload(8192)
+    encoded = ec.encode(set(range(12)), data)
+    available = {i: encoded[i] for i in range(12) if i not in (0, 5, 9, 11)}
+    assert ec.decode_concat(available)[:8192] == data
+    # vandermonde envelope (ErasureCodeIsa.cc:323-364)
+    with pytest.raises(ValueError):
+        registry.factory("isa", "", {"k": "22", "m": "4"})
+    # cauchy has no such limit
+    registry.factory("isa", "", {"k": "22", "m": "4", "technique": "cauchy",
+                                 "device": "numpy"})
