@@ -1,0 +1,181 @@
+"""CRUSH rjenkins1 hash, bit-exact to the reference
+(reference: src/crush/hash.c:12-90, seed 1315423911 at :24).
+
+Three implementations sharing one algorithm:
+- scalar Python ints (used by the exact rule interpreter),
+- vectorized numpy uint32,
+- jax uint32 (vmappable; feeds the bulk placement kernels).
+
+All arithmetic is uint32 with C wraparound; shifts are logical.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+CRUSH_HASH_SEED = 1315423911
+_M = 0xFFFFFFFF
+
+
+def _mix(a: int, b: int, c: int) -> tuple[int, int, int]:
+    a = (a - b) & _M; a = (a - c) & _M; a ^= c >> 13
+    b = (b - c) & _M; b = (b - a) & _M; b ^= (a << 8) & _M
+    c = (c - a) & _M; c = (c - b) & _M; c ^= b >> 13
+    a = (a - b) & _M; a = (a - c) & _M; a ^= c >> 12
+    b = (b - c) & _M; b = (b - a) & _M; b ^= (a << 16) & _M
+    c = (c - a) & _M; c = (c - b) & _M; c ^= b >> 5
+    a = (a - b) & _M; a = (a - c) & _M; a ^= c >> 3
+    b = (b - c) & _M; b = (b - a) & _M; b ^= (a << 10) & _M
+    c = (c - a) & _M; c = (c - b) & _M; c ^= b >> 15
+    return a, b, c
+
+
+def crush_hash32(a: int) -> int:
+    a &= _M
+    h = (CRUSH_HASH_SEED ^ a) & _M
+    b, x, y = a, 231232, 1232
+    b, x, h = _mix(b, x, h)
+    y, a, h = _mix(y, a, h)
+    return h
+
+
+def crush_hash32_2(a: int, b: int) -> int:
+    a &= _M; b &= _M
+    h = (CRUSH_HASH_SEED ^ a ^ b) & _M
+    x, y = 231232, 1232
+    a, b, h = _mix(a, b, h)
+    x, a, h = _mix(x, a, h)
+    b, y, h = _mix(b, y, h)
+    return h
+
+
+def crush_hash32_3(a: int, b: int, c: int) -> int:
+    a &= _M; b &= _M; c &= _M
+    h = (CRUSH_HASH_SEED ^ a ^ b ^ c) & _M
+    x, y = 231232, 1232
+    a, b, h = _mix(a, b, h)
+    c, x, h = _mix(c, x, h)
+    y, a, h = _mix(y, a, h)
+    b, x, h = _mix(b, x, h)
+    y, c, h = _mix(y, c, h)
+    return h
+
+
+def crush_hash32_4(a: int, b: int, c: int, d: int) -> int:
+    a &= _M; b &= _M; c &= _M; d &= _M
+    h = (CRUSH_HASH_SEED ^ a ^ b ^ c ^ d) & _M
+    x, y = 231232, 1232
+    a, b, h = _mix(a, b, h)
+    c, d, h = _mix(c, d, h)
+    a, x, h = _mix(a, x, h)
+    y, b, h = _mix(y, b, h)
+    c, x, h = _mix(c, x, h)
+    y, d, h = _mix(y, d, h)
+    return h
+
+
+def crush_hash32_5(a: int, b: int, c: int, d: int, e: int) -> int:
+    a &= _M; b &= _M; c &= _M; d &= _M; e &= _M
+    h = (CRUSH_HASH_SEED ^ a ^ b ^ c ^ d ^ e) & _M
+    x, y = 231232, 1232
+    a, b, h = _mix(a, b, h)
+    c, d, h = _mix(c, d, h)
+    e, x, h = _mix(e, x, h)
+    y, a, h = _mix(y, a, h)
+    b, x, h = _mix(b, x, h)
+    y, c, h = _mix(y, c, h)
+    d, x, h = _mix(d, x, h)
+    y, e, h = _mix(y, e, h)
+    return h
+
+
+# -- numpy vectorized -------------------------------------------------------
+
+def _mix_np(a, b, c):
+    a = a - b; a = a - c; a = a ^ (c >> np.uint32(13))
+    b = b - c; b = b - a; b = b ^ (a << np.uint32(8))
+    c = c - a; c = c - b; c = c ^ (b >> np.uint32(13))
+    a = a - b; a = a - c; a = a ^ (c >> np.uint32(12))
+    b = b - c; b = b - a; b = b ^ (a << np.uint32(16))
+    c = c - a; c = c - b; c = c ^ (b >> np.uint32(5))
+    a = a - b; a = a - c; a = a ^ (c >> np.uint32(3))
+    b = b - c; b = b - a; b = b ^ (a << np.uint32(10))
+    c = c - a; c = c - b; c = c ^ (b >> np.uint32(15))
+    return a, b, c
+
+
+def crush_hash32_3_np(a, b, c):
+    """Vectorized 3-arg hash over numpy uint32 arrays (broadcasting)."""
+    a = np.asarray(a).astype(np.uint32)
+    b = np.asarray(b).astype(np.uint32)
+    c = np.asarray(c).astype(np.uint32)
+    with np.errstate(over="ignore"):
+        h = np.uint32(CRUSH_HASH_SEED) ^ a ^ b ^ c
+        x = np.uint32(231232) + np.zeros_like(h)
+        y = np.uint32(1232) + np.zeros_like(h)
+        a, b, h = _mix_np(a, b, h)
+        c, x, h = _mix_np(c, x, h)
+        y, a, h = _mix_np(y, a, h)
+        b, x, h = _mix_np(b, x, h)
+        y, c, h = _mix_np(y, c, h)
+    return h
+
+
+def crush_hash32_2_np(a, b):
+    a = np.asarray(a).astype(np.uint32)
+    b = np.asarray(b).astype(np.uint32)
+    with np.errstate(over="ignore"):
+        h = np.uint32(CRUSH_HASH_SEED) ^ a ^ b
+        x = np.uint32(231232) + np.zeros_like(h)
+        y = np.uint32(1232) + np.zeros_like(h)
+        a, b, h = _mix_np(a, b, h)
+        x, a, h = _mix_np(x, a, h)
+        b, y, h = _mix_np(b, y, h)
+    return h
+
+
+# -- jax --------------------------------------------------------------------
+
+def _mix_jax(a, b, c):
+    import jax.numpy as jnp
+    u = lambda n: jnp.uint32(n)
+    a = a - b; a = a - c; a = a ^ (c >> u(13))
+    b = b - c; b = b - a; b = b ^ (a << u(8))
+    c = c - a; c = c - b; c = c ^ (b >> u(13))
+    a = a - b; a = a - c; a = a ^ (c >> u(12))
+    b = b - c; b = b - a; b = b ^ (a << u(16))
+    c = c - a; c = c - b; c = c ^ (b >> u(5))
+    a = a - b; a = a - c; a = a ^ (c >> u(3))
+    b = b - c; b = b - a; b = b ^ (a << u(10))
+    c = c - a; c = c - b; c = c ^ (b >> u(15))
+    return a, b, c
+
+
+def crush_hash32_3_jax(a, b, c):
+    """3-arg hash on jax uint32 arrays — the straw2 draw hash."""
+    import jax.numpy as jnp
+    a = a.astype(jnp.uint32)
+    b = b.astype(jnp.uint32)
+    c = c.astype(jnp.uint32)
+    h = jnp.uint32(CRUSH_HASH_SEED) ^ a ^ b ^ c
+    x = jnp.broadcast_to(jnp.uint32(231232), h.shape)
+    y = jnp.broadcast_to(jnp.uint32(1232), h.shape)
+    a, b, h = _mix_jax(a, b, h)
+    c, x, h = _mix_jax(c, x, h)
+    y, a, h = _mix_jax(y, a, h)
+    b, x, h = _mix_jax(b, x, h)
+    y, c, h = _mix_jax(y, c, h)
+    return h
+
+
+def crush_hash32_2_jax(a, b):
+    """2-arg hash on jax uint32 arrays — is_out / pps hashing."""
+    import jax.numpy as jnp
+    a = a.astype(jnp.uint32)
+    b = b.astype(jnp.uint32)
+    h = jnp.uint32(CRUSH_HASH_SEED) ^ a ^ b
+    x = jnp.broadcast_to(jnp.uint32(231232), h.shape)
+    y = jnp.broadcast_to(jnp.uint32(1232), h.shape)
+    a, b, h = _mix_jax(a, b, h)
+    x, a, h = _mix_jax(x, a, h)
+    b, y, h = _mix_jax(b, y, h)
+    return h

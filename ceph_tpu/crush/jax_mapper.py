@@ -1,0 +1,345 @@
+"""Vectorized CRUSH placement: one jitted call maps millions of PGs.
+
+TPU-first reformulation of the reference's bulk mapping
+(reference: src/osd/OSDMapMapping.{h,cc} ParallelPGMapper — a thread pool
+looping crush_do_rule per PG; here the whole PG axis is vmapped and the
+data-dependent retry loops become bounded lax.while_loops with masking,
+cf. SURVEY.md §7 "CRUSH's data-dependent loops").
+
+Scope (the production shape): maps whose buckets are all non-empty STRAW2
+(the default since jewel) and rules of the form
+    take <root>; choose[leaf]_{firstn,indep} <n> <type>; emit
+with optimal-profile local-retry tunables (choose_local_tries=0,
+choose_local_fallback_tries=0) and either chooseleaf_stable=1 or
+chooseleaf_descend_once=1 (single-try leaf recursion).  Anything else falls
+back to the exact host interpreter (ceph_tpu.crush.mapper), which is also
+the oracle these kernels are tested against bit-for-bit.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from .hash import crush_hash32_2_jax, crush_hash32_3_jax
+from .ln import LN_TABLE_S64
+from .map import (CRUSH_BUCKET_STRAW2, CRUSH_ITEM_NONE,
+                  CRUSH_RULE_CHOOSELEAF_FIRSTN, CRUSH_RULE_CHOOSELEAF_INDEP,
+                  CRUSH_RULE_CHOOSE_FIRSTN, CRUSH_RULE_CHOOSE_INDEP,
+                  CRUSH_RULE_EMIT, CRUSH_RULE_TAKE, CrushMap)
+
+S64_MIN = -(1 << 63)
+LN_BIAS = 0x1000000000000  # 2^48
+UNDEF = 0x7FFFFFFE         # CRUSH_ITEM_UNDEF
+
+
+@dataclass(frozen=True)
+class CompiledMap:
+    """Dense-array form of a straw2-only CrushMap for device kernels."""
+    items: np.ndarray        # [B, S] int32 (device ids >= 0, bucket ids < 0)
+    weights: np.ndarray      # [B, S] int64 (16.16 fixed point)
+    sizes: np.ndarray        # [B] int32
+    types: np.ndarray        # [B] int32
+    row_of_id: np.ndarray    # [max_buckets] int32 (-1 if absent)
+    max_devices: int
+    max_depth: int
+    tunables: dict
+
+    @classmethod
+    def compile(cls, cmap: CrushMap) -> "CompiledMap":
+        for b in cmap.buckets.values():
+            if b.alg != CRUSH_BUCKET_STRAW2:
+                raise ValueError(
+                    f"bucket {b.id} alg={b.alg}: JAX path supports straw2 "
+                    f"only; use the host interpreter")
+            if b.size == 0:
+                raise ValueError("empty buckets need the host interpreter")
+        t = cmap.tunables
+        if t["choose_local_tries"] or t["choose_local_fallback_tries"]:
+            raise ValueError("local retry tunables need the host interpreter")
+        if not t["chooseleaf_descend_once"]:
+            # without descend_once the chooseleaf recursion retries inside
+            # the chosen domain (recurse_tries=choose_tries, mapper.c
+            # do_rule firstn branch); the kernels do a single-try descent
+            raise ValueError(
+                "chooseleaf_descend_once=0 needs the host interpreter")
+        ids = sorted(cmap.buckets)
+        nb = len(ids)
+        smax = max(b.size for b in cmap.buckets.values())
+        items = np.full((nb, smax), CRUSH_ITEM_NONE, dtype=np.int32)
+        weights = np.zeros((nb, smax), dtype=np.int64)
+        sizes = np.zeros(nb, dtype=np.int32)
+        types = np.zeros(nb, dtype=np.int32)
+        row_of_id = np.full(max(-i for i in ids), -1, dtype=np.int32)
+        for row, bid in enumerate(ids):
+            b = cmap.buckets[bid]
+            items[row, :b.size] = b.items
+            weights[row, :b.size] = b.item_weights
+            sizes[row] = b.size
+            types[row] = b.type
+            row_of_id[-1 - bid] = row
+        # longest bucket chain via memoized DFS (bucket ids carry no
+        # ordering guarantee: Ceph assigns the root -1 and children -2...)
+        depth: dict[int, int] = {}
+
+        def bucket_depth(bid: int, seen: frozenset = frozenset()) -> int:
+            if bid in depth:
+                return depth[bid]
+            if bid in seen:
+                raise ValueError(f"bucket cycle through {bid}")
+            d = 1
+            for it in cmap.buckets[bid].items:
+                if it < 0 and it in cmap.buckets:
+                    d = max(d, bucket_depth(it, seen | {bid}) + 1)
+            depth[bid] = d
+            return d
+
+        for bid in ids:
+            bucket_depth(bid)
+        return cls(items=items, weights=weights, sizes=sizes, types=types,
+                   row_of_id=row_of_id, max_devices=cmap.max_devices,
+                   max_depth=max(depth.values()), tunables=dict(t))
+
+
+class BulkMapper:
+    """jit/vmap CRUSH placement over a compiled straw2 map.
+
+    map_rule(ruleno, xs) -> (out [N, numrep] int32 with CRUSH_ITEM_NONE
+    holes/padding, placed [N] int32).
+    """
+
+    def __init__(self, cmap: CrushMap):
+        self.cm = CompiledMap.compile(cmap)
+        self.cmap = cmap
+        self._cache = {}
+
+    # -- kernel construction ------------------------------------------------
+
+    def _kernel(self, kind: str, root: int, numrep: int, out_size: int,
+                target_type: int, leaf: bool):
+        key = (kind, root, numrep, out_size, target_type, leaf)
+        if key in self._cache:
+            return self._cache[key]
+        import jax
+        # straw2 draws are exact int64 fixed-point quotients (mapper.c
+        # div64_s64); JAX's default 32-bit mode would silently truncate the
+        # 2^48-scale ln values.  Refuse to run rather than flip the
+        # process-global flag behind the caller's back.  (On TPU, XLA
+        # emulates s64 with i32 pairs — fine for placement workloads.)
+        if not jax.config.jax_enable_x64:
+            raise RuntimeError(
+                "CRUSH bulk mapping needs 64-bit JAX types: call "
+                "jax.config.update('jax_enable_x64', True) first "
+                "(or set JAX_ENABLE_X64=1)")
+        import jax.numpy as jnp
+        from jax import lax
+
+        cm = self.cm
+        items_d = jnp.asarray(cm.items)
+        weights_d = jnp.asarray(cm.weights)
+        sizes_d = jnp.asarray(cm.sizes)
+        types_d = jnp.asarray(cm.types)
+        row_of_id_d = jnp.asarray(cm.row_of_id)
+        ln_d = jnp.asarray(LN_TABLE_S64)
+        smax = cm.items.shape[1]
+        slot = jnp.arange(smax, dtype=jnp.int32)
+        tries = cm.tunables["choose_total_tries"] + 1
+        vary_r = cm.tunables["chooseleaf_vary_r"]
+        stable = cm.tunables["chooseleaf_stable"]
+        root_row = int(cm.row_of_id[-1 - root])
+        max_devices = cm.max_devices
+        NONE = jnp.int32(CRUSH_ITEM_NONE)
+
+        def straw2_choose(row, x, r):
+            """mapper.c:361-384 vectorized over one bucket's item slots."""
+            ids = items_d[row]
+            ws = weights_d[row]
+            u = crush_hash32_3_jax(
+                jnp.broadcast_to(x, ids.shape),
+                ids,
+                jnp.broadcast_to(r, ids.shape)) & jnp.uint32(0xFFFF)
+            ln = ln_d[u.astype(jnp.int32)]
+            # trunc((ln - 2^48)/w): numerator <= 0, equals -((2^48-ln)//w)
+            draw = -((LN_BIAS - ln) // jnp.maximum(ws, 1))
+            draw = jnp.where((ws > 0) & (slot < sizes_d[row]), draw, S64_MIN)
+            return ids[jnp.argmax(draw)]
+
+        def is_out(reweights, item, x):
+            """mapper.c:424-438"""
+            w = reweights[jnp.clip(item, 0, reweights.shape[0] - 1)]
+            oob = item >= reweights.shape[0]
+            h = crush_hash32_2_jax(x, item.astype(jnp.uint32)) & jnp.uint32(0xFFFF)
+            return oob | (w == 0) | ((w < 0x10000) & (h.astype(jnp.int64) >= w))
+
+        def descend(row0, x, r, ttype):
+            """Walk intervening buckets until an item of type ttype
+            (mapper.c:547-565 / :787-800).  Returns (item, ok, skip):
+            ok = landed on the target type; skip = structurally bad
+            (device at the wrong level or id >= max_devices -> the
+            reference's skip_rep / CRUSH_ITEM_NONE cases)."""
+            def body(_, carry):
+                row, item, done, skip = carry
+                nxt = straw2_choose(row, x, r)
+                is_bucket = nxt < jnp.int32(0)
+                nrow = jnp.where(is_bucket, row_of_id_d[-1 - nxt], 0)
+                ntype = jnp.where(is_bucket, types_d[nrow], 0)
+                oob_dev = (~is_bucket) & (nxt >= max_devices)
+                hit = (ntype == ttype) & (~oob_dev)
+                bad = oob_dev | ((~hit) & (~is_bucket))
+                new_done = done | hit | bad
+                return (jnp.where(new_done, row, nrow),
+                        jnp.where(done, item, nxt),
+                        new_done,
+                        jnp.where(done, skip, bad))
+            init = (jnp.int32(row0), jnp.int32(0), jnp.bool_(False),
+                    jnp.bool_(False))
+            _, item, done, skip = lax.fori_loop(0, cm.max_depth, body, init)
+            # depth exhaustion without landing: treat as retryable reject
+            return item, done & (~skip), skip
+
+        def leaf_from(item, x, r, outpos):
+            """Single-try chooseleaf recursion (recurse_tries=1):
+            r_leaf = (stable ? 0 : outpos) + sub_r (mapper.c:570-596)."""
+            sub_r = (r >> (vary_r - 1)) if vary_r else jnp.int32(0)
+            base = jnp.int32(0) if stable else outpos
+            drow = jnp.where(item < 0, row_of_id_d[-1 - item], 0)
+            return descend(drow, x, base + sub_r, 0)
+
+        def firstn_one(x, reweights):
+            """crush_choose_firstn (mapper.c:460-651), no local retries.
+            Places at most out_size items while scanning numrep reps
+            (the reference's count/out_size vs numrep split)."""
+            out = jnp.full((out_size,), NONE, dtype=jnp.int32)
+            out2 = jnp.full((out_size,), NONE, dtype=jnp.int32)
+            outpos = jnp.int32(0)
+
+            for rep in range(numrep):
+                def cond(st):
+                    placed, dead, ftotal, _o, _o2, outpos = st
+                    return (~placed) & (~dead) & (ftotal < tries) & \
+                        (outpos < out_size)
+
+                def body(st):
+                    placed, dead, ftotal, out, out2, outpos = st
+                    r = jnp.int32(rep) + ftotal
+                    item, ok, skip = descend(root_row, x, r, target_type)
+                    pos_mask = jnp.arange(out_size) < outpos
+                    collide = jnp.any(pos_mask & (out == item))
+                    reject = ~ok
+                    if leaf:
+                        lf, lok, _ = leaf_from(item, x, r, outpos)
+                        lcollide = jnp.any(pos_mask & (out2 == lf))
+                        reject = reject | (~lok) | lcollide | \
+                            is_out(reweights, lf, x)
+                        leaf_item = lf
+                    else:
+                        leaf_item = item
+                        if target_type == 0:
+                            reject = reject | is_out(reweights, item, x)
+                    good = (~skip) & (~reject) & (~collide)
+                    new_out = jnp.where(good, out.at[outpos].set(item), out)
+                    new_out2 = jnp.where(good,
+                                         out2.at[outpos].set(leaf_item), out2)
+                    return (good, skip, ftotal + 1, new_out, new_out2,
+                            jnp.where(good, outpos + 1, outpos))
+
+                _, _, _, out, out2, outpos = lax.while_loop(
+                    cond, body,
+                    (jnp.bool_(False), jnp.bool_(False), jnp.int32(0),
+                     out, out2, outpos))
+
+            result = out2 if leaf else out
+            keep = jnp.arange(out_size) < outpos
+            return jnp.where(keep, result, NONE), outpos
+
+        def indep_one(x, reweights):
+            """crush_choose_indep (mapper.c:658-847): positionally stable."""
+            out = jnp.full((out_size,), UNDEF, dtype=jnp.int32)
+            out2 = jnp.full((out_size,), UNDEF, dtype=jnp.int32)
+
+            def cond(st):
+                out, out2, ftotal = st
+                return (ftotal < tries) & jnp.any(out == UNDEF)
+
+            def body(st):
+                out, out2, ftotal = st
+                for rep in range(out_size):
+                    undef = out[rep] == UNDEF
+                    r = jnp.int32(rep) + jnp.int32(numrep) * ftotal
+                    item, ok, skip = descend(root_row, x, r, target_type)
+                    collide = jnp.any(out == item)
+                    reject = (~ok) | collide
+                    if leaf:
+                        # recursion: out2[rep], parent_r = r, one try
+                        drow = jnp.where(item < 0, row_of_id_d[-1 - item], 0)
+                        lf, lok, _ = descend(drow, x, jnp.int32(rep) + r, 0)
+                        reject = reject | (~lok) | is_out(reweights, lf, x)
+                        leaf_item = lf
+                    else:
+                        leaf_item = item
+                        if target_type == 0:
+                            reject = reject | is_out(reweights, item, x)
+                    # structural badness pins the hole permanently
+                    pin_none = undef & skip
+                    good = undef & (~skip) & (~reject)
+                    out = jnp.where(pin_none, out.at[rep].set(NONE), out)
+                    out2 = jnp.where(pin_none, out2.at[rep].set(NONE), out2)
+                    out = jnp.where(good, out.at[rep].set(item), out)
+                    out2 = jnp.where(good, out2.at[rep].set(leaf_item), out2)
+                return out, out2, ftotal + 1
+
+            out, out2, _ = lax.while_loop(cond, body,
+                                          (out, out2, jnp.int32(0)))
+            result = out2 if leaf else out
+            return jnp.where(result == UNDEF, NONE, result), jnp.int32(out_size)
+
+        one = firstn_one if kind == "firstn" else indep_one
+
+        @jax.jit
+        def bulk(xs, reweights):
+            return jax.vmap(lambda x: one(x, reweights))(xs)
+
+        self._cache[key] = bulk
+        return bulk
+
+    # -- public API ---------------------------------------------------------
+
+    def map_rule(self, ruleno: int, xs, reweights=None, result_max: int = 0):
+        import jax.numpy as jnp
+        rule = self.cmap.rules[ruleno]
+        steps = rule.steps
+        if (len(steps) != 3 or steps[0][0] != CRUSH_RULE_TAKE or
+                steps[2][0] != CRUSH_RULE_EMIT):
+            raise ValueError("JAX path supports take/choose/emit rules only")
+        op, arg1, arg2 = steps[1]
+        kind_map = {
+            CRUSH_RULE_CHOOSE_FIRSTN: ("firstn", False),
+            CRUSH_RULE_CHOOSELEAF_FIRSTN: ("firstn", True),
+            CRUSH_RULE_CHOOSE_INDEP: ("indep", False),
+            CRUSH_RULE_CHOOSELEAF_INDEP: ("indep", True),
+        }
+        if op not in kind_map:
+            raise ValueError(f"unsupported op {op} on JAX path")
+        kind, leaf = kind_map[op]
+        if leaf and arg2 == 0:
+            # chooseleaf over failure-domain osd: the reference copies the
+            # chosen device straight into the leaf vector (mapper.c:592-596)
+            leaf = False
+        numrep = arg1
+        if numrep <= 0:
+            if result_max <= 0:
+                raise ValueError("numrep<=0 rule needs result_max")
+            numrep += result_max
+        # the reference clamps only the output size; the retry stride keeps
+        # the rule's numrep (crush_do_rule: out_size = min(numrep,
+        # result_max-osize) while crush_choose_indep still gets numrep)
+        out_size = min(numrep, result_max) if result_max else numrep
+        root = steps[0][1]
+        if reweights is None:
+            reweights = np.full(self.cm.max_devices, 0x10000, dtype=np.int64)
+        reweights = jnp.asarray(np.asarray(reweights, dtype=np.int64))
+        xs = jnp.asarray(np.asarray(xs, dtype=np.uint32))
+        bulk = self._kernel(kind, root, int(numrep), int(out_size),
+                            int(arg2), leaf)
+        out, placed = bulk(xs, reweights)
+        return np.asarray(out), np.asarray(placed)

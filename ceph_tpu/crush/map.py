@@ -1,0 +1,275 @@
+"""CRUSH map data model + builder.
+
+Python analog of the reference's map structs and builder API
+(reference: src/crush/crush.h:52-239, src/crush/builder.c): buckets with the
+five algorithms (UNIFORM/LIST/TREE/STRAW/STRAW2), rules as (op, arg1, arg2)
+step lists, and the map-level tunables.  The builder computes the derived
+per-algorithm data (list sum_weights, tree node_weights) the same way the
+reference does, and ``finalize`` computes ``max_devices``.
+
+Serialisable via from_dict/to_dict — the golden tests load maps dumped by
+the reference builder (tools/golden/golden_gen.c) through from_dict.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+# bucket algorithms (crush.h:123-191)
+CRUSH_BUCKET_UNIFORM = 1
+CRUSH_BUCKET_LIST = 2
+CRUSH_BUCKET_TREE = 3
+CRUSH_BUCKET_STRAW = 4
+CRUSH_BUCKET_STRAW2 = 5
+
+# rule step opcodes (crush.h:52-70)
+CRUSH_RULE_NOOP = 0
+CRUSH_RULE_TAKE = 1
+CRUSH_RULE_CHOOSE_FIRSTN = 2
+CRUSH_RULE_CHOOSE_INDEP = 3
+CRUSH_RULE_EMIT = 4
+CRUSH_RULE_CHOOSELEAF_FIRSTN = 6
+CRUSH_RULE_CHOOSELEAF_INDEP = 7
+CRUSH_RULE_SET_CHOOSE_TRIES = 8
+CRUSH_RULE_SET_CHOOSELEAF_TRIES = 9
+CRUSH_RULE_SET_CHOOSE_LOCAL_TRIES = 10
+CRUSH_RULE_SET_CHOOSE_LOCAL_FALLBACK_TRIES = 11
+CRUSH_RULE_SET_CHOOSELEAF_VARY_R = 12
+CRUSH_RULE_SET_CHOOSELEAF_STABLE = 13
+
+CRUSH_ITEM_UNDEF = 0x7FFFFFFE   # crush.h (mapping undefined)
+CRUSH_ITEM_NONE = 0x7FFFFFFF    # no item (EC positional hole)
+
+CRUSH_HASH_RJENKINS1 = 0
+
+
+@dataclass
+class Bucket:
+    id: int
+    alg: int
+    type: int
+    items: list[int]
+    weight: int = 0                         # 16.16 cumulative
+    hash: int = CRUSH_HASH_RJENKINS1
+    item_weights: list[int] | None = None   # list/straw/straw2
+    sum_weights: list[int] | None = None    # list
+    item_weight: int | None = None          # uniform
+    num_nodes: int | None = None            # tree
+    node_weights: list[int] | None = None   # tree
+    straws: list[int] | None = None         # straw v1
+
+    @property
+    def size(self) -> int:
+        return len(self.items)
+
+
+@dataclass
+class Rule:
+    steps: list[tuple[int, int, int]]
+    ruleno: int = -1
+
+
+# optimal tunable profile (builder.c set_optimal_crush_map semantics)
+OPTIMAL_TUNABLES = dict(choose_local_tries=0, choose_local_fallback_tries=0,
+                        choose_total_tries=50, chooseleaf_descend_once=1,
+                        chooseleaf_vary_r=1, chooseleaf_stable=1)
+# legacy profile (builder.h set_legacy_crush_map doc)
+LEGACY_TUNABLES = dict(choose_local_tries=2, choose_local_fallback_tries=5,
+                       choose_total_tries=19, chooseleaf_descend_once=0,
+                       chooseleaf_vary_r=0, chooseleaf_stable=0)
+
+
+class CrushMap:
+    def __init__(self, tunables: dict | None = None):
+        self.buckets: dict[int, Bucket] = {}       # id (negative) -> Bucket
+        self.rules: dict[int, Rule] = {}
+        self.tunables = dict(OPTIMAL_TUNABLES)
+        if tunables:
+            self.tunables.update(tunables)
+        self.max_devices = 0
+        # CrushWrapper-style naming (reference: src/crush/CrushWrapper.h)
+        self.type_names: dict[int, str] = {0: "osd"}
+        self.item_names: dict[int, str] = {}
+        self.rule_names: dict[str, int] = {}
+        self.choose_args: dict[int, object] = {}
+
+    # -- builder (builder.c semantics) -------------------------------------
+
+    def add_bucket(self, alg: int, type: int, items: list[int],
+                   weights: list[int] | None = None, id: int | None = None,
+                   uniform_weight: int | None = None) -> int:
+        if id is None:
+            id = -1
+            while id in self.buckets:
+                id -= 1
+        if id >= 0 or id in self.buckets:
+            raise ValueError(f"bad bucket id {id}")
+        items = [int(i) for i in items]
+        b = Bucket(id=id, alg=alg, type=type, items=items)
+        if alg == CRUSH_BUCKET_UNIFORM:
+            if uniform_weight is None:
+                uniform_weight = weights[0] if weights else 0x10000
+            b.item_weight = int(uniform_weight)
+            b.weight = b.item_weight * len(items)
+        elif alg == CRUSH_BUCKET_LIST:
+            b.item_weights = [int(w) for w in weights]
+            # sum_weights[i] = sum of item_weights[j] for j <= i (builder.c
+            # crush_make_list_bucket: cumulative including self)
+            acc, sums = 0, []
+            for w in b.item_weights:
+                acc += w
+                sums.append(acc)
+            b.sum_weights = sums
+            b.weight = acc
+        elif alg == CRUSH_BUCKET_STRAW2:
+            b.item_weights = [int(w) for w in weights]
+            b.weight = sum(b.item_weights)
+        elif alg == CRUSH_BUCKET_TREE:
+            b.item_weights = [int(w) for w in weights]
+            self._build_tree(b)
+        elif alg == CRUSH_BUCKET_STRAW:
+            raise NotImplementedError(
+                "straw(v1) construction needs the legacy straw calculation; "
+                "straw buckets can be loaded via from_dict (dumped maps) but "
+                "new maps should use straw2")
+        else:
+            raise ValueError(f"unknown bucket alg {alg}")
+        self.buckets[id] = b
+        return id
+
+    @staticmethod
+    def _build_tree(b: Bucket) -> None:
+        """Tree bucket node table (builder.c crush_make_tree_bucket
+        semantics): leaves at odd node indices, internal weights cumulative."""
+        n = len(b.items)
+        depth = 0
+        t = 1
+        while t < n:
+            t <<= 1
+            depth += 1
+        num_nodes = 1 << (depth + 1)
+        node_weights = [0] * num_nodes
+        for i, w in enumerate(b.item_weights):
+            node = (i << 1) + 1
+            node_weights[node] = int(w)
+        # propagate up: each internal node at even index sums its subtree
+        for h in range(1, depth + 1):
+            step = 1 << h
+            for node in range(step, num_nodes, step << 1):
+                lo = node - (step >> 1)
+                hi = node + (step >> 1)
+                node_weights[node] = node_weights[lo] + (
+                    node_weights[hi] if hi < num_nodes else 0)
+        b.num_nodes = num_nodes
+        b.node_weights = node_weights
+        b.weight = node_weights[num_nodes >> 1]
+
+    def add_rule(self, steps: list[tuple[int, int, int]],
+                 ruleno: int | None = None) -> int:
+        if ruleno is None:
+            ruleno = 0
+            while ruleno in self.rules:
+                ruleno += 1
+        if ruleno in self.rules:
+            raise ValueError(f"rule {ruleno} exists")
+        self.rules[ruleno] = Rule(steps=[tuple(s) for s in steps],
+                                  ruleno=ruleno)
+        return ruleno
+
+    def finalize(self) -> None:
+        """Compute max_devices (builder.c crush_finalize)."""
+        md = 0
+        for b in self.buckets.values():
+            for i in b.items:
+                if i >= 0:
+                    md = max(md, i + 1)
+        self.max_devices = md
+
+    # -- naming / convenience (CrushWrapper-shaped) ------------------------
+
+    def set_type_name(self, type_id: int, name: str) -> None:
+        self.type_names[type_id] = name
+
+    def type_id(self, name: str) -> int:
+        for t, n in self.type_names.items():
+            if n == name:
+                return t
+        raise KeyError(f"unknown crush type {name}")
+
+    def set_item_name(self, item: int, name: str) -> None:
+        self.item_names[item] = name
+
+    def item_id(self, name: str) -> int:
+        for i, n in self.item_names.items():
+            if n == name:
+                return i
+        raise KeyError(f"unknown crush item {name}")
+
+    def add_simple_rule(self, name: str, root_name: str,
+                        failure_domain: str, device_class: str = "",
+                        mode: str = "firstn", num_rep: int = 0) -> int:
+        """CrushWrapper::add_simple_rule semantics (CrushWrapper.h; used by
+        ErasureCode::create_rule with mode='indep', ErasureCode.cc:64-83)."""
+        if device_class:
+            raise NotImplementedError("device classes: shadow trees TBD")
+        root = self.item_id(root_name)
+        steps = [(CRUSH_RULE_TAKE, root, 0)]
+        if failure_domain == "osd" or failure_domain == "":
+            op = (CRUSH_RULE_CHOOSE_INDEP if mode == "indep"
+                  else CRUSH_RULE_CHOOSE_FIRSTN)
+            steps.append((op, num_rep, 0))
+        else:
+            ftype = self.type_id(failure_domain)
+            op = (CRUSH_RULE_CHOOSELEAF_INDEP if mode == "indep"
+                  else CRUSH_RULE_CHOOSELEAF_FIRSTN)
+            steps.append((op, num_rep, ftype))
+        steps.append((CRUSH_RULE_EMIT, 0, 0))
+        if name in self.rule_names:
+            raise ValueError(f"rule {name!r} already exists")
+        ruleno = self.add_rule(steps)
+        self.rule_names[name] = ruleno
+        return ruleno
+
+    # -- (de)serialisation --------------------------------------------------
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "CrushMap":
+        m = cls(tunables=d.get("tunables"))
+        for bd in d.get("buckets", []):
+            b = Bucket(
+                id=bd["id"], alg=bd["alg"], type=bd["type"],
+                items=list(bd["items"]), weight=bd.get("weight", 0),
+                item_weights=bd.get("item_weights"),
+                sum_weights=bd.get("sum_weights"),
+                item_weight=bd.get("item_weight"),
+                num_nodes=bd.get("num_nodes"),
+                node_weights=bd.get("node_weights"),
+                straws=bd.get("straws"),
+            )
+            m.buckets[b.id] = b
+        for rd in d.get("rules", []):
+            m.rules[rd["ruleno"]] = Rule(
+                steps=[tuple(s) for s in rd["steps"]], ruleno=rd["ruleno"])
+        m.max_devices = d.get("max_devices", 0)
+        if not m.max_devices:
+            m.finalize()
+        return m
+
+    def to_dict(self) -> dict:
+        buckets = []
+        for b in sorted(self.buckets.values(), key=lambda b: -b.id):
+            bd = {"id": b.id, "alg": b.alg, "type": b.type,
+                  "weight": b.weight, "size": b.size, "items": list(b.items)}
+            for k in ("item_weights", "sum_weights", "item_weight",
+                      "num_nodes", "node_weights", "straws"):
+                v = getattr(b, k)
+                if v is not None:
+                    bd[k] = v
+            buckets.append(bd)
+        return {
+            "tunables": dict(self.tunables),
+            "max_devices": self.max_devices,
+            "buckets": buckets,
+            "rules": [{"ruleno": r.ruleno, "steps": [list(s) for s in r.steps]}
+                      for r in sorted(self.rules.values(),
+                                      key=lambda r: r.ruleno)],
+        }

@@ -19,3 +19,6 @@ import jax  # noqa: E402
 
 if jax.config.jax_platforms != "cpu":
     jax.config.update("jax_platforms", "cpu")
+
+# CRUSH bulk kernels need exact int64 straw2 draws
+jax.config.update("jax_enable_x64", True)

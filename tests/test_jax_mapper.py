@@ -1,0 +1,181 @@
+"""Vmapped CRUSH vs the exact host interpreter (which is itself validated
+bit-for-bit against the reference C in test_crush_golden.py)."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+from ceph_tpu.crush import (CRUSH_BUCKET_STRAW2, CRUSH_ITEM_NONE,
+                            CRUSH_RULE_CHOOSELEAF_FIRSTN,
+                            CRUSH_RULE_CHOOSELEAF_INDEP,
+                            CRUSH_RULE_CHOOSE_FIRSTN, CRUSH_RULE_CHOOSE_INDEP,
+                            CRUSH_RULE_EMIT, CRUSH_RULE_TAKE, CrushMap,
+                            crush_do_rule)
+from ceph_tpu.crush.jax_mapper import BulkMapper
+
+GOLDEN = os.path.join(os.path.dirname(__file__), "golden", "crush_golden.json")
+with open(GOLDEN) as f:
+    G = json.load(f)
+
+NX = 48
+
+
+def _interp_padded(cmap, ruleno, x, result_max, weights, numrep):
+    got = crush_do_rule(cmap, ruleno, x, result_max, weights)
+    return got + [CRUSH_ITEM_NONE] * (numrep - len(got))
+
+
+def _compare(cmap, ruleno, result_max, weights=None):
+    bm = BulkMapper(cmap)
+    xs = np.arange(NX)
+    out, placed = bm.map_rule(ruleno, xs, reweights=weights,
+                              result_max=result_max)
+    numrep = out.shape[1]
+    for x in range(NX):
+        want = _interp_padded(cmap, ruleno, x, result_max,
+                              list(weights) if weights is not None else None,
+                              numrep)
+        assert list(out[x]) == want[:numrep], (
+            f"x={x}: jax={list(out[x])} interp={want}")
+
+
+def _golden_straw2_cases():
+    for g in G["groups"]:
+        cmap = CrushMap.from_dict(g["map"])
+        if any(b.alg != CRUSH_BUCKET_STRAW2 for b in cmap.buckets.values()):
+            continue
+        if cmap.tunables["choose_local_tries"]:
+            continue  # legacy tunables -> host interpreter only
+        for run in g["runs"]:
+            if len(cmap.rules[run["ruleno"]].steps) != 3:
+                continue  # multi-choose rules -> host interpreter only
+            yield g["map"], run
+
+
+CASES = list(_golden_straw2_cases())
+
+
+@pytest.mark.parametrize("case", CASES, ids=[r["name"] for _, r in CASES])
+def test_bulk_matches_reference_golden(case):
+    """JAX bulk mapper must equal the reference C output on golden runs."""
+    map_dict, run = case
+    cmap = CrushMap.from_dict(map_dict)
+    bm = BulkMapper(cmap)
+    nx = len(run["results"])
+    out, placed = bm.map_rule(run["ruleno"], np.arange(nx),
+                              reweights=run["weights"],
+                              result_max=run["result_max"])
+    numrep = out.shape[1]
+    for x in range(nx):
+        want = run["results"][x]
+        want = want + [CRUSH_ITEM_NONE] * (numrep - len(want))
+        assert list(out[x]) == want[:numrep], (
+            f"{run['name']} x={x}: jax={list(out[x])} want={want}")
+
+
+def _three_level_map(seed=0):
+    """racks -> hosts -> osds with uneven weights, some zero."""
+    rng = np.random.default_rng(seed)
+    cmap = CrushMap()
+    osd = 0
+    racks = []
+    for r in range(3):
+        hosts = []
+        for h in range(3):
+            n = int(rng.integers(2, 5))
+            items = list(range(osd, osd + n))
+            osd += n
+            w = [int(rng.integers(0, 5)) * 0x8000 for _ in items]
+            hosts.append(cmap.add_bucket(CRUSH_BUCKET_STRAW2, 1, items, w))
+        hw = [max(sum(cmap.buckets[h].item_weights), 0) for h in hosts]
+        racks.append(cmap.add_bucket(CRUSH_BUCKET_STRAW2, 2, hosts, hw))
+    rw = [sum(cmap.buckets[r].item_weights) for r in racks]
+    root = cmap.add_bucket(CRUSH_BUCKET_STRAW2, 3, racks, rw)
+    cmap.finalize()
+    return cmap, root
+
+
+@pytest.mark.parametrize("op,numrep,ttype", [
+    (CRUSH_RULE_CHOOSELEAF_FIRSTN, 3, 2),   # 3 replicas across racks
+    (CRUSH_RULE_CHOOSELEAF_INDEP, 4, 1),    # EC across hosts
+    (CRUSH_RULE_CHOOSE_FIRSTN, 2, 1),       # pick 2 host buckets
+    (CRUSH_RULE_CHOOSE_INDEP, 3, 0),        # devices directly
+    (CRUSH_RULE_CHOOSELEAF_FIRSTN, 2, 0),   # chooseleaf over osd domain
+])
+def test_bulk_matches_interpreter_three_level(op, numrep, ttype):
+    cmap, root = _three_level_map()
+    ruleno = cmap.add_rule([(CRUSH_RULE_TAKE, root, 0), (op, numrep, ttype),
+                            (CRUSH_RULE_EMIT, 0, 0)])
+    _compare(cmap, ruleno, result_max=numrep)
+
+
+def test_bulk_with_reweights():
+    cmap, root = _three_level_map(seed=3)
+    ruleno = cmap.add_rule([(CRUSH_RULE_TAKE, root, 0),
+                            (CRUSH_RULE_CHOOSELEAF_INDEP, 4, 1),
+                            (CRUSH_RULE_EMIT, 0, 0)])
+    n = cmap.max_devices
+    rng = np.random.default_rng(7)
+    weights = [int(w) for w in rng.choice(
+        [0, 0x4000, 0x8000, 0xC000, 0x10000], size=n)]
+    _compare(cmap, ruleno, result_max=4, weights=weights)
+
+
+def test_bulk_numrep_zero_uses_result_max():
+    cmap, root = _three_level_map(seed=5)
+    ruleno = cmap.add_rule([(CRUSH_RULE_TAKE, root, 0),
+                            (CRUSH_RULE_CHOOSELEAF_INDEP, 0, 1),
+                            (CRUSH_RULE_EMIT, 0, 0)])
+    _compare(cmap, ruleno, result_max=5)
+
+
+def test_compile_rejects_unsupported():
+    from ceph_tpu.crush import CRUSH_BUCKET_LIST
+    cmap = CrushMap()
+    cmap.add_bucket(CRUSH_BUCKET_LIST, 1, [0, 1], [0x10000, 0x10000])
+    cmap.finalize()
+    with pytest.raises(ValueError, match="straw2"):
+        BulkMapper(cmap)
+    cmap2, root = _three_level_map()
+    cmap2.tunables["choose_local_tries"] = 2
+    with pytest.raises(ValueError, match="local retry"):
+        BulkMapper(cmap2)
+
+
+def _ceph_id_order_map():
+    """Root gets id -1, children -2..: the Ceph-default id assignment
+    (regression: depth must not rely on id ordering)."""
+    cmap = CrushMap()
+    # reserve -1 for root by building top-down with explicit ids
+    cmap.add_bucket(CRUSH_BUCKET_STRAW2, 3, [-2, -3], [0x40000, 0x40000],
+                    id=-1)
+    cmap.add_bucket(CRUSH_BUCKET_STRAW2, 1, [0, 1], [0x20000, 0x20000], id=-2)
+    cmap.add_bucket(CRUSH_BUCKET_STRAW2, 1, [2, 3], [0x20000, 0x20000], id=-3)
+    cmap.finalize()
+    return cmap
+
+
+def test_bulk_root_id_minus_one():
+    cmap = _ceph_id_order_map()
+    ruleno = cmap.add_rule([(CRUSH_RULE_TAKE, -1, 0),
+                            (CRUSH_RULE_CHOOSELEAF_FIRSTN, 2, 1),
+                            (CRUSH_RULE_EMIT, 0, 0)])
+    out, placed = BulkMapper(cmap).map_rule(ruleno, np.arange(16),
+                                            result_max=2)
+    assert (out != CRUSH_ITEM_NONE).all(), "depth bug: all-NONE placements"
+    _compare(cmap, ruleno, result_max=2)
+
+
+def test_bulk_result_max_smaller_than_numrep():
+    """The retry stride must keep the rule's numrep even when result_max
+    clamps the output (regression for the out_size/numrep split)."""
+    cmap, root = _three_level_map(seed=11)
+    ruleno = cmap.add_rule([(CRUSH_RULE_TAKE, root, 0),
+                            (CRUSH_RULE_CHOOSE_INDEP, 5, 0),
+                            (CRUSH_RULE_EMIT, 0, 0)])
+    _compare(cmap, ruleno, result_max=3)
+    ruleno2 = cmap.add_rule([(CRUSH_RULE_TAKE, root, 0),
+                             (CRUSH_RULE_CHOOSELEAF_FIRSTN, 4, 1),
+                             (CRUSH_RULE_EMIT, 0, 0)])
+    _compare(cmap, ruleno2, result_max=2)

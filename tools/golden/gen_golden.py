@@ -1,0 +1,58 @@
+#!/usr/bin/env python3
+"""Regenerate tests/golden/crush_golden.json from the reference C core.
+
+Requires /root/reference to be mounted (dev environment only); the committed
+JSON is what CI/tests consume, so this only needs re-running when the golden
+scenario set in golden_gen.c changes.
+"""
+import json
+import os
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(os.path.dirname(HERE))
+REF = os.environ.get("CEPH_REFERENCE", "/root/reference")
+OUT = os.path.join(REPO, "tests", "golden", "crush_golden.json")
+
+
+def main() -> int:
+    if not os.path.isdir(os.path.join(REF, "src", "crush")):
+        print(f"reference not found at {REF}; cannot regenerate", file=sys.stderr)
+        return 1
+    with open(os.path.join(HERE, "acconfig.h"), "w") as f:
+        f.write("#define HAVE_LINUX_TYPES_H 1\n")
+    exe = os.path.join(HERE, "golden_gen")
+    subprocess.check_call([
+        "gcc", "-O1", "-I", HERE,
+        "-I", os.path.join(REF, "src", "crush"),
+        "-I", os.path.join(REF, "src"),
+        "-o", exe,
+        os.path.join(HERE, "golden_gen.c"),
+        os.path.join(HERE, "golden_mapper.c"),
+        "-lm",
+    ])
+    # full-domain crush_ln LUT (the straw2 draw domain) as packaged data
+    lut = subprocess.check_output([exe, "lntable"]).decode().split()
+    import numpy as np
+    arr = np.array([int(v) for v in lut], dtype=np.uint64)
+    assert arr.shape == (65536,)
+    data_dir = os.path.join(REPO, "ceph_tpu", "crush", "data")
+    os.makedirs(data_dir, exist_ok=True)
+    np.save(os.path.join(data_dir, "crush_ln16.npy"), arr)
+    print(f"wrote crush_ln16.npy ({arr.nbytes} bytes)")
+
+    raw = subprocess.check_output([exe]).decode()
+    data = json.loads(raw)  # validate before writing
+    os.makedirs(os.path.dirname(OUT), exist_ok=True)
+    with open(OUT, "w") as f:
+        json.dump(data, f, separators=(",", ":"))
+        f.write("\n")
+    ngroups = len(data["groups"])
+    nruns = sum(len(g["runs"]) for g in data["groups"])
+    print(f"wrote {OUT}: {ngroups} map groups, {nruns} runs")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

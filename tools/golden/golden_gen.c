@@ -1,0 +1,374 @@
+/* Golden-vector generator for the CRUSH reimplementation.
+ *
+ * Compiles the *reference* CRUSH C core (hash.c, crush.c, builder.c,
+ * mapper.c under /root/reference/src/crush) by #include-by-path — nothing is
+ * copied into this repository — builds a set of test maps through the
+ * public builder API, runs crush_do_rule() / crush_hash32*() / crush_ln()
+ * on them, and emits JSON golden vectors (including full map dumps) on
+ * stdout.  tests/golden/crush_golden.json is the committed output; tests
+ * compare the JAX/numpy reimplementation bit-for-bit against it
+ * (SURVEY.md §7: CRUSH requires exact uint32 overflow semantics).
+ *
+ * Build + regenerate: python tools/golden/gen_golden.py
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "hash.c"
+#include "crush.c"
+#include "builder.c"
+#include "mapper.h"
+extern unsigned long long golden_crush_ln(unsigned int x);
+
+static void emit_hash_golden(void) {
+    unsigned int xs[] = {0u, 1u, 2u, 12345u, 0x12345678u, 0xffffffffu,
+                         0xdeadbeefu, 4294967290u, 716740u, 42u};
+    int n = sizeof(xs) / sizeof(xs[0]);
+    printf("  \"hash\": {\n    \"inputs\": [");
+    for (int i = 0; i < n; i++) printf("%s%u", i ? "," : "", xs[i]);
+    printf("],\n    \"h1\": [");
+    for (int i = 0; i < n; i++)
+        printf("%s%u", i ? "," : "", crush_hash32(CRUSH_HASH_RJENKINS1, xs[i]));
+    printf("],\n    \"h2\": [");
+    for (int i = 0; i < n; i++)
+        printf("%s%u", i ? "," : "",
+               crush_hash32_2(CRUSH_HASH_RJENKINS1, xs[i], xs[(i + 1) % n]));
+    printf("],\n    \"h3\": [");
+    for (int i = 0; i < n; i++)
+        printf("%s%u", i ? "," : "",
+               crush_hash32_3(CRUSH_HASH_RJENKINS1, xs[i], xs[(i + 1) % n],
+                              xs[(i + 2) % n]));
+    printf("],\n    \"h4\": [");
+    for (int i = 0; i < n; i++)
+        printf("%s%u", i ? "," : "",
+               crush_hash32_4(CRUSH_HASH_RJENKINS1, xs[i], xs[(i + 1) % n],
+                              xs[(i + 2) % n], xs[(i + 3) % n]));
+    printf("],\n    \"h5\": [");
+    for (int i = 0; i < n; i++)
+        printf("%s%u", i ? "," : "",
+               crush_hash32_5(CRUSH_HASH_RJENKINS1, xs[i], xs[(i + 1) % n],
+                              xs[(i + 2) % n], xs[(i + 3) % n], xs[(i + 4) % n]));
+    printf("]\n  },\n");
+}
+
+static void emit_ln_golden(void) {
+    printf("  \"crush_ln\": {\"inputs\": [");
+    for (int i = 0; i <= 0xffff; i += 17)
+        printf("%s%d", i ? "," : "", i);
+    printf("],\n    \"values\": [");
+    int first = 1;
+    for (int i = 0; i <= 0xffff; i += 17) {
+        printf("%s%llu", first ? "" : ",", golden_crush_ln((unsigned int)i));
+        first = 0;
+    }
+    printf("]\n  },\n");
+}
+
+/* ---- map dump ---------------------------------------------------------- */
+
+static void emit_u32s(const char *key, const __u32 *v, int n) {
+    printf("\"%s\": [", key);
+    for (int i = 0; i < n; i++) printf("%s%u", i ? "," : "", v[i]);
+    printf("]");
+}
+
+static void emit_map(struct crush_map *map) {
+    printf("     \"map\": {\n      \"tunables\": {"
+           "\"choose_local_tries\": %u, \"choose_local_fallback_tries\": %u, "
+           "\"choose_total_tries\": %u, \"chooseleaf_descend_once\": %u, "
+           "\"chooseleaf_vary_r\": %u, \"chooseleaf_stable\": %u},\n",
+           map->choose_local_tries, map->choose_local_fallback_tries,
+           map->choose_total_tries, map->chooseleaf_descend_once,
+           map->chooseleaf_vary_r, map->chooseleaf_stable);
+    printf("      \"max_devices\": %d,\n      \"buckets\": [\n", map->max_devices);
+    int firstb = 1;
+    for (int b = 0; b < map->max_buckets; b++) {
+        struct crush_bucket *bu = map->buckets[b];
+        if (!bu) continue;
+        printf("%s       {\"id\": %d, \"alg\": %d, \"type\": %d, "
+               "\"weight\": %u, \"size\": %u, \"items\": [",
+               firstb ? "" : ",\n", bu->id, bu->alg, bu->type, bu->weight,
+               bu->size);
+        firstb = 0;
+        for (unsigned i = 0; i < bu->size; i++)
+            printf("%s%d", i ? "," : "", bu->items[i]);
+        printf("], ");
+        switch (bu->alg) {
+        case CRUSH_BUCKET_UNIFORM:
+            printf("\"item_weight\": %u",
+                   ((struct crush_bucket_uniform *)bu)->item_weight);
+            break;
+        case CRUSH_BUCKET_LIST: {
+            struct crush_bucket_list *l = (struct crush_bucket_list *)bu;
+            emit_u32s("item_weights", l->item_weights, bu->size);
+            printf(", ");
+            emit_u32s("sum_weights", l->sum_weights, bu->size);
+            break;
+        }
+        case CRUSH_BUCKET_TREE: {
+            struct crush_bucket_tree *t = (struct crush_bucket_tree *)bu;
+            printf("\"num_nodes\": %u, ", t->num_nodes);
+            emit_u32s("node_weights", t->node_weights, t->num_nodes);
+            break;
+        }
+        case CRUSH_BUCKET_STRAW: {
+            struct crush_bucket_straw *s = (struct crush_bucket_straw *)bu;
+            emit_u32s("item_weights", s->item_weights, bu->size);
+            printf(", ");
+            emit_u32s("straws", s->straws, bu->size);
+            break;
+        }
+        case CRUSH_BUCKET_STRAW2:
+            emit_u32s("item_weights",
+                      ((struct crush_bucket_straw2 *)bu)->item_weights,
+                      bu->size);
+            break;
+        }
+        printf("}");
+    }
+    printf("],\n      \"rules\": [\n");
+    int firstr = 1;
+    for (unsigned r = 0; r < map->max_rules; r++) {
+        struct crush_rule *ru = map->rules[r];
+        if (!ru) continue;
+        printf("%s       {\"ruleno\": %u, \"steps\": [", firstr ? "" : ",\n", r);
+        firstr = 0;
+        for (unsigned s = 0; s < ru->len; s++)
+            printf("%s[%u,%d,%d]", s ? "," : "", ru->steps[s].op,
+                   ru->steps[s].arg1, ru->steps[s].arg2);
+        printf("]}");
+    }
+    printf("]\n     },\n");
+}
+
+/* ---- runs -------------------------------------------------------------- */
+
+static int add_bucket(struct crush_map *map, int alg, int type,
+                      int size, int *items, int *weights) {
+    struct crush_bucket *b = crush_make_bucket(map, alg, CRUSH_HASH_RJENKINS1,
+                                               type, size, items, weights);
+    int id;
+    if (crush_add_bucket(map, 0, b, &id) < 0) exit(2);
+    return id;
+}
+
+static int first_run;
+
+static void run_rule(struct crush_map *map, int ruleno, int nx,
+                     const __u32 *weight, int weight_max, int result_max,
+                     const char *name) {
+    void *cw = malloc(map->working_size + 3 * result_max * sizeof(int));
+    int *result = malloc(sizeof(int) * result_max);
+    printf("%s      {\"name\": \"%s\", \"ruleno\": %d, \"result_max\": %d, ",
+           first_run ? "" : ",\n", name, ruleno, result_max);
+    first_run = 0;
+    emit_u32s("weights", weight, weight_max);
+    printf(",\n       \"results\": [");
+    for (int x = 0; x < nx; x++) {
+        crush_init_workspace(map, cw);
+        int len = crush_do_rule(map, ruleno, x, result, result_max,
+                                weight, weight_max, cw, NULL);
+        printf("%s[", x ? "," : "");
+        for (int i = 0; i < len; i++)
+            printf("%s%d", i ? "," : "", result[i]);
+        printf("]");
+    }
+    printf("]}");
+    free(result);
+    free(cw);
+}
+
+static int first_group = 1;
+
+static void begin_group(struct crush_map *map) {
+    crush_finalize(map);
+    printf("%s    {\n", first_group ? "" : ",\n");
+    first_group = 0;
+    emit_map(map);
+    printf("     \"runs\": [\n");
+    first_run = 1;
+}
+
+static void end_group(struct crush_map *map) {
+    printf("]\n    }");
+    crush_destroy(map);
+}
+
+#define NX 64
+
+int main(int argc, char **argv) {
+    if (argc > 1 && strcmp(argv[1], "lntable") == 0) {
+        /* full straw2-domain crush_ln LUT: u in [0, 0xffff] */
+        for (int i = 0; i <= 0xffff; i++)
+            printf("%llu\n", golden_crush_ln((unsigned int)i));
+        return 0;
+    }
+    printf("{\n");
+    emit_hash_golden();
+    emit_ln_golden();
+    printf("  \"groups\": [\n");
+
+    /* ---- flat root of 12 osds, straw2, uneven weights ---------------- */
+    {
+        struct crush_map *map = crush_create();
+        int items[12], weights[12];
+        for (int i = 0; i < 12; i++) {
+            items[i] = i;
+            weights[i] = 0x10000 * (1 + (i % 4));
+        }
+        int root = add_bucket(map, CRUSH_BUCKET_STRAW2, 1, 12, items, weights);
+        struct crush_rule *r = crush_make_rule(3, 0, 1, 1, 12);
+        crush_rule_set_step(r, 0, CRUSH_RULE_TAKE, root, 0);
+        crush_rule_set_step(r, 1, CRUSH_RULE_CHOOSE_FIRSTN, 3, 0);
+        crush_rule_set_step(r, 2, CRUSH_RULE_EMIT, 0, 0);
+        int ruleno = crush_add_rule(map, r, -1);
+        begin_group(map);
+        __u32 w[12];
+        for (int i = 0; i < 12; i++) w[i] = 0x10000;
+        run_rule(map, ruleno, NX, w, 12, 3, "flat_straw2_firstn");
+        w[3] = 0x8000; w[7] = 0; w[10] = 0x4000;
+        run_rule(map, ruleno, NX, w, 12, 3, "flat_straw2_firstn_reweight");
+        end_group(map);
+    }
+
+    /* ---- root -> 4 hosts x 4 osds: chooseleaf firstn/indep, choose ---- */
+    {
+        struct crush_map *map = crush_create();
+        int hosts[4];
+        for (int h = 0; h < 4; h++) {
+            int items[4], weights[4];
+            for (int i = 0; i < 4; i++) {
+                items[i] = h * 4 + i;
+                weights[i] = 0x10000 + 0x4000 * i;
+            }
+            hosts[h] = add_bucket(map, CRUSH_BUCKET_STRAW2, 1, 4, items, weights);
+        }
+        int hw[4];
+        for (int h = 0; h < 4; h++) hw[h] = 0x10000 * (h + 2);
+        int root = add_bucket(map, CRUSH_BUCKET_STRAW2, 2, 4, hosts, hw);
+
+        struct crush_rule *rep = crush_make_rule(3, 0, 1, 1, 10);
+        crush_rule_set_step(rep, 0, CRUSH_RULE_TAKE, root, 0);
+        crush_rule_set_step(rep, 1, CRUSH_RULE_CHOOSELEAF_FIRSTN, 0, 1);
+        crush_rule_set_step(rep, 2, CRUSH_RULE_EMIT, 0, 0);
+        int r_rep = crush_add_rule(map, rep, -1);
+
+        struct crush_rule *ec = crush_make_rule(3, 1, 3, 1, 10);
+        crush_rule_set_step(ec, 0, CRUSH_RULE_TAKE, root, 0);
+        crush_rule_set_step(ec, 1, CRUSH_RULE_CHOOSELEAF_INDEP, 0, 1);
+        crush_rule_set_step(ec, 2, CRUSH_RULE_EMIT, 0, 0);
+        int r_ec = crush_add_rule(map, ec, -1);
+
+        struct crush_rule *two = crush_make_rule(4, 2, 1, 1, 10);
+        crush_rule_set_step(two, 0, CRUSH_RULE_TAKE, root, 0);
+        crush_rule_set_step(two, 1, CRUSH_RULE_CHOOSE_FIRSTN, 2, 1);
+        crush_rule_set_step(two, 2, CRUSH_RULE_CHOOSE_FIRSTN, 2, 0);
+        crush_rule_set_step(two, 3, CRUSH_RULE_EMIT, 0, 0);
+        int r_two = crush_add_rule(map, two, -1);
+
+        begin_group(map);
+        __u32 w[16];
+        for (int i = 0; i < 16; i++) w[i] = 0x10000;
+        run_rule(map, r_rep, NX, w, 16, 3, "tree_chooseleaf_firstn");
+        run_rule(map, r_ec, NX, w, 16, 6, "tree_chooseleaf_indep");
+        run_rule(map, r_two, NX, w, 16, 4, "tree_choose_choose");
+        w[4] = w[5] = w[6] = w[7] = 0;
+        w[1] = 0x8000; w[13] = 0x2000;
+        run_rule(map, r_rep, NX, w, 16, 3, "tree_chooseleaf_firstn_degraded");
+        run_rule(map, r_ec, NX, w, 16, 6, "tree_chooseleaf_indep_degraded");
+        end_group(map);
+    }
+
+    /* ---- legacy vs optimal tunables ---------------------------------- */
+    for (int variant = 0; variant < 2; variant++) {
+        struct crush_map *map = crush_create();
+        if (variant == 0)
+            set_legacy_crush_map(map);
+        int hosts[3];
+        for (int h = 0; h < 3; h++) {
+            int items[3], weights[3];
+            for (int i = 0; i < 3; i++) {
+                items[i] = h * 3 + i;
+                weights[i] = 0x10000 * (i + 1);
+            }
+            hosts[h] = add_bucket(map, CRUSH_BUCKET_STRAW2, 1, 3,
+                                  items, weights);
+        }
+        int hw[3] = {0x30000, 0x60000, 0x90000};
+        int root = add_bucket(map, CRUSH_BUCKET_STRAW2, 2, 3, hosts, hw);
+        struct crush_rule *r = crush_make_rule(3, 0, 1, 1, 10);
+        crush_rule_set_step(r, 0, CRUSH_RULE_TAKE, root, 0);
+        crush_rule_set_step(r, 1, CRUSH_RULE_CHOOSELEAF_FIRSTN, 0, 1);
+        crush_rule_set_step(r, 2, CRUSH_RULE_EMIT, 0, 0);
+        int ruleno = crush_add_rule(map, r, -1);
+        begin_group(map);
+        __u32 w[9];
+        for (int i = 0; i < 9; i++) w[i] = 0x10000;
+        w[2] = 0x9999;
+        run_rule(map, ruleno, NX, w, 9, 3,
+                 variant == 0 ? "tunables_legacy" : "tunables_optimal");
+        end_group(map);
+    }
+
+    /* ---- other bucket algorithms ------------------------------------- */
+    {
+        int algs[4] = {CRUSH_BUCKET_UNIFORM, CRUSH_BUCKET_LIST,
+                       CRUSH_BUCKET_TREE, CRUSH_BUCKET_STRAW};
+        const char *names[4] = {"alg_uniform", "alg_list", "alg_tree",
+                                "alg_straw"};
+        for (int a = 0; a < 4; a++) {
+            struct crush_map *map = crush_create();
+            int items[8], weights[8];
+            for (int i = 0; i < 8; i++) {
+                items[i] = i;
+                weights[i] = (algs[a] == CRUSH_BUCKET_UNIFORM)
+                                 ? 0x10000
+                                 : 0x10000 + 0x2000 * i;
+            }
+            int root = add_bucket(map, algs[a], 1, 8, items, weights);
+            struct crush_rule *r = crush_make_rule(3, 0, 1, 1, 8);
+            crush_rule_set_step(r, 0, CRUSH_RULE_TAKE, root, 0);
+            crush_rule_set_step(r, 1, CRUSH_RULE_CHOOSE_FIRSTN, 3, 0);
+            crush_rule_set_step(r, 2, CRUSH_RULE_EMIT, 0, 0);
+            int ruleno = crush_add_rule(map, r, -1);
+            begin_group(map);
+            __u32 w[8];
+            for (int i = 0; i < 8; i++) w[i] = 0x10000;
+            run_rule(map, ruleno, NX, w, 8, 3, names[a]);
+            end_group(map);
+        }
+    }
+
+    /* ---- indep holes: numrep > healthy items ------------------------- */
+    {
+        struct crush_map *map = crush_create();
+        int hosts[3];
+        for (int h = 0; h < 3; h++) {
+            int items[2], weights[2];
+            for (int i = 0; i < 2; i++) {
+                items[i] = h * 2 + i;
+                weights[i] = 0x10000;
+            }
+            hosts[h] = add_bucket(map, CRUSH_BUCKET_STRAW2, 1, 2,
+                                  items, weights);
+        }
+        int hw[3] = {0x20000, 0x20000, 0x20000};
+        int root = add_bucket(map, CRUSH_BUCKET_STRAW2, 2, 3, hosts, hw);
+        struct crush_rule *r = crush_make_rule(3, 0, 3, 1, 10);
+        crush_rule_set_step(r, 0, CRUSH_RULE_TAKE, root, 0);
+        crush_rule_set_step(r, 1, CRUSH_RULE_CHOOSELEAF_INDEP, 0, 1);
+        crush_rule_set_step(r, 2, CRUSH_RULE_EMIT, 0, 0);
+        int ruleno = crush_add_rule(map, r, -1);
+        begin_group(map);
+        __u32 w[6];
+        for (int i = 0; i < 6; i++) w[i] = 0x10000;
+        run_rule(map, ruleno, NX, w, 6, 5, "indep_holes");
+        w[0] = w[1] = 0;
+        run_rule(map, ruleno, NX, w, 6, 5, "indep_holes_host_down");
+        end_group(map);
+    }
+
+    printf("\n  ]\n}\n");
+    return 0;
+}
