@@ -11,9 +11,10 @@ Scope (the production shape): maps whose buckets are all non-empty STRAW2
     take <root>; choose[leaf]_{firstn,indep} <n> <type>; emit
 with optimal-profile local-retry tunables (choose_local_tries=0,
 choose_local_fallback_tries=0) and either chooseleaf_stable=1 or
-chooseleaf_descend_once=1 (single-try leaf recursion).  Anything else falls
-back to the exact host interpreter (ceph_tpu.crush.mapper), which is also
-the oracle these kernels are tested against bit-for-bit.
+chooseleaf_descend_once=1 (single-try leaf recursion).  Anything outside
+this envelope is rejected with ValueError at compile/map time — run it
+through the exact host interpreter (ceph_tpu.crush.mapper) instead, which
+is also the oracle these kernels are tested against bit-for-bit.
 """
 from __future__ import annotations
 

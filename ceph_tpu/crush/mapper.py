@@ -226,9 +226,13 @@ def crush_choose_firstn(cmap: CrushMap, ws: Workspace, bucket: Bucket,
                     if item >= cmap.max_devices:
                         skip_rep = True
                         break
+                    if item < 0 and item not in cmap.buckets:
+                        # dangling bucket reference (mapper.c bad-id guard)
+                        skip_rep = True
+                        break
                     itemtype = cmap.buckets[item].type if item < 0 else 0
                     if itemtype != type:
-                        if item >= 0 or item not in cmap.buckets:
+                        if item >= 0:
                             skip_rep = True
                             break
                         in_b = cmap.buckets[item]
@@ -307,9 +311,16 @@ def crush_choose_indep(cmap: CrushMap, ws: Workspace, bucket: Bucket,
                         out2[rep] = CRUSH_ITEM_NONE
                     left -= 1
                     break
+                if item < 0 and item not in cmap.buckets:
+                    # dangling bucket reference (mapper.c bad-id guard)
+                    out[rep] = CRUSH_ITEM_NONE
+                    if out2 is not None:
+                        out2[rep] = CRUSH_ITEM_NONE
+                    left -= 1
+                    break
                 itemtype = cmap.buckets[item].type if item < 0 else 0
                 if itemtype != type:
-                    if item >= 0 or item not in cmap.buckets:
+                    if item >= 0:
                         out[rep] = CRUSH_ITEM_NONE
                         if out2 is not None:
                             out2[rep] = CRUSH_ITEM_NONE

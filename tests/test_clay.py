@@ -1,0 +1,175 @@
+"""clay plugin: geometry, roundtrips, sub-chunk repair bandwidth, parameter
+validation (mirrors src/test/erasure-code/TestErasureCodeClay.cc strategy)."""
+import itertools
+
+import numpy as np
+import pytest
+
+from ceph_tpu.plugins import ErasureCodePluginRegistry
+
+
+@pytest.fixture
+def registry():
+    return ErasureCodePluginRegistry()
+
+
+def _payload(n, seed=0):
+    return np.random.default_rng(seed).integers(0, 256, n, dtype=np.uint8).tobytes()
+
+
+def _make(registry, k, m, d=None, **extra):
+    profile = {"k": str(k), "m": str(m), "device": "numpy", **extra}
+    if d is not None:
+        profile["d"] = str(d)
+    return registry.factory("clay", "", profile)
+
+
+# -- geometry ---------------------------------------------------------------
+
+def test_geometry_defaults(registry):
+    ec = _make(registry, 4, 2)          # d defaults to k+m-1 = 5
+    assert ec.d == 5 and ec.q == 2 and ec.nu == 0 and ec.t == 3
+    assert ec.get_sub_chunk_count() == 8
+    assert ec.get_chunk_count() == 6
+    assert ec.get_data_chunk_count() == 4
+
+
+def test_geometry_with_nu(registry):
+    # k=3, m=2, d=4 -> q=2, k+m=5 odd -> nu=1, t=3, sub=8
+    ec = _make(registry, 3, 2, d=4)
+    assert ec.q == 2 and ec.nu == 1 and ec.t == 3
+    assert ec.get_sub_chunk_count() == 8
+
+
+def test_chunk_size_subchunk_aligned(registry):
+    ec = _make(registry, 4, 2)
+    cs = ec.get_chunk_size(1)
+    assert cs % ec.get_sub_chunk_count() == 0
+    cs2 = ec.get_chunk_size(100000)
+    assert cs2 * 4 >= 100000 and cs2 % ec.get_sub_chunk_count() == 0
+
+
+@pytest.mark.parametrize("profile", [
+    {"k": "4", "m": "2", "d": "3"},      # d < k
+    {"k": "4", "m": "2", "d": "6"},      # d > k+m-1
+    {"k": "4", "m": "2", "scalar_mds": "bogus"},
+    {"k": "4", "m": "2", "technique": "bogus"},
+    {"k": "4", "m": "2", "scalar_mds": "isa", "technique": "liber8tion"},
+])
+def test_invalid_profiles(registry, profile):
+    with pytest.raises(ValueError):
+        registry.factory("clay", "", {**profile, "device": "numpy"})
+
+
+# -- roundtrip --------------------------------------------------------------
+
+@pytest.mark.parametrize("k,m,d", [(4, 2, 5), (2, 2, 3), (3, 2, 4),
+                                   (4, 3, 6), (6, 3, 8)])
+def test_encode_decode_all_single_erasures(registry, k, m, d):
+    ec = _make(registry, k, m, d)
+    data = _payload(ec.get_chunk_size(1) * k, seed=k * 10 + m)
+    n = k + m
+    encoded = ec.encode(set(range(n)), data)
+    for lost in range(n):
+        available = {i: v for i, v in encoded.items() if i != lost}
+        decoded = ec.decode({lost}, available)
+        np.testing.assert_array_equal(decoded[lost], encoded[lost],
+                                      err_msg=f"lost={lost}")
+
+
+@pytest.mark.parametrize("k,m", [(4, 2), (4, 3)])
+def test_decode_all_m_erasures(registry, k, m):
+    ec = _make(registry, k, m)
+    data = _payload(ec.get_chunk_size(1) * k, seed=9)
+    n = k + m
+    encoded = ec.encode(set(range(n)), data)
+    for lost in itertools.combinations(range(n), m):
+        available = {i: v for i, v in encoded.items() if i not in lost}
+        decoded = ec.decode(set(lost), available)
+        for e in lost:
+            np.testing.assert_array_equal(decoded[e], encoded[e],
+                                          err_msg=f"lost={lost}")
+
+
+def test_decode_concat_roundtrip(registry):
+    ec = _make(registry, 4, 2)
+    data = _payload(3000, seed=4)
+    encoded = ec.encode(set(range(6)), data)
+    available = {i: encoded[i] for i in (1, 2, 3, 5)}
+    assert ec.decode_concat(available)[:len(data)] == data
+
+
+# -- repair path (the MSR feature) ------------------------------------------
+
+def test_minimum_to_repair_reads_fraction(registry):
+    ec = _make(registry, 4, 2)          # q=2: helpers send 1/2 chunk
+    lost = 1
+    available = set(range(6)) - {lost}
+    minimum = ec.minimum_to_decode({lost}, available)
+    assert len(minimum) == ec.d == 5
+    sub = ec.get_sub_chunk_count()
+    for node, runs in minimum.items():
+        read = sum(count for _, count in runs)
+        assert read == sub // ec.q, f"node {node} reads {read}"
+
+
+def test_minimum_to_decode_falls_back_to_full(registry):
+    ec = _make(registry, 4, 2)
+    # two losses -> not a repair; full chunks from k survivors
+    got = ec.minimum_to_decode({0, 1}, {2, 3, 4, 5})
+    sub = ec.get_sub_chunk_count()
+    assert all(runs == [(0, sub)] for runs in got.values())
+
+
+@pytest.mark.parametrize("k,m,d", [(4, 2, 5), (4, 3, 6), (3, 2, 4)])
+def test_repair_with_subchunk_reads(registry, k, m, d):
+    """Feed repair() only the sub-chunk runs minimum_to_decode asked for and
+    check the reconstruction is exact (the regenerating property)."""
+    ec = _make(registry, k, m, d)
+    chunk_size = ec.get_chunk_size(1) * 4
+    data = _payload(chunk_size * k, seed=13)
+    n = k + m
+    encoded = ec.encode(set(range(n)), data)
+    sub = ec.get_sub_chunk_count()
+    sc_size = chunk_size // sub
+    for lost in range(n):
+        available = set(range(n)) - {lost}
+        minimum = ec.minimum_to_decode({lost}, available)
+        assert len(minimum) == d
+        helper_chunks = {}
+        for node, runs in minimum.items():
+            full = encoded[node].reshape(sub, sc_size)
+            parts = [full[off:off + cnt] for off, cnt in runs]
+            helper_chunks[node] = np.concatenate(parts).reshape(-1)
+            assert helper_chunks[node].nbytes < chunk_size  # true saving
+        decoded = ec.decode({lost}, helper_chunks, chunk_size=chunk_size)
+        np.testing.assert_array_equal(decoded[lost], encoded[lost],
+                                      err_msg=f"lost={lost}")
+
+
+def test_repair_bandwidth_ratio(registry):
+    # d=k+m-1 MSR: repair bandwidth = d/q vs k full chunks for plain RS
+    ec = _make(registry, 4, 2)
+    minimum = ec.minimum_to_decode({0}, {1, 2, 3, 4, 5})
+    sub = ec.get_sub_chunk_count()
+    total_sub = sum(sum(c for _, c in runs) for runs in minimum.values())
+    rs_cost = 4 * sub               # k chunks, all sub-chunks
+    assert total_sub < rs_cost      # 5 * 4 = 20 < 32
+
+
+# -- scalar_mds variants ----------------------------------------------------
+
+@pytest.mark.parametrize("scalar_mds,technique", [
+    ("jerasure", "reed_sol_van"),
+    ("isa", "cauchy"),
+    ("jax_rs", "cauchy"),
+    ("shec", "single"),
+])
+def test_scalar_mds_choices(registry, scalar_mds, technique):
+    ec = _make(registry, 4, 2, scalar_mds=scalar_mds, technique=technique)
+    data = _payload(ec.get_chunk_size(1) * 4, seed=5)
+    encoded = ec.encode(set(range(6)), data)
+    available = {i: encoded[i] for i in (0, 2, 3, 4)}
+    decoded = ec.decode({1, 5}, available)
+    np.testing.assert_array_equal(decoded[1], encoded[1])
+    np.testing.assert_array_equal(decoded[5], encoded[5])

@@ -1,0 +1,180 @@
+"""lrc plugin: kml shorthand generation, layered repair, local-read
+minimums, validation (mirrors src/test/erasure-code/TestErasureCodeLrc.cc
+strategy)."""
+import json
+
+import numpy as np
+import pytest
+
+from ceph_tpu.plugins import ErasureCodePluginRegistry
+
+
+@pytest.fixture
+def registry():
+    return ErasureCodePluginRegistry()
+
+
+def _payload(n=4000, seed=0):
+    return np.random.default_rng(seed).integers(0, 256, n, dtype=np.uint8).tobytes()
+
+
+KML = {"k": "4", "m": "2", "l": "3", "device": "numpy"}
+
+LAYERS = {
+    "mapping": "__DD__DD",
+    "layers": json.dumps([
+        ["_cDD_cDD", {"plugin": "jax_rs", "device": "numpy"}],
+        ["c_DD____", {"plugin": "jax_rs", "device": "numpy"}],
+        ["____c_DD", {"plugin": "jax_rs", "device": "numpy"}],
+    ]),
+}
+
+
+# -- kml shorthand ----------------------------------------------------------
+
+def test_kml_generates_mapping_and_layers(registry):
+    ec = registry.factory("lrc", "", dict(KML))
+    # k+m=6, l=3 -> 2 groups, mapping DD__DD__ style with l+1 positions/group
+    assert ec.get_chunk_count() == 8        # (l+1) * groups
+    assert ec.get_data_chunk_count() == 4
+    assert len(ec.layers) == 3              # 1 global + 2 local
+    # generated params are not exposed (ErasureCodeLrc.cc:536-545)
+    assert "mapping" not in ec.get_profile()
+    assert "layers" not in ec.get_profile()
+
+
+@pytest.mark.parametrize("profile,match", [
+    ({"k": "4", "m": "2"}, "all of k, m, l"),
+    ({"k": "4", "m": "2", "l": "4"}, "multiple of l"),
+    ({"k": "4", "m": "2", "l": "3", "mapping": "x"}, "cannot be set"),
+    ({"k": "4", "m": "2", "l": "2"}, "k must be a multiple"),
+    ({"k": "4", "m": "4", "l": "0"}, "multiple of l"),
+])
+def test_kml_validation(registry, profile, match):
+    with pytest.raises(ValueError, match=match):
+        registry.factory("lrc", "", dict(profile))
+
+
+# -- explicit layers --------------------------------------------------------
+
+def test_layers_roundtrip(registry):
+    ec = registry.factory("lrc", "", dict(LAYERS))
+    assert ec.get_chunk_count() == 8
+    assert ec.get_data_chunk_count() == 4
+    data = _payload(5000)
+    want = set(range(8))
+    encoded = ec.encode(want, data)
+    assert set(encoded) == want
+    # no erasure: decode_concat returns the payload
+    assert ec.decode_concat(encoded)[:len(data)] == data
+
+
+def test_local_repair_single_failure(registry):
+    ec = registry.factory("lrc", "", dict(LAYERS))
+    data = _payload(3000, seed=1)
+    encoded = ec.encode(set(range(8)), data)
+    # single failure of a data chunk in the second local group
+    available = {i: v for i, v in encoded.items() if i != 6}
+    decoded = ec.decode({6}, available)
+    np.testing.assert_array_equal(decoded[6], encoded[6])
+    # minimum set should stay inside the local layer ____c_DD
+    got = ec.minimum_to_decode({6}, set(available))
+    assert set(got) <= {4, 7}
+
+
+def test_global_repair_two_failures(registry):
+    ec = registry.factory("lrc", "", dict(LAYERS))
+    data = _payload(3000, seed=2)
+    encoded = ec.encode(set(range(8)), data)
+    # two failures in one local group exceed the local layer (m=1) but the
+    # global layer (m=2... here 'c' x2 at 1 and 5) catches them
+    available = {i: v for i, v in encoded.items() if i not in (6, 7)}
+    decoded = ec.decode({6, 7}, available)
+    np.testing.assert_array_equal(decoded[6], encoded[6])
+    np.testing.assert_array_equal(decoded[7], encoded[7])
+
+
+def test_cascading_repair(registry):
+    # kml layout: local layers can free up the global layer step by step
+    ec = registry.factory("lrc", "", dict(KML))
+    data = _payload(4096, seed=3)
+    n = ec.get_chunk_count()
+    encoded = ec.encode(set(range(n)), data)
+    import itertools
+    # all single and double erasures that lrc can structurally repair
+    repaired = 0
+    for lost in itertools.chain(
+            ((i,) for i in range(n)),
+            itertools.combinations(range(n), 2)):
+        available = {i: v for i, v in encoded.items() if i not in lost}
+        try:
+            decoded = ec.decode(set(lost), available)
+        except IOError:
+            continue
+        for e in lost:
+            np.testing.assert_array_equal(decoded[e], encoded[e],
+                                          err_msg=f"lost={lost}")
+        repaired += 1
+    assert repaired >= n  # at least all single failures repair
+
+
+def test_minimum_to_decode_cases(registry):
+    ec = registry.factory("lrc", "", dict(LAYERS))
+    n = ec.get_chunk_count()
+    # case 1: all wanted available
+    got = ec.minimum_to_decode({2, 3}, set(range(n)))
+    assert set(got) == {2, 3}
+    # case impossible: too many failures everywhere
+    with pytest.raises(IOError):
+        ec.minimum_to_decode({2}, {0, 4})
+
+
+def test_layer_validation(registry):
+    # bad: layer map length mismatch
+    with pytest.raises(ValueError, match="characters long"):
+        registry.factory("lrc", "", {
+            "mapping": "DD__",
+            "layers": json.dumps([["DDc", ""]]),
+        })
+    # bad: layers not an array
+    with pytest.raises(ValueError):
+        registry.factory("lrc", "", {"mapping": "DD_",
+                                     "layers": json.dumps({"a": 1})})
+    # bad: missing layers entirely
+    with pytest.raises(ValueError, match="layers"):
+        registry.factory("lrc", "", {"mapping": "DD_"})
+
+
+def test_crush_rule_steps(registry):
+    ec = registry.factory("lrc", "", dict(KML))
+    assert ec.rule_steps == [("chooseleaf", "host", 0)]
+    ec2 = registry.factory("lrc", "", {**KML, "crush-locality": "rack"})
+    assert ec2.rule_steps[0] == ("choose", "rack", 2)
+    assert ec2.rule_steps[1] == ("chooseleaf", "host", 4)
+    # explicit crush-steps JSON
+    ec3 = registry.factory("lrc", "", {
+        **LAYERS,
+        "crush-steps": json.dumps([["choose", "rack", 2],
+                                   ["chooseleaf", "host", 4]])})
+    assert ec3.rule_steps == [("choose", "rack", 2), ("chooseleaf", "host", 4)]
+
+
+def test_create_rule_with_crush_map(registry):
+    from ceph_tpu.crush.map import CrushMap, CRUSH_BUCKET_STRAW2
+    cmap = CrushMap()
+    cmap.set_type_name(1, "host")
+    cmap.set_type_name(2, "root")
+    hosts = []
+    for h in range(4):
+        hid = cmap.add_bucket(CRUSH_BUCKET_STRAW2, 1, [h * 2, h * 2 + 1],
+                              weights=[0x10000, 0x10000])
+        hosts.append(hid)
+    root = cmap.add_bucket(CRUSH_BUCKET_STRAW2, 2, hosts,
+                           weights=[0x20000] * 4)
+    cmap.set_item_name(root, "default")
+    cmap.finalize()
+    ec = registry.factory("lrc", "", dict(KML))
+    ruleno = ec.create_rule("lrcrule", cmap)
+    assert cmap.rule_names["lrcrule"] == ruleno
+    steps = cmap.rules[ruleno].steps
+    assert steps[0][1] == root

@@ -1,0 +1,150 @@
+"""shec plugin: matrix shape, roundtrips with erasures, minimum_to_decode
+locality, parameter validation (mirrors src/test/erasure-code/
+TestErasureCodeShec*.cc strategy)."""
+import numpy as np
+import pytest
+
+from ceph_tpu.plugins import ErasureCodePluginRegistry
+from ceph_tpu.plugins.plugin_shec import (MULTIPLE, SINGLE,
+                                          shec_coding_matrix)
+
+
+@pytest.fixture
+def registry():
+    return ErasureCodePluginRegistry()
+
+
+def _payload(n=4000, seed=0):
+    return np.random.default_rng(seed).integers(0, 256, n, dtype=np.uint8).tobytes()
+
+
+# -- coding matrix ----------------------------------------------------------
+
+def test_matrix_shape_and_shingles():
+    mat = shec_coding_matrix(4, 3, 2, MULTIPLE)
+    assert mat.shape == (3, 4)
+    # shingled: at least one zero (each parity covers a window, not all of k)
+    assert (mat == 0).any()
+    # every parity row covers something
+    assert (mat != 0).any(axis=1).all()
+    # every data chunk is covered by at least one parity
+    assert (mat != 0).any(axis=0).all()
+
+
+def test_matrix_single_vs_multiple_differ():
+    a = shec_coding_matrix(6, 4, 2, MULTIPLE)
+    b = shec_coding_matrix(6, 4, 2, SINGLE)
+    assert a.shape == b.shape == (4, 6)
+    assert not np.array_equal(a, b)
+
+
+def test_c_equals_m_is_full_rs():
+    # c == m means no shingling: full Vandermonde coverage
+    mat = shec_coding_matrix(4, 2, 2)
+    assert (mat != 0).all()
+
+
+# -- roundtrip --------------------------------------------------------------
+
+@pytest.mark.parametrize("k,m,c", [(4, 3, 2), (6, 3, 2), (8, 4, 3), (4, 2, 2)])
+def test_roundtrip_single_erasures(registry, k, m, c):
+    ec = registry.factory("shec", "", {"k": str(k), "m": str(m), "c": str(c),
+                                       "device": "numpy"})
+    data = _payload(5000, seed=k * 100 + m)
+    want = set(range(k + m))
+    encoded = ec.encode(want, data)
+    for lost in range(k + m):
+        available = {i: v for i, v in encoded.items() if i != lost}
+        decoded = ec.decode({lost}, available)
+        np.testing.assert_array_equal(decoded[lost], encoded[lost],
+                                      err_msg=f"lost={lost}")
+    assert ec.decode_concat({i: encoded[i] for i in range(k + m) if i != 1}
+                            )[:len(data)] == data
+
+
+def test_roundtrip_c_erasures(registry):
+    # any c=2 failures must be recoverable (the durability guarantee)
+    ec = registry.factory("shec", "", {"k": "4", "m": "3", "c": "2",
+                                       "device": "numpy"})
+    data = _payload(3000, seed=7)
+    encoded = ec.encode(set(range(7)), data)
+    import itertools
+    for lost in itertools.combinations(range(7), 2):
+        available = {i: v for i, v in encoded.items() if i not in lost}
+        decoded = ec.decode(set(lost), available)
+        for e in lost:
+            np.testing.assert_array_equal(decoded[e], encoded[e],
+                                          err_msg=f"lost={lost}")
+
+
+# -- minimum_to_decode (locality) -------------------------------------------
+
+def test_minimum_to_decode_local_repair(registry):
+    ec = registry.factory("shec", "", {"k": "4", "m": "3", "c": "2",
+                                       "device": "numpy"})
+    n = 7
+    # single data failure should read fewer than k+1 chunks on average
+    sizes = []
+    for lost in range(4):
+        available = set(range(n)) - {lost}
+        got = ec.minimum_to_decode({lost}, available)
+        assert lost not in got
+        sizes.append(len(got))
+    # shec's point: average repair cost below plain RS (which always reads k)
+    assert sum(sizes) / len(sizes) <= 4
+
+    # want available chunks only: no repair needed
+    got = ec.minimum_to_decode({0, 1}, set(range(n)))
+    assert set(got) <= {0, 1}
+
+
+def test_minimum_to_decode_impossible(registry):
+    ec = registry.factory("shec", "", {"k": "4", "m": "3", "c": "2",
+                                       "device": "numpy"})
+    # losing 4 chunks (> m) cannot be repaired
+    with pytest.raises(IOError):
+        ec.minimum_to_decode({0, 1, 2, 3}, {4, 5, 6})
+
+
+def test_minimum_to_decode_range_check(registry):
+    ec = registry.factory("shec", "", {"device": "numpy"})
+    with pytest.raises(ValueError):
+        ec.minimum_to_decode({99}, {0, 1})
+
+
+# -- parameter validation (ErasureCodeShec.cc:276-344) ----------------------
+
+@pytest.mark.parametrize("profile", [
+    {"k": "0", "m": "3", "c": "2"},
+    {"k": "4", "m": "0", "c": "2"},
+    {"k": "4", "m": "3", "c": "0"},
+    {"k": "4", "m": "2", "c": "3"},      # c > m
+    {"k": "13", "m": "3", "c": "2"},     # k > 12
+    {"k": "12", "m": "9", "c": "2"},     # k+m > 20
+    {"k": "3", "m": "4", "c": "2"},      # m > k
+    {"k": "4", "m": "3"},                # partial k/m/c
+    {"k": "4", "m": "3", "c": "2", "w": "16"},
+    {"k": "4", "m": "3", "c": "2", "technique": "bogus"},
+])
+def test_invalid_profiles(registry, profile):
+    with pytest.raises(ValueError):
+        registry.factory("shec", "", dict(profile))
+
+
+def test_defaults(registry):
+    ec = registry.factory("shec", "", {"device": "numpy"})
+    assert ec.k == 4 and ec.m == 3 and ec.c == 2
+    assert ec.get_chunk_count() == 7
+    assert ec.get_profile()["technique"] == "multiple"
+
+
+def test_single_technique_roundtrip(registry):
+    ec = registry.factory("shec", "", {"k": "4", "m": "3", "c": "2",
+                                       "technique": "single",
+                                       "device": "numpy"})
+    data = _payload(2000, seed=3)
+    encoded = ec.encode(set(range(7)), data)
+    available = {i: v for i, v in encoded.items() if i not in (0, 4)}
+    decoded = ec.decode({0, 4}, available)
+    np.testing.assert_array_equal(decoded[0], encoded[0])
+    np.testing.assert_array_equal(decoded[4], encoded[4])
