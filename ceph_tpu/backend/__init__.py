@@ -1,0 +1,24 @@
+"""EC backend: stripe algebra, object store, write pipeline, recovery.
+
+The TPU-native analog of the reference's OSD erasure-coded I/O path
+(reference: src/osd/ECUtil.*, ECTransaction.*, ECBackend.*, ECMsgTypes.*,
+ExtentCache.*, src/os/memstore/ — SURVEY.md §2.2), restructured so every
+encode/decode is one batched device call across all stripes of an op.
+"""
+from .ecutil import HINFO_KEY, HashInfo, StripeInfo, crc32c, decode, decode_shards, encode
+from .extent import ExtentSet
+from .extent_cache import ExtentCache
+from .ec_backend import ECBackend, OSDShard, RecoveryState, make_cluster
+from .memstore import GObject, MemStore, Transaction
+from .messages import (ECSubRead, ECSubReadReply, ECSubWrite, ECSubWriteReply,
+                       MessageBus, PushOp, PushReply)
+from .transaction import ObjectOperation, PGTransaction, WritePlan, get_write_plan
+
+__all__ = [
+    "HINFO_KEY", "HashInfo", "StripeInfo", "crc32c", "decode", "decode_shards",
+    "encode", "ExtentSet", "ExtentCache", "ECBackend", "OSDShard",
+    "RecoveryState", "make_cluster", "GObject", "MemStore", "Transaction",
+    "ECSubRead", "ECSubReadReply", "ECSubWrite", "ECSubWriteReply",
+    "MessageBus", "PushOp", "PushReply", "ObjectOperation", "PGTransaction",
+    "WritePlan", "get_write_plan",
+]

@@ -1,0 +1,689 @@
+"""The erasure-coded backend: write pipeline, reconstructing reads, recovery.
+
+Analog of the reference's ``ECBackend`` (reference: src/osd/ECBackend.{h,cc};
+design note ECBackend.h:520-564) restructured TPU-first:
+
+- Same three-stage ordered write pipeline — ``waiting_state ->
+  waiting_reads -> waiting_commit`` driven by ``try_state_to_reads /
+  try_reads_to_commit / try_finish_rmw`` from ``check_ops``
+  (ECBackend.cc:1856,1930,2089,2137).
+- Same sub-op fan-out over a messenger (here the deterministic
+  :class:`~ceph_tpu.backend.messages.MessageBus`), one shard-local
+  transaction per acting shard (ECBackend.cc:2036-2070), self-delivery for
+  the primary's own shard (:2059-2061).
+- BUT encode/decode are **batched across all stripes of an op** into one
+  device call via :mod:`ceph_tpu.backend.ecutil` instead of the reference's
+  per-stripe loop — the restructuring SURVEY.md §2.2 calls the main TPU hook.
+
+Shards are ``OSDShard`` objects (MemStore + handler).  Failure is modelled by
+``bus.mark_down``: a dead shard drops requests, the primary routes around it
+using ``minimum_to_decode`` exactly like degraded reads do in the reference
+(ECBackend.cc:1588-1625), and ``recover_object`` runs the
+IDLE->READING->WRITING->COMPLETE machine (ECBackend.h:249-293).
+"""
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+from enum import Enum
+
+import numpy as np
+
+from .ecutil import HINFO_KEY, HashInfo, StripeInfo, crc32c, decode_shards
+from . import ecutil
+from .extent import ExtentSet
+from .extent_cache import ExtentCache
+from .memstore import GObject, MemStore, Transaction
+from .messages import (ECSubRead, ECSubReadReply, ECSubWrite, ECSubWriteReply,
+                       MessageBus, PushOp, PushReply)
+from .transaction import PGTransaction, WritePlan, get_write_plan
+
+
+class OSDShard:
+    """One shard OSD: a MemStore plus the server side of the EC sub-ops
+    (handle_sub_write ECBackend.cc:910-983, handle_sub_read :985-1031,
+    recovery push :511-563)."""
+
+    def __init__(self, shard: int, bus: MessageBus):
+        self.shard = shard
+        self.store = MemStore()
+        self.bus = bus
+        bus.register(shard, self)
+
+    def handle_message(self, msg) -> None:
+        if isinstance(msg, ECSubWrite):
+            self.store.queue_transaction(msg.t)
+            self.bus.send(msg.from_shard,
+                          ECSubWriteReply(self.shard, msg.tid))
+        elif isinstance(msg, ECSubRead):
+            reply = ECSubReadReply(self.shard, msg.tid)
+            for oid, extents in msg.to_read.items():
+                obj = GObject(oid, self.shard)
+                try:
+                    bufs = []
+                    for ext in extents:
+                        off, length = ext[0], ext[1]
+                        subchunks = ext[2] if len(ext) > 2 else None
+                        data = self.store.read(obj, off, length)
+                        if len(data) < length:
+                            data = data + b"\0" * (length - len(data))
+                        if subchunks is not None:
+                            data = _slice_subchunks(data, subchunks,
+                                                    msg.sub_chunk_count)
+                        bufs.append((off, data))
+                    reply.buffers_read[oid] = bufs
+                    if msg.attrs_to_read:
+                        reply.attrs_read[oid] = {
+                            a: self.store.getattr(obj, a)
+                            for a in msg.attrs_to_read
+                            if a in self.store.objects[obj].xattrs}
+                except FileNotFoundError:
+                    reply.errors[oid] = -2  # ENOENT
+            self.bus.send(msg.from_shard, reply)
+        elif isinstance(msg, PushOp):
+            t = Transaction()
+            obj = GObject(msg.oid, self.shard)
+            t.remove(obj).write(obj, 0, msg.data)
+            for name, value in msg.attrs.items():
+                t.setattr(obj, name, value)
+            self.store.queue_transaction(t)
+            self.bus.send(msg.from_shard, PushReply(self.shard, msg.oid))
+        else:
+            raise TypeError(f"shard {self.shard}: unexpected {msg!r}")
+
+
+def _slice_subchunks(data: bytes, runs: list[tuple[int, int]],
+                     sub_chunk_count: int) -> bytes:
+    """Extract (offset, count) sub-chunk runs out of ``sub_chunk_count``
+    equal sub-chunks (clay fractional reads, ECBackend.cc:1002-1024)."""
+    sub_size = len(data) // max(sub_chunk_count, 1)
+    return b"".join(data[off * sub_size:(off + c) * sub_size]
+                    for off, c in runs)
+
+
+class RecoveryState(Enum):
+    IDLE = "IDLE"
+    READING = "READING"
+    WRITING = "WRITING"
+    COMPLETE = "COMPLETE"
+
+
+@dataclass
+class RecoveryOp:
+    """ECBackend::RecoveryOp (ECBackend.h:249-293)."""
+    oid: str
+    missing_shards: set[int]
+    state: RecoveryState = RecoveryState.IDLE
+    read_tid: int | None = None
+    pending_pushes: set[int] = field(default_factory=set)
+    on_complete: object = None
+
+
+@dataclass
+class Op:
+    """In-flight client write (ECBackend::Op, ECBackend.h:390-440)."""
+    tid: int
+    plan: WritePlan
+    on_commit: object
+    pending_read_shards: set[int] = field(default_factory=set)
+    remote_reads: dict[str, dict[int, bytes]] = field(default_factory=dict)  # oid -> {logical off: stripe data}
+    pending_commit_shards: set[int] = field(default_factory=set)
+    cache_claims: list[tuple[str, int]] = field(default_factory=list)
+
+
+@dataclass
+class ReadOp:
+    """In-flight client read (ECBackend::ReadOp, ECBackend.h:155-190)."""
+    tid: int
+    to_read: dict[str, list[tuple[int, int]]]     # oid -> [(logical off, len)]
+    on_complete: object
+    shard_extents: dict[str, tuple[int, int]] = field(default_factory=dict)  # oid -> (chunk off, len)
+    want_shards: dict[str, set[int]] = field(default_factory=dict)
+    # shard -> outstanding reply count (retries can address a shard twice)
+    pending_shards: dict[int, int] = field(default_factory=dict)
+    results: dict[str, dict[int, bytes]] = field(default_factory=dict)  # oid -> {shard: chunk bytes}
+    errors: dict[str, set[int]] = field(default_factory=dict)
+    tried_shards: dict[str, set[int]] = field(default_factory=dict)
+    for_recovery: bool = False
+
+
+class ECBackend:
+    """Primary-side EC backend over a set of shard OSDs on a message bus."""
+
+    def __init__(self, ec_impl, sinfo: StripeInfo, bus: MessageBus,
+                 acting: list[int], whoami: int = 0):
+        n = ec_impl.get_chunk_count()
+        assert len(acting) == n, f"acting set must have {n} shards"
+        self.ec_impl = ec_impl
+        self.sinfo = sinfo
+        self.bus = bus
+        self.acting = list(acting)
+        self.whoami = whoami
+        self.local_shard = OSDShard(whoami, bus)
+        bus.handlers[whoami] = self  # primary intercepts its own queue
+        self.next_tid = 0
+        # write pipeline (ECBackend.h:562-564)
+        self.waiting_state: deque[Op] = deque()
+        self.waiting_reads: deque[Op] = deque()
+        self.waiting_commit: deque[Op] = deque()
+        self.tid_to_op: dict[int, Op] = {}
+        self.extent_cache = ExtentCache()
+        # read path
+        self.in_progress_reads: dict[int, ReadOp] = {}
+        # recovery
+        self.recovery_ops: dict[str, RecoveryOp] = {}
+        self._recovery_read_tids: dict[int, RecoveryOp] = {}
+        self.hinfo_cache: dict[str, HashInfo] = {}
+        self.completed_writes: list[int] = []
+
+    # -- helpers -----------------------------------------------------------
+
+    def up_shards(self) -> set[int]:
+        return {s for s in self.acting if s not in self.bus.down}
+
+    def _hinfo(self, oid: str) -> HashInfo:
+        if oid not in self.hinfo_cache:
+            n = self.ec_impl.get_chunk_count()
+            try:
+                stored = self.local_shard.store.getattr(
+                    GObject(oid, self.whoami), HINFO_KEY)
+                h = HashInfo(n)
+                h.total_chunk_size = stored["total_chunk_size"]
+                h.cumulative_shard_hashes = list(stored["cumulative_shard_hashes"])
+                h.projected_total_chunk_size = h.total_chunk_size
+            except (FileNotFoundError, KeyError):
+                h = HashInfo(n)
+            self.hinfo_cache[oid] = h
+        return self.hinfo_cache[oid]
+
+    def object_size(self, oid: str) -> int:
+        return self._hinfo(oid).get_total_logical_size(self.sinfo)
+
+    # -- message dispatch --------------------------------------------------
+
+    def handle_message(self, msg) -> None:
+        if isinstance(msg, ECSubWriteReply):
+            self.handle_sub_write_reply(msg)
+        elif isinstance(msg, ECSubReadReply):
+            self.handle_sub_read_reply(msg)
+        elif isinstance(msg, PushReply):
+            self.handle_push_reply(msg)
+        else:
+            self.local_shard.handle_message(msg)
+
+    # -- write pipeline ----------------------------------------------------
+
+    def submit_transaction(self, t: PGTransaction, on_commit=None) -> int:
+        """Client entry point (ECBackend.cc:1477 -> start_rmw :1830)."""
+        self.next_tid += 1
+        tid = self.next_tid
+        plan = get_write_plan(self.sinfo, t, self._hinfo)
+        op = Op(tid=tid, plan=plan, on_commit=on_commit)
+        self.tid_to_op[tid] = op
+        self.waiting_state.append(op)
+        self.check_ops()
+        return tid
+
+    def check_ops(self) -> None:
+        """Advance each pipeline stage's head as far as possible
+        (ECBackend.cc:2137-2145).  Re-loops because an op reaching the
+        commit stage pins its result in the extent cache, which can unblock
+        a stalled overlapping op behind it."""
+        progress = True
+        while progress:
+            progress = False
+            if self.waiting_state and self.try_state_to_reads():
+                progress = True
+            if self.waiting_reads and self.try_reads_to_commit():
+                progress = True
+
+    def _blocked_on_inflight_write(self, op: Op) -> bool:
+        """An RMW read overlapping an earlier in-flight write must wait until
+        that write's bytes are pinned in the cache — the ordering invariant
+        the reference's ExtentCache reservation enforces
+        (doc/dev/osd_internals/erasure_coding/ecbackend.rst:190-206)."""
+        for oid, to_read in op.plan.to_read.items():
+            for off, length in to_read:
+                if self.extent_cache.read(oid, off, length) is not None:
+                    continue
+                for other in self.waiting_reads:
+                    ww = other.plan.will_write.get(oid)
+                    if ww is not None and ww.intersects(off, length):
+                        return True
+        return False
+
+    def try_state_to_reads(self) -> bool:
+        """(ECBackend.cc:1856-1928): satisfy RMW reads from the extent cache
+        where pinned; issue remote shard reads for the rest."""
+        op = self.waiting_state[0]
+        if self._blocked_on_inflight_write(op):
+            return False
+        need_remote: dict[str, ExtentSet] = {}
+        for oid, to_read in op.plan.to_read.items():
+            for off, length in to_read:
+                cached = self.extent_cache.read(oid, off, length)
+                if cached is not None:
+                    op.remote_reads.setdefault(oid, {})[off] = cached
+                else:
+                    need_remote.setdefault(oid, ExtentSet()).union_insert(off, length)
+        self.waiting_state.popleft()
+        self.waiting_reads.append(op)
+        if need_remote:
+            self._start_rmw_reads(op, need_remote)
+        return True
+
+    def _start_rmw_reads(self, op: Op, need: dict[str, ExtentSet]) -> None:
+        """Read the full stripes from k data shards (reads are stripe-aligned
+        whole stripes, so the k data chunks suffice when healthy; degraded
+        objects fall back to the reconstructing read path)."""
+        k = self.ec_impl.get_data_chunk_count()
+        up = self.up_shards()
+        want = {self.ec_impl.chunk_index(i) for i in range(k)}
+        avail = {i for i, s in enumerate(self.acting) if s in up}
+        minimum = self.ec_impl.minimum_to_decode(want, avail)
+        per_shard: dict[int, dict[str, list[tuple]]] = {}
+        for oid, es in need.items():
+            for off, length in es:
+                c_off = self.sinfo.aligned_logical_offset_to_chunk_offset(off)
+                c_len = self.sinfo.aligned_logical_offset_to_chunk_offset(length)
+                for chunk in minimum:
+                    shard = self.acting[chunk]
+                    per_shard.setdefault(shard, {}).setdefault(oid, []).append(
+                        (c_off, c_len))
+        op._rmw_chunks = {c: self.acting[c] for c in minimum}
+        op._rmw_need = need
+        op._rmw_buf: dict[str, dict[int, dict[int, bytes]]] = {}
+        for shard, to_read in per_shard.items():
+            op.pending_read_shards.add(shard)
+            self.bus.send(shard, ECSubRead(self.whoami, op.tid, to_read))
+
+    def try_reads_to_commit(self) -> bool:
+        """(ECBackend.cc:1930-2087): encode the will-write extents in one
+        batched device call and fan out per-shard transactions."""
+        op = self.waiting_reads[0]
+        if op.pending_read_shards:
+            return False
+        self.waiting_reads.popleft()
+        self.waiting_commit.append(op)
+
+        n = self.ec_impl.get_chunk_count()
+        shard_txns = {shard: Transaction() for shard in self.acting}
+        for oid, will_write in op.plan.will_write.items():
+            objop = op.plan.t.ops[oid]
+            hinfo = op.plan.hash_infos[oid]
+            if objop.delete_first:
+                for chunk, shard in enumerate(self.acting):
+                    shard_txns[shard].remove(GObject(oid, shard))
+                hinfo.clear()
+            if not will_write:
+                if not objop.delete_first:
+                    self._persist_hinfo(oid, hinfo, shard_txns)
+                continue
+            # assemble the logical bytes for every will_write extent
+            pieces: list[tuple[int, bytes]] = []
+            for off, length in will_write:
+                pieces.append((off, self._assemble_extent(op, oid, objop, off, length)))
+            # ONE batched encode over all extents' stripes
+            logical = np.concatenate(
+                [np.frombuffer(b, dtype=np.uint8) for _, b in pieces])
+            encoded = ecutil.encode(self.sinfo, self.ec_impl, logical)
+            # scatter per-extent chunk ranges into shard transactions
+            c_cursor = 0
+            old_size = hinfo.total_chunk_size
+            append_chunks: dict[int, np.ndarray] = {}
+            appended = 0
+            pure_append = True
+            for off, data in pieces:
+                c_off = self.sinfo.aligned_logical_offset_to_chunk_offset(off)
+                c_len = self.sinfo.aligned_logical_offset_to_chunk_offset(len(data))
+                for chunk in range(n):
+                    shard = self.acting[chunk]
+                    payload = encoded[chunk][c_cursor:c_cursor + c_len]
+                    shard_txns[shard].write(
+                        GObject(oid, shard), c_off, payload.tobytes())
+                if pure_append and c_off == old_size + appended:
+                    for chunk in range(n):
+                        prev = append_chunks.get(chunk)
+                        seg = encoded[chunk][c_cursor:c_cursor + c_len]
+                        append_chunks[chunk] = seg if prev is None else \
+                            np.concatenate([prev, seg])
+                    appended += c_len
+                else:
+                    pure_append = False
+                c_cursor += c_len
+                self.extent_cache.claim(oid, op.tid, off, data)
+                op.cache_claims.append((oid, op.tid))
+            # hash maintenance: pure appends chain the crc (HashInfo::append,
+            # ECUtil.cc:161-177); overwrites invalidate it and deep scrub
+            # recomputes from data
+            if pure_append and appended:
+                hinfo.append(old_size, append_chunks)
+            elif not pure_append:
+                hinfo.set_total_chunk_size_clear_hash(
+                    hinfo.projected_total_chunk_size)
+            self._persist_hinfo(oid, hinfo, shard_txns)
+
+        # fan out ECSubWrite to every up shard (down shards miss the write
+        # and are repaired later by recovery — the reference's peering would
+        # instead shrink the acting set)
+        up = self.up_shards()
+        op.pending_commit_shards = set(up)
+        for shard in self.acting:
+            if shard in up:
+                self.bus.send(shard,
+                              ECSubWrite(self.whoami, op.tid, shard_txns[shard]))
+        return True
+
+    def _assemble_extent(self, op: Op, oid: str, objop, off: int,
+                         length: int) -> bytes:
+        """Merge read-in stripes, cached stripes, and the op's new writes
+        into the stripe-aligned extent [off, off+length)."""
+        buf = bytearray(length)
+        reads = op.remote_reads.get(oid, {})
+        for r_off, data in reads.items():
+            if r_off >= off + length or r_off + len(data) <= off:
+                continue
+            s = max(r_off, off)
+            e = min(r_off + len(data), off + length)
+            buf[s - off:e - off] = data[s - r_off:e - r_off]
+        if objop.truncate is not None:
+            t0 = objop.truncate[0]
+            if off <= t0 < off + length:
+                buf[t0 - off:] = b"\0" * (off + length - t0)
+        for w_off, data in objop.buffer_updates:
+            if w_off >= off + length or w_off + len(data) <= off:
+                continue
+            s = max(w_off, off)
+            e = min(w_off + len(data), off + length)
+            buf[s - off:e - off] = data[s - w_off:e - w_off]
+        return bytes(buf)
+
+    def _persist_hinfo(self, oid: str, hinfo: HashInfo, shard_txns) -> None:
+        for shard in self.acting:
+            shard_txns[shard].setattr(GObject(oid, shard), HINFO_KEY,
+                                      hinfo.to_dict())
+
+    def handle_sub_write_reply(self, reply: ECSubWriteReply) -> None:
+        """(ECBackend.cc:1120-1152) -> try_finish_rmw (:2089)."""
+        op = self.tid_to_op.get(reply.tid)
+        if op is None:
+            return
+        op.pending_commit_shards.discard(reply.from_shard)
+        self.try_finish_rmw()
+
+    def try_finish_rmw(self) -> None:
+        while self.waiting_commit:
+            op = self.waiting_commit[0]
+            # shards that died after dispatch can never ack
+            op.pending_commit_shards &= self.up_shards()
+            if op.pending_commit_shards:
+                return
+            self.waiting_commit.popleft()
+            for oid, tid in op.cache_claims:
+                self.extent_cache.release(oid, tid)
+            del self.tid_to_op[op.tid]
+            self.completed_writes.append(op.tid)
+            if op.on_commit:
+                op.on_commit(op.tid)
+
+    # -- read path ---------------------------------------------------------
+
+    def objects_read_and_reconstruct(self, reads: dict[str, list[tuple[int, int]]],
+                                     on_complete, fast_read: bool = False) -> int:
+        """(ECBackend.cc:2331-2385): choose min shards per object, read
+        chunk extents, reconstruct if any data shard is unavailable."""
+        self.next_tid += 1
+        tid = self.next_tid
+        rop = ReadOp(tid=tid, to_read=reads, on_complete=on_complete)
+        k = self.ec_impl.get_data_chunk_count()
+        up = self.up_shards()
+        avail = {i for i, s in enumerate(self.acting) if s in up}
+        want = {self.ec_impl.chunk_index(i) for i in range(k)}
+        per_shard: dict[int, dict[str, list[tuple]]] = {}
+        for oid, extents in reads.items():
+            lo = min(off for off, _ in extents)
+            hi = max(off + ln for off, ln in extents)
+            start, length = self.sinfo.offset_len_to_stripe_bounds(lo, hi - lo)
+            c_off = self.sinfo.aligned_logical_offset_to_chunk_offset(start)
+            c_len = self.sinfo.aligned_logical_offset_to_chunk_offset(length)
+            rop.shard_extents[oid] = (c_off, c_len)
+            minimum = self.ec_impl.minimum_to_decode(want, avail)
+            if fast_read and len(avail) > len(minimum):
+                # redundant reads: ask every available shard (ECBackend.cc:1609-1615)
+                minimum = {c: [(0, self.ec_impl.get_sub_chunk_count())]
+                           for c in avail}
+            rop.want_shards[oid] = set(minimum)
+            rop.tried_shards[oid] = set(minimum)
+            for chunk, subchunks in minimum.items():
+                shard = self.acting[chunk]
+                runs = None if subchunks == [(0, self.ec_impl.get_sub_chunk_count())] \
+                    else subchunks
+                per_shard.setdefault(shard, {}).setdefault(oid, []).append(
+                    (c_off, c_len, runs))
+        rop.pending_shards = {shard: 1 for shard in per_shard}
+        self.in_progress_reads[tid] = rop
+        for shard, to_read in per_shard.items():
+            self.bus.send(shard, ECSubRead(
+                self.whoami, tid, to_read,
+                sub_chunk_count=self.ec_impl.get_sub_chunk_count()))
+        return tid
+
+    def handle_sub_read_reply(self, reply: ECSubReadReply) -> None:
+        """(ECBackend.cc:1153-1320): collect; on error widen the shard set
+        (send_all_remaining_reads :2386)."""
+        rop_rec = self._recovery_read_tids.get(reply.tid)
+        if rop_rec is not None:
+            self.handle_recovery_read_reply(rop_rec, reply)
+            return
+        # RMW pipeline reads
+        op = self.tid_to_op.get(reply.tid)
+        if op is not None:
+            self._handle_rmw_read_reply(op, reply)
+            return
+        rop = self.in_progress_reads.get(reply.tid)
+        if rop is None:
+            return
+        left = rop.pending_shards.get(reply.from_shard, 0) - 1
+        if left <= 0:
+            rop.pending_shards.pop(reply.from_shard, None)
+        else:
+            rop.pending_shards[reply.from_shard] = left
+        chunk_of_shard = {s: c for c, s in enumerate(self.acting)}
+        chunk = chunk_of_shard[reply.from_shard]
+        for oid, bufs in reply.buffers_read.items():
+            data = b"".join(b for _, b in bufs)
+            rop.results.setdefault(oid, {})[chunk] = data
+        for oid in reply.errors:
+            rop.errors.setdefault(oid, set()).add(chunk)
+            self._retry_remaining_shards(rop, oid)
+        if not rop.pending_shards:
+            self._complete_read_op(rop)
+
+    def _retry_remaining_shards(self, rop: ReadOp, oid: str) -> None:
+        """Incremental recovery from shard read errors (ECBackend.cc:1627-1671)."""
+        k = self.ec_impl.get_data_chunk_count()
+        up = self.up_shards()
+        avail = {c for c, s in enumerate(self.acting)
+                 if s in up and c not in rop.errors.get(oid, set())}
+        untried = avail - rop.tried_shards[oid]
+        have_or_pending = (set(rop.results.get(oid, {})) | untried) - \
+            rop.errors.get(oid, set())
+        if len(have_or_pending) < k:
+            return  # complete_read_op will surface the failure
+        c_off, c_len = rop.shard_extents[oid]
+        for chunk in untried:
+            shard = self.acting[chunk]
+            rop.tried_shards[oid].add(chunk)
+            rop.pending_shards[shard] = rop.pending_shards.get(shard, 0) + 1
+            self.bus.send(shard, ECSubRead(
+                self.whoami, rop.tid, {oid: [(c_off, c_len, None)]}))
+
+    def _handle_rmw_read_reply(self, op: Op, reply: ECSubReadReply) -> None:
+        op.pending_read_shards.discard(reply.from_shard)
+        chunk_of_shard = {s: c for c, s in enumerate(self.acting)}
+        chunk = chunk_of_shard[reply.from_shard]
+        for oid, bufs in reply.buffers_read.items():
+            store = op._rmw_buf.setdefault(oid, {})
+            for c_off, data in bufs:
+                store.setdefault(c_off, {})[chunk] = data
+        if not op.pending_read_shards:
+            self._finish_rmw_reads(op)
+            self.check_ops()
+
+    def _finish_rmw_reads(self, op: Op) -> None:
+        """Decode each read stripe-run back to logical bytes."""
+        for oid, runs in op._rmw_buf.items():
+            for c_off, by_chunk in runs.items():
+                logical_off = self.sinfo.aligned_chunk_offset_to_logical_offset(c_off)
+                data = ecutil.decode(self.sinfo, self.ec_impl, by_chunk)
+                op.remote_reads.setdefault(oid, {})[logical_off] = data
+
+    def _complete_read_op(self, rop: ReadOp) -> None:
+        """Reassemble/reconstruct and trim (ECBackend.cc:2273-2329)."""
+        k = self.ec_impl.get_data_chunk_count()
+        result: dict[str, list[tuple[int, int, bytes]]] = {}
+        errors: dict[str, int] = {}
+        for oid, extents in rop.to_read.items():
+            by_chunk = rop.results.get(oid, {})
+            by_chunk = {c: v for c, v in by_chunk.items()
+                        if c not in rop.errors.get(oid, set())}
+            if len(by_chunk) < k:
+                errors[oid] = -5  # EIO
+                continue
+            # keep exactly k shards for decode
+            chosen = dict(sorted(by_chunk.items())[:k])
+            logical = ecutil.decode(self.sinfo, self.ec_impl, chosen)
+            c_off, _ = rop.shard_extents[oid]
+            base = self.sinfo.aligned_chunk_offset_to_logical_offset(c_off)
+            obj_size = self.object_size(oid)
+            out = []
+            for off, length in extents:
+                end = min(off + length, obj_size)
+                seg = logical[off - base:end - base] if end > off else b""
+                out.append((off, length, seg))
+            result[oid] = out
+        del self.in_progress_reads[rop.tid]
+        rop.on_complete(result, errors)
+
+    # -- recovery (ECBackend.cc:565-732; state ECBackend.h:249-293) --------
+
+    def is_recoverable(self, oid: str, missing: set[int]) -> bool:
+        """ECRecPred analog (ECBackend.h:581-607)."""
+        avail = {c for c, s in enumerate(self.acting)
+                 if s in self.up_shards() and c not in missing}
+        try:
+            self.ec_impl.minimum_to_decode(set(missing), avail)
+            return True
+        except IOError:
+            return False
+
+    def recover_object(self, oid: str, missing_chunks: set[int],
+                       on_complete=None) -> RecoveryOp:
+        rop = RecoveryOp(oid=oid, missing_shards=set(missing_chunks),
+                         on_complete=on_complete)
+        self.recovery_ops[oid] = rop
+        self.continue_recovery_op(rop)
+        return rop
+
+    def continue_recovery_op(self, rop: RecoveryOp) -> None:
+        if rop.state == RecoveryState.IDLE:
+            avail = {c for c, s in enumerate(self.acting)
+                     if s in self.up_shards() and c not in rop.missing_shards}
+            minimum = self.ec_impl.minimum_to_decode(rop.missing_shards, avail)
+            self.next_tid += 1
+            rop.read_tid = self.next_tid
+            hinfo = self._hinfo(rop.oid)
+            c_len = hinfo.get_total_chunk_size()
+            per_shard = {}
+            for chunk, subchunks in minimum.items():
+                shard = self.acting[chunk]
+                runs = None if subchunks == [(0, self.ec_impl.get_sub_chunk_count())] \
+                    else subchunks
+                per_shard.setdefault(shard, {})[rop.oid] = [(0, c_len, runs)]
+            rop._read_results = {}
+            rop._pending = set(per_shard)
+            rop.state = RecoveryState.READING
+            self._recovery_read_tids[rop.read_tid] = rop
+            for shard, to_read in per_shard.items():
+                self.bus.send(shard, ECSubRead(
+                    self.whoami, rop.read_tid, to_read,
+                    sub_chunk_count=self.ec_impl.get_sub_chunk_count()))
+
+    def handle_recovery_read_reply(self, rop: RecoveryOp,
+                                   reply: ECSubReadReply) -> None:
+        chunk_of_shard = {s: c for c, s in enumerate(self.acting)}
+        chunk = chunk_of_shard[reply.from_shard]
+        for oid, bufs in reply.buffers_read.items():
+            rop._read_results[chunk] = b"".join(b for _, b in bufs)
+        rop._pending.discard(reply.from_shard)
+        if rop._pending:
+            return
+        # READING -> WRITING: reconstruct the missing chunks, push them.
+        # chunk_size tells sub-chunk codes (clay) the helpers are fractional
+        available = {c: np.frombuffer(v, dtype=np.uint8)
+                     for c, v in rop._read_results.items()}
+        hinfo = self._hinfo(rop.oid)
+        rec = decode_shards(self.sinfo, self.ec_impl, available,
+                            rop.missing_shards,
+                            chunk_size=hinfo.get_total_chunk_size())
+        rop.state = RecoveryState.WRITING
+        for chunk in rop.missing_shards:
+            shard = self.acting[chunk]
+            rop.pending_pushes.add(shard)
+            self.bus.send(shard, PushOp(
+                self.whoami, rop.oid, bytes(rec[chunk]),
+                attrs={HINFO_KEY: hinfo.to_dict()}))
+
+    def handle_push_reply(self, reply: PushReply) -> None:
+        rop = self.recovery_ops.get(reply.oid)
+        if rop is None:
+            return
+        rop.pending_pushes.discard(reply.from_shard)
+        if not rop.pending_pushes:
+            rop.state = RecoveryState.COMPLETE
+            if rop.on_complete:
+                rop.on_complete(rop)
+
+    # -- deep scrub (ECBackend.cc:2461-2546) -------------------------------
+
+    def be_deep_scrub(self, oid: str) -> dict[int, bool]:
+        """Recompute each up shard's cumulative crc vs its stored HashInfo;
+        True = clean."""
+        out: dict[int, bool] = {}
+        for chunk, shard in enumerate(self.acting):
+            if shard in self.bus.down:
+                continue
+            handler = self.bus.handlers[shard]
+            store = handler.store if isinstance(handler, OSDShard) else \
+                handler.local_shard.store
+            obj = GObject(oid, shard)
+            try:
+                data = store.read(obj)
+                stored = store.getattr(obj, HINFO_KEY)
+            except (FileNotFoundError, KeyError):
+                out[chunk] = False
+                continue
+            hashes = stored.get("cumulative_shard_hashes") or []
+            if not hashes:
+                out[chunk] = True  # hash cleared by overwrite: nothing to check
+                continue
+            out[chunk] = crc32c(0xFFFFFFFF, data) == hashes[chunk] and \
+                len(data) == stored["total_chunk_size"]
+        return out
+
+
+def make_cluster(ec_impl, chunk_size: int = 4096):
+    """Build a primary + shard OSDs wired on one bus; returns (backend, bus).
+
+    Chunk i lives on shard id i (identity crush mapping) with the primary
+    colocated on shard 0, the common layout in the standalone EC tests
+    (reference: qa/standalone/erasure-code/test-erasure-code.sh:21-66).
+    """
+    n = ec_impl.get_chunk_count()
+    k = ec_impl.get_data_chunk_count()
+    bus = MessageBus()
+    backend = ECBackend(ec_impl, StripeInfo(k, chunk_size), bus,
+                        acting=list(range(n)), whoami=0)
+    for shard in range(1, n):
+        OSDShard(shard, bus)
+    return backend, bus

@@ -1,0 +1,252 @@
+"""Stripe offset algebra, per-shard hashes, and batched stripe codecs.
+
+Analog of the reference's ``ECUtil`` (reference: src/osd/ECUtil.{h,cc}) with
+the one deliberate TPU-first restructuring called out in SURVEY.md §2.2: the
+reference encodes **per stripe** (one plugin call per stripe_width bytes,
+ECUtil.cc:136-148); here :func:`encode`/:func:`decode` make ONE plugin call
+for the whole multi-stripe buffer by laying stripes out as contiguous
+per-shard chunk streams.  RS parity is positionwise, so batching across
+stripes is a pure relayout — bit-identical output, MXU-sized launches.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+# -- crc32c (Castagnoli), seed-chained like ceph_crc32c ----------------------
+# HashInfo chains bufferlist::crc32c(seed) per shard with initial seed -1
+# (reference: src/osd/ECUtil.h:110-112, ECUtil.cc:161-177).
+
+_CRC32C_POLY = 0x82F63B78
+
+
+def _make_crc_tables(n_tables: int = 16) -> list[list[int]]:
+    """Slice-by-N tables: T[j][b] advances byte b through j+1 zero bytes."""
+    t0 = []
+    for n in range(256):
+        c = n
+        for _ in range(8):
+            c = (c >> 1) ^ _CRC32C_POLY if c & 1 else c >> 1
+        t0.append(c)
+    tables = [t0]
+    for _ in range(n_tables - 1):
+        prev = tables[-1]
+        tables.append([(prev[b] >> 8) ^ t0[prev[b] & 0xFF] for b in range(256)])
+    return tables
+
+
+_CRC_TABLES = _make_crc_tables()
+
+
+def crc32c(seed: int, data: bytes | np.ndarray) -> int:
+    """ceph_crc32c semantics: raw reflected CRC-32C update, no final xor —
+    the caller chains seeds (standard crc32c(x) = crc32c(0xffffffff, x) ^ 0xffffffff).
+
+    Slice-by-16: one Python iteration consumes 16 bytes.
+    """
+    if isinstance(data, np.ndarray):
+        buf = np.ascontiguousarray(data.ravel()).tobytes()
+    else:
+        buf = bytes(data)
+    crc = seed & 0xFFFFFFFF
+    t = _CRC_TABLES
+    (t15, t14, t13, t12, t11, t10, t9, t8,
+     t7, t6, t5, t4, t3, t2, t1, t0) = t[15], t[14], t[13], t[12], t[11], \
+        t[10], t[9], t[8], t[7], t[6], t[5], t[4], t[3], t[2], t[1], t[0]
+    n16 = len(buf) & ~15
+    for i in range(0, n16, 16):
+        b = buf[i:i + 16]
+        crc ^= b[0] | (b[1] << 8) | (b[2] << 16) | (b[3] << 24)
+        crc = (t15[crc & 0xFF] ^ t14[(crc >> 8) & 0xFF] ^
+               t13[(crc >> 16) & 0xFF] ^ t12[crc >> 24] ^
+               t11[b[4]] ^ t10[b[5]] ^ t9[b[6]] ^ t8[b[7]] ^
+               t7[b[8]] ^ t6[b[9]] ^ t5[b[10]] ^ t4[b[11]] ^
+               t3[b[12]] ^ t2[b[13]] ^ t1[b[14]] ^ t0[b[15]])
+    for i in range(n16, len(buf)):
+        crc = t0[(crc ^ buf[i]) & 0xFF] ^ (crc >> 8)
+    return crc
+
+
+class StripeInfo:
+    """stripe_info_t: logical<->chunk offset algebra (ECUtil.h:27-80).
+
+    ``stripe_width = k * chunk_size``; logical offsets live in object space,
+    chunk offsets in per-shard space.
+    """
+
+    def __init__(self, k: int, chunk_size: int):
+        self.k = k
+        self.chunk_size = chunk_size
+        self.stripe_width = k * chunk_size
+
+    def logical_offset_is_stripe_aligned(self, logical: int) -> bool:
+        return logical % self.stripe_width == 0
+
+    def logical_to_prev_chunk_offset(self, offset: int) -> int:
+        return (offset // self.stripe_width) * self.chunk_size
+
+    def logical_to_next_chunk_offset(self, offset: int) -> int:
+        return ((offset + self.stripe_width - 1) // self.stripe_width) * self.chunk_size
+
+    def logical_to_prev_stripe_offset(self, offset: int) -> int:
+        return offset - (offset % self.stripe_width)
+
+    def logical_to_next_stripe_offset(self, offset: int) -> int:
+        rem = offset % self.stripe_width
+        return offset + (self.stripe_width - rem) if rem else offset
+
+    def aligned_logical_offset_to_chunk_offset(self, offset: int) -> int:
+        assert offset % self.stripe_width == 0
+        return (offset // self.stripe_width) * self.chunk_size
+
+    def aligned_chunk_offset_to_logical_offset(self, offset: int) -> int:
+        assert offset % self.chunk_size == 0
+        return (offset // self.chunk_size) * self.stripe_width
+
+    def aligned_offset_len_to_chunk(self, off: int, length: int) -> tuple[int, int]:
+        return (self.aligned_logical_offset_to_chunk_offset(off),
+                self.aligned_logical_offset_to_chunk_offset(length))
+
+    def offset_len_to_stripe_bounds(self, off: int, length: int) -> tuple[int, int]:
+        start = self.logical_to_prev_stripe_offset(off)
+        end_len = self.logical_to_next_stripe_offset((off - start) + length)
+        return start, end_len
+
+
+class HashInfo:
+    """Per-shard cumulative crc32c of appended chunk bytes (ECUtil.h:101-168).
+
+    Appends must be contiguous with the current size; out-of-order appends
+    clear the hashes the way the reference asserts them away.
+    """
+
+    def __init__(self, num_chunks: int):
+        self.total_chunk_size = 0
+        self.cumulative_shard_hashes = [0xFFFFFFFF] * num_chunks
+        self.projected_total_chunk_size = 0
+
+    def append(self, old_size: int, to_append: dict[int, np.ndarray]) -> None:
+        assert old_size == self.total_chunk_size
+        if not to_append:
+            return
+        sizes = {len(v) for v in to_append.values()}
+        assert len(sizes) == 1, "uneven shard appends"
+        if self.has_chunk_hash():
+            for shard, buf in to_append.items():
+                self.cumulative_shard_hashes[shard] = crc32c(
+                    self.cumulative_shard_hashes[shard], buf)
+        self.total_chunk_size += sizes.pop()
+
+    def clear(self) -> None:
+        self.total_chunk_size = 0
+        self.cumulative_shard_hashes = [0xFFFFFFFF] * len(self.cumulative_shard_hashes)
+
+    def get_chunk_hash(self, shard: int) -> int:
+        return self.cumulative_shard_hashes[shard]
+
+    def get_total_chunk_size(self) -> int:
+        return self.total_chunk_size
+
+    def get_projected_total_chunk_size(self) -> int:
+        return self.projected_total_chunk_size
+
+    def get_total_logical_size(self, sinfo: StripeInfo) -> int:
+        return self.total_chunk_size * (sinfo.stripe_width // sinfo.chunk_size)
+
+    def get_projected_total_logical_size(self, sinfo: StripeInfo) -> int:
+        return self.projected_total_chunk_size * (sinfo.stripe_width // sinfo.chunk_size)
+
+    def set_projected_total_logical_size(self, sinfo: StripeInfo, logical: int) -> None:
+        assert sinfo.logical_offset_is_stripe_aligned(logical)
+        self.projected_total_chunk_size = \
+            sinfo.aligned_logical_offset_to_chunk_offset(logical)
+
+    def set_total_chunk_size_clear_hash(self, new_chunk_size: int) -> None:
+        self.cumulative_shard_hashes = []
+        self.total_chunk_size = new_chunk_size
+
+    def has_chunk_hash(self) -> bool:
+        return bool(self.cumulative_shard_hashes)
+
+    def to_dict(self) -> dict:
+        return {"total_chunk_size": self.total_chunk_size,
+                "cumulative_shard_hashes": list(self.cumulative_shard_hashes)}
+
+
+# -- batched stripe codec ----------------------------------------------------
+
+def _to_shard_major(buf: np.ndarray, k: int, chunk_size: int) -> np.ndarray:
+    """[S * stripe_width] logical bytes -> [k, S * chunk_size] shard streams.
+
+    Stripe s contributes bytes [s*W + i*c, s*W + (i+1)*c) to shard i at chunk
+    offset s*c (doc/dev/osd_internals/erasure_coding.rst:55-75 layout).
+    """
+    stripes = buf.reshape(-1, k, chunk_size)          # [S, k, c]
+    return np.ascontiguousarray(stripes.transpose(1, 0, 2)).reshape(k, -1)
+
+
+def _from_shard_major(shards: np.ndarray, chunk_size: int) -> np.ndarray:
+    """[k, S * chunk_size] shard streams -> [S * stripe_width] logical bytes."""
+    k = shards.shape[0]
+    stripes = shards.reshape(k, -1, chunk_size).transpose(1, 0, 2)  # [S, k, c]
+    return np.ascontiguousarray(stripes).reshape(-1)
+
+
+def encode(sinfo: StripeInfo, ec_impl, data: bytes | np.ndarray,
+           want: set | None = None) -> dict[int, np.ndarray]:
+    """Encode a stripe-aligned logical buffer into per-shard chunk buffers.
+
+    One ``encode_chunks`` call for ALL stripes (vs the reference's per-stripe
+    loop at ECUtil.cc:136-148); returns {shard: concatenated chunk bytes}.
+    """
+    buf = np.frombuffer(data, dtype=np.uint8) if isinstance(data, (bytes, bytearray)) \
+        else np.asarray(data, dtype=np.uint8)
+    assert len(buf) % sinfo.stripe_width == 0, \
+        f"len {len(buf)} not stripe aligned ({sinfo.stripe_width})"
+    k = ec_impl.get_data_chunk_count()
+    n = ec_impl.get_chunk_count()
+    assert k == sinfo.k
+    if want is None:
+        want = set(range(n))
+    shard_len = (len(buf) // sinfo.stripe_width) * sinfo.chunk_size
+    data_shards = _to_shard_major(buf, k, sinfo.chunk_size)
+    encoded = {ec_impl.chunk_index(i): data_shards[i].copy() for i in range(k)}
+    for i in range(k, n):
+        encoded[ec_impl.chunk_index(i)] = np.zeros(shard_len, dtype=np.uint8)
+    ec_impl.encode_chunks(set(range(n)), encoded)
+    return {i: encoded[i] for i in want}
+
+
+def _as_u8(v) -> np.ndarray:
+    if isinstance(v, (bytes, bytearray, memoryview)):
+        return np.frombuffer(v, dtype=np.uint8)
+    return np.asarray(v, dtype=np.uint8)
+
+
+def decode(sinfo: StripeInfo, ec_impl,
+           to_decode: dict[int, np.ndarray]) -> bytes:
+    """Reconstruct the logical buffer from >=k shard chunk streams
+    (ECUtil.cc:9-45), batched across all stripes in one decode call."""
+    chunks = {i: _as_u8(v) for i, v in to_decode.items()}
+    total = {len(v) for v in chunks.values()}
+    assert len(total) == 1, "uneven shard buffers"
+    decoded = ec_impl.decode_concat(chunks)
+    k = ec_impl.get_data_chunk_count()
+    shard_len = total.pop()
+    logical = _from_shard_major(
+        np.frombuffer(decoded, dtype=np.uint8).reshape(k, shard_len),
+        sinfo.chunk_size)
+    return logical.tobytes()
+
+
+def decode_shards(sinfo: StripeInfo, ec_impl, available: dict[int, np.ndarray],
+                  want: set, chunk_size: int = 0) -> dict[int, np.ndarray]:
+    """Reconstruct specific shards (recovery path, ECUtil.cc:47-118 shape).
+
+    ``chunk_size`` is the full per-shard size; when the available buffers are
+    smaller, sub-chunk-aware codes (clay) route through their fractional
+    repair path (ErasureCodeClay.cc:107-122)."""
+    chunks = {i: _as_u8(v) for i, v in available.items()}
+    return ec_impl.decode(set(want), chunks, chunk_size)
+
+
+HINFO_KEY = "hinfo_key"  # xattr name (ECUtil.cc:235, get_hinfo_key)

@@ -1,0 +1,129 @@
+"""EC sub-op wire payloads and the in-process message bus.
+
+Analog of the reference's ``ECSubWrite``/``ECSubRead``(+replies) payloads
+(reference: src/osd/ECMsgTypes.h:23-129) carried by
+``MOSDECSubOpWrite/Read`` messages, and of the messenger fan-out that moves
+them between shards (reference: src/osd/ECBackend.cc:2036-2070).  The bus is
+deterministic: sends enqueue, ``deliver_all`` drains — tests step it to
+exercise pipeline orderings; a down shard silently drops its queue the way a
+dead OSD would.
+"""
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+
+from .extent import ExtentSet
+from .memstore import Transaction
+
+
+@dataclass
+class ECSubWrite:
+    """Primary -> shard: apply this shard-local transaction (ECMsgTypes.h:23-38)."""
+    from_shard: int
+    tid: int
+    t: Transaction
+    at_version: int = 0
+    trim_to: int = 0
+    backfill_or_async_recovery: bool = False
+
+
+@dataclass
+class ECSubWriteReply:
+    """Shard -> primary: committed/applied acks (ECMsgTypes.h:91-102)."""
+    from_shard: int
+    tid: int
+    committed: bool = True
+    applied: bool = True
+
+
+@dataclass
+class ECSubRead:
+    """Primary -> shard: read chunk extents, optionally sub-chunk runs
+    (ECMsgTypes.h:105-116; sub-chunks serve clay, ECBackend.cc:985-1031)."""
+    from_shard: int
+    tid: int
+    # oid -> list of (chunk-space offset, length, subchunk_runs|None)
+    to_read: dict[str, list[tuple]] = field(default_factory=dict)
+    attrs_to_read: set[str] = field(default_factory=set)
+    # denominator for subchunk_runs (codec's get_sub_chunk_count(); the
+    # reference ships it inside the run offsets, ECMsgTypes.h:105-116)
+    sub_chunk_count: int = 1
+
+
+@dataclass
+class ECSubReadReply:
+    """Shard -> primary (ECMsgTypes.h:118-129)."""
+    from_shard: int
+    tid: int
+    buffers_read: dict[str, list[tuple[int, bytes]]] = field(default_factory=dict)
+    attrs_read: dict[str, dict] = field(default_factory=dict)
+    errors: dict[str, int] = field(default_factory=dict)
+
+
+@dataclass
+class PushOp:
+    """Recovery payload: reconstructed chunk data for a missing shard
+    (reference: src/osd/ECBackend.cc:284-360 shape)."""
+    from_shard: int
+    oid: str
+    data: bytes
+    attrs: dict = field(default_factory=dict)
+    version: int = 0
+
+
+@dataclass
+class PushReply:
+    from_shard: int
+    oid: str
+
+
+class MessageBus:
+    """Per-shard FIFO queues; handlers registered per shard id."""
+
+    def __init__(self):
+        self.queues: dict[int, deque] = {}
+        self.handlers: dict[int, object] = {}
+        self.down: set[int] = set()
+        self.delivered = 0
+
+    def register(self, shard: int, handler) -> None:
+        self.queues.setdefault(shard, deque())
+        self.handlers[shard] = handler
+
+    def mark_down(self, shard: int) -> None:
+        """Drop the shard: pending + future messages to it vanish (a dead
+        OSD's socket resets; the reference learns via heartbeats+osdmap)."""
+        self.down.add(shard)
+        if shard in self.queues:
+            self.queues[shard].clear()
+
+    def mark_up(self, shard: int) -> None:
+        self.down.discard(shard)
+
+    def send(self, to_shard: int, msg) -> None:
+        if to_shard in self.down:
+            return
+        self.queues.setdefault(to_shard, deque()).append(msg)
+
+    def deliver_one(self, shard: int) -> bool:
+        q = self.queues.get(shard)
+        if not q or shard in self.down:
+            return False
+        msg = q.popleft()
+        self.handlers[shard].handle_message(msg)
+        self.delivered += 1
+        return True
+
+    def deliver_all(self, max_rounds: int = 10000) -> int:
+        """Drain every queue to quiescence; returns messages delivered."""
+        n = 0
+        for _ in range(max_rounds):
+            progressed = False
+            for shard in list(self.queues):
+                while self.deliver_one(shard):
+                    progressed = True
+                    n += 1
+            if not progressed:
+                return n
+        raise RuntimeError("message storm: bus did not quiesce")

@@ -1,0 +1,130 @@
+"""Client-level object transactions and EC write planning.
+
+Analog of the reference's ``PGTransaction`` (reference:
+src/osd/PGTransaction.h) and ``ECTransaction::get_write_plan`` (reference:
+src/osd/ECTransaction.h:40-183): computes which whole stripes must be read
+(RMW head/tail partials) and which stripe-aligned extents will be written.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from .ecutil import HashInfo, StripeInfo
+from .extent import ExtentSet
+
+
+@dataclass
+class ObjectOperation:
+    """One object's mutation set (PGTransaction::ObjectOperation shape)."""
+    delete_first: bool = False
+    # buffer updates: (logical offset, payload bytes)
+    buffer_updates: list[tuple[int, bytes]] = field(default_factory=list)
+    # (truncate_before_writes, truncate_after_writes) — ECTransaction.h:71,154
+    truncate: tuple[int, int] | None = None
+    source: str | None = None  # rename/clone source oid
+
+    def write(self, offset: int, data: bytes) -> "ObjectOperation":
+        self.buffer_updates.append((offset, bytes(data)))
+        return self
+
+
+class PGTransaction:
+    """oid -> ObjectOperation, applied in insertion order."""
+
+    def __init__(self):
+        self.ops: dict[str, ObjectOperation] = {}
+
+    def touch(self, oid: str) -> ObjectOperation:
+        return self.ops.setdefault(oid, ObjectOperation())
+
+    def write(self, oid: str, offset: int, data: bytes) -> "PGTransaction":
+        self.touch(oid).write(offset, data)
+        return self
+
+    def delete(self, oid: str) -> "PGTransaction":
+        self.touch(oid).delete_first = True
+        return self
+
+    def truncate_to(self, oid: str, size: int) -> "PGTransaction":
+        self.touch(oid).truncate = (size, size)
+        return self
+
+
+@dataclass
+class WritePlan:
+    """ECTransaction::WritePlan (ECTransaction.h:26-33)."""
+    t: PGTransaction
+    to_read: dict[str, ExtentSet] = field(default_factory=dict)
+    will_write: dict[str, ExtentSet] = field(default_factory=dict)
+    hash_infos: dict[str, HashInfo] = field(default_factory=dict)
+    invalidates_cache: bool = False
+
+
+def get_write_plan(sinfo: StripeInfo, t: PGTransaction, get_hinfo) -> WritePlan:
+    """Mirror of the reference planner (ECTransaction.h:40-183).
+
+    ``get_hinfo(oid) -> HashInfo`` supplies the projected-size oracle.  For
+    each object: unaligned truncates force a read+rewrite of their last
+    stripe; every write extent reads its partial head/tail stripes when they
+    overlap existing data; ``will_write`` is the stripe-aligned hull of the
+    writes (a superset of ``to_read``).
+    """
+    plan = WritePlan(t=t)
+    for oid, op in t.ops.items():
+        hinfo = get_hinfo(oid)
+        plan.hash_infos[oid] = hinfo
+        projected_size = hinfo.get_projected_total_logical_size(sinfo)
+
+        if op.delete_first:
+            projected_size = 0
+        if op.source is not None:
+            plan.invalidates_cache = True
+            shinfo = get_hinfo(op.source)
+            projected_size = shinfo.get_projected_total_logical_size(sinfo)
+            plan.hash_infos[op.source] = shinfo
+
+        will_write = plan.will_write.setdefault(oid, ExtentSet())
+
+        if op.truncate is not None and op.truncate[0] < projected_size:
+            if not sinfo.logical_offset_is_stripe_aligned(op.truncate[0]):
+                prev = sinfo.logical_to_prev_stripe_offset(op.truncate[0])
+                plan.to_read.setdefault(oid, ExtentSet()).union_insert(
+                    prev, sinfo.stripe_width)
+                will_write.union_insert(prev, sinfo.stripe_width)
+            projected_size = sinfo.logical_to_next_stripe_offset(op.truncate[0])
+
+        raw_write_set = ExtentSet()
+        for off, data in op.buffer_updates:
+            raw_write_set.union_insert(off, len(data))
+
+        orig_size = projected_size
+        for off, length in raw_write_set:
+            head_start = sinfo.logical_to_prev_stripe_offset(off)
+            head_finish = sinfo.logical_to_next_stripe_offset(off)
+            if head_start > projected_size:
+                head_start = projected_size
+            if head_start != head_finish and head_start < orig_size:
+                plan.to_read.setdefault(oid, ExtentSet()).union_insert(
+                    head_start, sinfo.stripe_width)
+
+            tail_start = sinfo.logical_to_prev_stripe_offset(off + length)
+            tail_finish = sinfo.logical_to_next_stripe_offset(off + length)
+            if (tail_start != tail_finish and
+                    (head_start == head_finish or tail_start != head_start) and
+                    tail_start < orig_size):
+                plan.to_read.setdefault(oid, ExtentSet()).union_insert(
+                    tail_start, sinfo.stripe_width)
+
+            if head_start != tail_finish:
+                will_write.union_insert(head_start, tail_finish - head_start)
+                if tail_finish > projected_size:
+                    projected_size = tail_finish
+
+        if op.truncate is not None and op.truncate[1] > projected_size:
+            truncating_to = sinfo.logical_to_next_stripe_offset(op.truncate[1])
+            will_write.union_insert(projected_size,
+                                    truncating_to - projected_size)
+            projected_size = truncating_to
+
+        hinfo.set_projected_total_logical_size(sinfo, projected_size)
+    return plan

@@ -305,7 +305,11 @@ class ErasureCodeClay(ErasureCode):
             erased.add(i)
         assert len(erased) == m
 
-        C = {node: buf.reshape(self.sub_chunk_no, sc_size)
+        # work on copies: the erasure padding above recruits intact parity
+        # nodes, whose buffers belong to the caller (and may be read-only
+        # np.frombuffer views) — results are written back at the end
+        C = {node: np.array(buf, dtype=np.uint8).reshape(
+                self.sub_chunk_no, sc_size)
              for node, buf in chunks.items()}
         U = np.zeros((q * t, self.sub_chunk_no, sc_size), dtype=np.uint8)
 
@@ -343,6 +347,9 @@ class ErasureCodeClay(ErasureCode):
                                 {0: C[node_xy][z], 1: C[node_sw][z_sw]})
                     else:  # hole-dot: C == U
                         C[node_xy][z] = U[node_xy][z]
+
+        for node in erased_chunks:
+            chunks[node][:] = C[node].reshape(-1)
 
     def _decode_erasures(self, erased: set[int], z: int, z_vec: list[int],
                          C: dict[int, np.ndarray], U: np.ndarray,
