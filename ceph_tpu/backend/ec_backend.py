@@ -174,7 +174,8 @@ class ECBackend:
         self.recovery_ops: dict[str, RecoveryOp] = {}
         self._recovery_read_tids: dict[int, RecoveryOp] = {}
         self.hinfo_cache: dict[str, HashInfo] = {}
-        self.completed_writes: list[int] = []
+        self.completed_writes: deque[int] = deque(maxlen=1024)
+        bus.down_listeners.append(self.on_shard_down)
 
     # -- helpers -----------------------------------------------------------
 
@@ -211,6 +212,55 @@ class ECBackend:
         else:
             self.local_shard.handle_message(msg)
 
+    # -- failure handling --------------------------------------------------
+
+    def on_shard_down(self, shard: int) -> None:
+        """Route around a shard that died with requests outstanding — the
+        analog of the reference's on_change/check_recovery_sources paths
+        re-driving in-flight ops when the acting set changes
+        (ECBackend.cc check_recovery_sources, _failed_push).  The commit
+        stage already prunes in try_finish_rmw; this covers the read
+        stages."""
+        if shard not in set(self.acting):
+            return
+        chunk = self.acting.index(shard)
+        # RMW pipeline reads: re-issue from the remaining shards
+        for op in list(self.waiting_reads):
+            if shard in op.pending_read_shards:
+                op.pending_read_shards.clear()
+                try:
+                    self._start_rmw_reads(op, op._rmw_need)
+                except IOError:
+                    # unrecoverable: too few shards — the op stays queued,
+                    # the PG is effectively down (reference: peering would
+                    # mark the PG incomplete) until shards return
+                    op.pending_read_shards.add(shard)
+        # client reads: treat like an error reply from that shard
+        for rop in list(self.in_progress_reads.values()):
+            if shard in rop.pending_shards:
+                rop.pending_shards.pop(shard, None)
+                for oid in rop.to_read:
+                    if (chunk in rop.want_shards.get(oid, ()) and
+                            chunk not in rop.results.get(oid, {})):
+                        rop.errors.setdefault(oid, set()).add(chunk)
+                        self._retry_remaining_shards(rop, oid)
+                if not rop.pending_shards:
+                    self._complete_read_op(rop)
+        # recovery reads: restart the op's READING phase from live shards
+        for tid, rop in list(self._recovery_read_tids.items()):
+            if shard in rop._pending:
+                del self._recovery_read_tids[tid]
+                rop.state = RecoveryState.IDLE
+                self.continue_recovery_op(rop)
+        # recovery pushes: a dead push target can never ack
+        for oid, rop in list(self.recovery_ops.items()):
+            if shard in rop.pending_pushes:
+                rop.pending_pushes.discard(shard)
+                if not rop.pending_pushes and rop.state == RecoveryState.WRITING:
+                    self._finish_recovery_op(rop)
+        self.try_finish_rmw()
+        self.check_ops()
+
     # -- write pipeline ----------------------------------------------------
 
     def submit_transaction(self, t: PGTransaction, on_commit=None) -> int:
@@ -244,8 +294,9 @@ class ECBackend:
         (doc/dev/osd_internals/erasure_coding/ecbackend.rst:190-206)."""
         for oid, to_read in op.plan.to_read.items():
             for off, length in to_read:
-                if self.extent_cache.read(oid, off, length) is not None:
-                    continue
+                # NB: a cache hit does NOT lift the block — cached bytes may
+                # be an older op's; any not-yet-committed overlapping write
+                # ahead of us must land in the cache first
                 for other in self.waiting_reads:
                     ww = other.plan.will_write.get(oid)
                     if ww is not None and ww.intersects(off, length):
@@ -315,6 +366,20 @@ class ECBackend:
                 for chunk, shard in enumerate(self.acting):
                     shard_txns[shard].remove(GObject(oid, shard))
                 hinfo.clear()
+            if objop.truncate is not None:
+                # truncate-before-writes: shrink every shard to the chunk
+                # offset of the next stripe boundary, then let the rewritten
+                # partial stripe (planned by get_write_plan) land on top
+                # (reference: ECTransaction.cc generate_transactions truncate
+                # handling; ECTransaction.h:70-86)
+                t_logical = self.sinfo.logical_to_next_stripe_offset(
+                    objop.truncate[0])
+                t_chunk = self.sinfo.aligned_logical_offset_to_chunk_offset(
+                    t_logical)
+                if t_chunk < hinfo.total_chunk_size:
+                    for chunk, shard in enumerate(self.acting):
+                        shard_txns[shard].truncate(GObject(oid, shard), t_chunk)
+                    hinfo.set_total_chunk_size_clear_hash(t_chunk)
             if not will_write:
                 if not objop.delete_first:
                     self._persist_hinfo(oid, hinfo, shard_txns)
@@ -506,8 +571,14 @@ class ECBackend:
         avail = {c for c, s in enumerate(self.acting)
                  if s in up and c not in rop.errors.get(oid, set())}
         untried = avail - rop.tried_shards[oid]
-        have_or_pending = (set(rop.results.get(oid, {})) | untried) - \
-            rop.errors.get(oid, set())
+        # chunks already read + still outstanding on live shards + the new
+        # candidates must reach k (ECBackend.cc:1627-1671 counts pending
+        # shards as available too)
+        pending = {c for c, s in enumerate(self.acting)
+                   if s in rop.pending_shards and s in up and
+                   c in rop.tried_shards[oid]}
+        have_or_pending = (set(rop.results.get(oid, {})) | pending | untried) \
+            - rop.errors.get(oid, set())
         if len(have_or_pending) < k:
             return  # complete_read_op will surface the failure
         c_off, c_len = rop.shard_extents[oid]
@@ -611,6 +682,8 @@ class ECBackend:
 
     def handle_recovery_read_reply(self, rop: RecoveryOp,
                                    reply: ECSubReadReply) -> None:
+        if rop.state != RecoveryState.READING:
+            return                      # stale/duplicate reply
         chunk_of_shard = {s: c for c, s in enumerate(self.acting)}
         chunk = chunk_of_shard[reply.from_shard]
         for oid, bufs in reply.buffers_read.items():
@@ -618,6 +691,7 @@ class ECBackend:
         rop._pending.discard(reply.from_shard)
         if rop._pending:
             return
+        self._recovery_read_tids.pop(rop.read_tid, None)
         # READING -> WRITING: reconstruct the missing chunks, push them.
         # chunk_size tells sub-chunk codes (clay) the helpers are fractional
         available = {c: np.frombuffer(v, dtype=np.uint8)
@@ -639,10 +713,18 @@ class ECBackend:
         if rop is None:
             return
         rop.pending_pushes.discard(reply.from_shard)
-        if not rop.pending_pushes:
-            rop.state = RecoveryState.COMPLETE
-            if rop.on_complete:
-                rop.on_complete(rop)
+        if not rop.pending_pushes and rop.state == RecoveryState.WRITING:
+            self._finish_recovery_op(rop)
+
+    def _finish_recovery_op(self, rop: RecoveryOp) -> None:
+        """COMPLETE + drop tracking state so late replies are inert
+        (the reference erases the RecoveryOp from recovery_ops on
+        on_global_recover)."""
+        rop.state = RecoveryState.COMPLETE
+        self.recovery_ops.pop(rop.oid, None)
+        self._recovery_read_tids.pop(rop.read_tid, None)
+        if rop.on_complete:
+            rop.on_complete(rop)
 
     # -- deep scrub (ECBackend.cc:2461-2546) -------------------------------
 

@@ -89,12 +89,28 @@ class MemStore:
     # -- transactions ------------------------------------------------------
 
     def queue_transaction(self, t: Transaction) -> int:
-        """Apply atomically; returns the commit sequence number."""
-        staged = {obj: _Object(bytearray(o.data), dict(o.xattrs), dict(o.omap))
-                  for obj, o in self.objects.items()}
+        """Apply atomically; returns the commit sequence number.
+
+        Atomicity by staging copies of only the objects the transaction
+        names (not the whole store): on any op error nothing merges back."""
+        touched: set[GObject] = set()
+        for op in t.ops:
+            touched.add(op[1])
+            if op[0] == "clone":
+                touched.add(op[2])
+        staged: dict[GObject, _Object] = {}
+        for obj in touched:
+            o = self.objects.get(obj)
+            if o is not None:
+                staged[obj] = _Object(bytearray(o.data), dict(o.xattrs),
+                                      dict(o.omap))
         for op in t.ops:
             self._apply(staged, op)
-        self.objects = staged
+        for obj in touched:
+            if obj in staged:
+                self.objects[obj] = staged[obj]
+            else:
+                self.objects.pop(obj, None)
         self.committed_seq += 1
         return self.committed_seq
 

@@ -86,6 +86,9 @@ class MessageBus:
         self.handlers: dict[int, object] = {}
         self.down: set[int] = set()
         self.delivered = 0
+        # failure notification fan-out: the reference's analog is the osdmap
+        # epoch bump reaching each OSD after heartbeats report the failure
+        self.down_listeners: list = []
 
     def register(self, shard: int, handler) -> None:
         self.queues.setdefault(shard, deque())
@@ -97,6 +100,8 @@ class MessageBus:
         self.down.add(shard)
         if shard in self.queues:
             self.queues[shard].clear()
+        for cb in self.down_listeners:
+            cb(shard)
 
     def mark_up(self, shard: int) -> None:
         self.down.discard(shard)

@@ -1,0 +1,280 @@
+"""OSDMap mapping chain: scalar oracle semantics + bulk-vs-scalar equality.
+
+The scalar chain mirrors the reference (src/osd/OSDMap.cc:2359-2653,
+src/osd/osd_types.cc:1640-1656, src/include/rados.h:86-92) on top of the
+golden-validated CRUSH interpreter; the bulk mapper (OSDMapMapping analog)
+must agree with it PG-for-PG."""
+import numpy as np
+import pytest
+
+from ceph_tpu.crush import (CRUSH_BUCKET_STRAW2, CRUSH_ITEM_NONE,
+                            CRUSH_RULE_CHOOSELEAF_FIRSTN,
+                            CRUSH_RULE_CHOOSELEAF_INDEP, CRUSH_RULE_EMIT,
+                            CRUSH_RULE_TAKE, CrushMap)
+from ceph_tpu.osdmap import (PG, BulkPGMapper, Incremental, OSDMap, Pool,
+                             POOL_TYPE_ERASURE, POOL_TYPE_REPLICATED,
+                             apply_incremental, ceph_stable_mod, pg_mask)
+
+NONE = CRUSH_ITEM_NONE
+
+
+def build_cluster(n_racks=3, hosts_per_rack=3, osds_per_host=3, seed=0):
+    """racks -> hosts -> osds, all straw2, uniform-ish weights."""
+    rng = np.random.default_rng(seed)
+    cmap = CrushMap()
+    cmap.set_type_name(1, "host")
+    cmap.set_type_name(2, "rack")
+    cmap.set_type_name(3, "root")
+    osd = 0
+    racks = []
+    for r in range(n_racks):
+        hosts = []
+        for h in range(hosts_per_rack):
+            items = list(range(osd, osd + osds_per_host))
+            osd += osds_per_host
+            w = [int(rng.integers(1, 4)) * 0x10000 for _ in items]
+            hosts.append(cmap.add_bucket(CRUSH_BUCKET_STRAW2, 1, items, w))
+        hw = [sum(cmap.buckets[h].item_weights) for h in hosts]
+        racks.append(cmap.add_bucket(CRUSH_BUCKET_STRAW2, 2, hosts, hw))
+    rw = [sum(cmap.buckets[r].item_weights) for r in racks]
+    root = cmap.add_bucket(CRUSH_BUCKET_STRAW2, 3, racks, rw)
+    cmap.set_item_name(root, "default")
+    cmap.finalize()
+
+    m = OSDMap(crush=cmap)
+    for o in range(osd):
+        m.create_osd(o)
+
+    rep_rule = cmap.add_rule([(CRUSH_RULE_TAKE, root, 0),
+                              (CRUSH_RULE_CHOOSELEAF_FIRSTN, 0, 1),
+                              (CRUSH_RULE_EMIT, 0, 0)])
+    ec_rule = cmap.add_rule([(CRUSH_RULE_TAKE, root, 0),
+                             (CRUSH_RULE_CHOOSELEAF_INDEP, 0, 1),
+                             (CRUSH_RULE_EMIT, 0, 0)])
+    m.add_pool(Pool(pool_id=1, type=POOL_TYPE_REPLICATED, size=3,
+                    pg_num=64, crush_rule=rep_rule, name="rbd"))
+    m.add_pool(Pool(pool_id=2, type=POOL_TYPE_ERASURE, size=6,
+                    pg_num=48, crush_rule=ec_rule, name="ecpool"))
+    return m
+
+
+def _row(lst, width):
+    out = np.full(width, NONE, dtype=np.int64)
+    out[:len(lst)] = lst
+    return out
+
+
+def assert_bulk_matches_scalar(m, pool_id):
+    pm = BulkPGMapper(m).map_pool(pool_id)
+    pool = m.pools[pool_id]
+    for ps in range(pool.pg_num):
+        up, upp, act, actp = m.pg_to_up_acting_osds(PG(pool_id, ps))
+        assert list(pm.up[ps]) == list(_row(up, pool.size)), f"pg {ps} up"
+        assert pm.up_primary[ps] == upp, f"pg {ps} up_primary"
+        assert list(pm.acting[ps]) == list(_row(act, pool.size)), (
+            f"pg {ps} acting")
+        assert pm.acting_primary[ps] == actp, f"pg {ps} acting_primary"
+
+
+# -- stable_mod / pps -------------------------------------------------------
+
+def test_stable_mod_reference_examples():
+    # b=12 -> bmask=15 (rados.h:80-85)
+    assert pg_mask(12) == 15
+    assert pg_mask(123) == 127
+    assert pg_mask(1) == 0
+    assert pg_mask(16) == 15
+    # entries >= b fold into the lower half-range
+    for x in range(64):
+        got = ceph_stable_mod(x, 12, 15)
+        assert 0 <= got < 12
+        if (x & 15) < 12:
+            assert got == (x & 15)
+        else:
+            assert got == (x & 7)
+
+
+def test_pps_distinct_across_pools():
+    m = build_cluster()
+    a = m.pools[1].raw_pg_to_pps(PG(1, 5))
+    b = m.pools[2].raw_pg_to_pps(PG(2, 5))
+    assert a != b
+
+
+# -- scalar chain semantics -------------------------------------------------
+
+def test_replicated_no_holes_ec_positional_holes():
+    m = build_cluster()
+    for ps in range(16):
+        up, upp, *_ = m.pg_to_up_acting_osds(PG(1, ps))
+        assert NONE not in up
+        assert len(up) == 3
+        assert upp == up[0]
+    # kill an OSD: replicated shifts, EC leaves a hole
+    m2 = m.clone()
+    victim = m.pg_to_up_acting_osds(PG(2, 0))[0][2]
+    m2.osd_state[victim] &= ~2          # clear UP
+    up, _, _, _ = m2.pg_to_up_acting_osds(PG(2, 0))
+    assert up[2] == NONE                # positional hole at slot 2
+    for ps in range(16):
+        upr, *_ = m2.pg_to_up_acting_osds(PG(1, ps))
+        assert NONE not in upr          # replicated compacts
+
+
+def test_out_osd_remapped():
+    m = build_cluster()
+    up0, *_ = m.pg_to_up_acting_osds(PG(1, 3))
+    victim = up0[0]
+    m2 = m.clone()
+    m2.osd_weight[victim] = 0           # mark out (reweight 0)
+    up, *_ = m2.pg_to_up_acting_osds(PG(1, 3))
+    assert victim not in up
+    assert len(up) == 3                 # refilled by CRUSH retry
+
+
+def test_pg_temp_overrides_acting_only():
+    m = build_cluster()
+    pg = PG(1, 7)
+    up0, upp0, *_ = m.pg_to_up_acting_osds(pg)
+    tmp = [o for o in range(9) if o not in up0][:3]
+    m.pg_temp[pg] = tmp
+    up, upp, act, actp = m.pg_to_up_acting_osds(pg)
+    assert up == up0 and upp == upp0
+    assert act == tmp and actp == tmp[0]
+    # primary_temp overrides the acting primary only
+    m.primary_temp[pg] = tmp[1]
+    *_, actp2 = m.pg_to_up_acting_osds(pg)
+    assert actp2 == tmp[1]
+
+
+def test_pg_temp_down_members_filtered():
+    m = build_cluster()
+    pg = PG(1, 9)
+    m.pg_temp[pg] = [0, 1, 2]
+    m.osd_state[1] &= ~2                # down
+    _, _, act, _ = m.pg_to_up_acting_osds(pg)
+    assert act == [0, 2]                # replicated: shifted out
+    pg2 = PG(2, 9)
+    m.pg_temp[pg2] = [0, 1, 2, 3, 4, 5]
+    _, _, act2, _ = m.pg_to_up_acting_osds(pg2)
+    assert act2[1] == NONE              # EC: positional hole
+
+
+def test_upmap_explicit_and_items():
+    m = build_cluster()
+    pg = PG(1, 11)
+    up0, *_ = m.pg_to_up_acting_osds(pg)
+    # explicit full mapping
+    want = [o for o in range(9) if o not in up0][:3]
+    m.pg_upmap[pg] = want
+    up, upp, *_ = m.pg_to_up_acting_osds(pg)
+    assert up == want and upp == want[0]
+    del m.pg_upmap[pg]
+    # pairwise swap: replace up0[1] with an unused osd
+    repl = [o for o in range(m.max_osd) if o not in up0][0]
+    m.pg_upmap_items[pg] = [(up0[1], repl)]
+    up, *_ = m.pg_to_up_acting_osds(pg)
+    assert up[1] == repl and up[0] == up0[0] and up[2] == up0[2]
+
+
+def test_upmap_rejected_when_target_out():
+    m = build_cluster()
+    pg = PG(1, 13)
+    up0, *_ = m.pg_to_up_acting_osds(pg)
+    repl = [o for o in range(m.max_osd) if o not in up0][0]
+    m.osd_weight[repl] = 0              # target marked out
+    m.pg_upmap[pg] = [repl] + up0[1:]
+    up, *_ = m.pg_to_up_acting_osds(pg)
+    assert up == up0                    # explicit mapping ignored
+    m.pg_upmap_items[pg] = [(up0[0], repl)]
+    up, *_ = m.pg_to_up_acting_osds(pg)
+    assert up == up0                    # item swap ignored too
+
+
+def test_upmap_rejection_skips_items():
+    """A rejected pg_upmap returns early, skipping pg_upmap_items too
+    (OSDMap.cc:2396-2400)."""
+    m = build_cluster()
+    pg = PG(1, 14)
+    up0, *_ = m.pg_to_up_acting_osds(pg)
+    unused = [o for o in range(m.max_osd) if o not in up0]
+    out_osd, valid_repl = unused[0], unused[1]
+    m.osd_weight[out_osd] = 0
+    m.pg_upmap[pg] = [out_osd] + up0[1:]           # rejected (target out)
+    m.pg_upmap_items[pg] = [(up0[0], valid_repl)]  # valid on its own
+    up, *_ = m.pg_to_up_acting_osds(pg)
+    assert up == up0                    # items skipped after rejection
+
+
+def test_primary_affinity_zero_never_primary():
+    m = build_cluster()
+    pg_hits = 0
+    for ps in range(m.pools[1].pg_num):
+        up, upp, *_ = m.pg_to_up_acting_osds(PG(1, ps))
+        if up and up[0] == 0:
+            pg_hits += 1
+    m.set_primary_affinity(0, 0)
+    for ps in range(m.pools[1].pg_num):
+        up, upp, *_ = m.pg_to_up_acting_osds(PG(1, ps))
+        assert not (upp == 0 and any(o != 0 and o != NONE for o in up)), (
+            f"osd.0 stayed primary of pg {ps} despite affinity 0")
+
+
+# -- incrementals -----------------------------------------------------------
+
+def test_incremental_epoch_and_state():
+    m = build_cluster()
+    inc = Incremental(new_state={4: 2},          # XOR UP -> osd.4 down
+                      new_weight={5: 0},
+                      new_pg_temp={PG(1, 1): [6, 7, 8]})
+    n = apply_incremental(m, inc)
+    assert n.epoch == m.epoch + 1
+    assert n.is_down(4) and not m.is_down(4)
+    assert n.is_out(5)
+    assert n.pg_temp[PG(1, 1)] == [6, 7, 8]
+    # clearing pg_temp via empty list
+    n2 = apply_incremental(n, Incremental(new_pg_temp={PG(1, 1): []}))
+    assert PG(1, 1) not in n2.pg_temp
+
+
+# -- bulk vs scalar ---------------------------------------------------------
+
+@pytest.mark.parametrize("pool_id", [1, 2])
+def test_bulk_matches_scalar_clean(pool_id):
+    m = build_cluster()
+    assert_bulk_matches_scalar(m, pool_id)
+
+
+@pytest.mark.parametrize("pool_id", [1, 2])
+def test_bulk_matches_scalar_degraded(pool_id):
+    m = build_cluster(seed=2)
+    rng = np.random.default_rng(11)
+    downs = rng.choice(m.max_osd, size=4, replace=False)
+    for o in downs[:2]:
+        m.osd_state[o] &= ~2            # down
+    for o in downs[2:]:
+        m.osd_weight[o] = 0             # out
+    m.osd_weight[int(downs[0])] = 0x8000  # partial reweight on a down osd
+    assert_bulk_matches_scalar(m, pool_id)
+
+
+@pytest.mark.parametrize("pool_id", [1, 2])
+def test_bulk_matches_scalar_affinity_and_overrides(pool_id):
+    m = build_cluster(seed=4)
+    m.set_primary_affinity(0, 0)
+    m.set_primary_affinity(3, 0x8000)
+    m.set_primary_affinity(7, 0x4000)
+    m.pg_temp[PG(pool_id, 2)] = [8, 7, 6] if pool_id == 1 else [8, 7, 6, 5, 4, 3]
+    m.primary_temp[PG(pool_id, 4)] = 5
+    up0, *_ = m.pg_to_up_acting_osds(PG(pool_id, 5))
+    if up0:
+        repl = [o for o in range(m.max_osd) if o not in up0][0]
+        m.pg_upmap_items[PG(pool_id, 5)] = [(up0[0], repl)]
+    assert_bulk_matches_scalar(m, pool_id)
+
+
+def test_bulk_nonpow2_pg_num():
+    m = build_cluster(seed=6)
+    m.pools[1].pg_num = 24              # non-power-of-two: stable_mod folds
+    m.pools[1].pgp_num = 24
+    assert_bulk_matches_scalar(m, 1)
