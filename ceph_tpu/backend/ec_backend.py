@@ -106,6 +106,9 @@ class RecoveryState(Enum):
     READING = "READING"
     WRITING = "WRITING"
     COMPLETE = "COMPLETE"
+    # a push target died before acking: the object is still degraded there
+    # (the reference's _failed_push path, ECBackend.cc:211-248)
+    FAILED = "FAILED"
 
 
 @dataclass
@@ -129,6 +132,8 @@ class Op:
     remote_reads: dict[str, dict[int, bytes]] = field(default_factory=dict)  # oid -> {logical off: stripe data}
     pending_commit_shards: set[int] = field(default_factory=set)
     cache_claims: list[tuple[str, int]] = field(default_factory=list)
+    # reads unrecoverable with current up set; re-driven by on_shard_up
+    _rmw_stalled: bool = False
 
 
 @dataclass
@@ -174,8 +179,9 @@ class ECBackend:
         self.recovery_ops: dict[str, RecoveryOp] = {}
         self._recovery_read_tids: dict[int, RecoveryOp] = {}
         self.hinfo_cache: dict[str, HashInfo] = {}
-        self.completed_writes: deque[int] = deque(maxlen=1024)
+        self._stalled_recoveries: list[RecoveryOp] = []
         bus.down_listeners.append(self.on_shard_down)
+        bus.up_listeners.append(self.on_shard_up)
 
     # -- helpers -----------------------------------------------------------
 
@@ -230,11 +236,15 @@ class ECBackend:
                 op.pending_read_shards.clear()
                 try:
                     self._start_rmw_reads(op, op._rmw_need)
+                    op._rmw_stalled = False
                 except IOError:
-                    # unrecoverable: too few shards — the op stays queued,
-                    # the PG is effectively down (reference: peering would
-                    # mark the PG incomplete) until shards return
-                    op.pending_read_shards.add(shard)
+                    # unrecoverable: too few shards — the op stays queued
+                    # (the PG is effectively down, like the reference's
+                    # incomplete state) and is re-driven by on_shard_up;
+                    # the -1 sentinel keeps try_reads_to_commit from running
+                    # with missing data (no real reply ever clears it)
+                    op.pending_read_shards.add(-1)
+                    op._rmw_stalled = True
         # client reads: treat like an error reply from that shard
         for rop in list(self.in_progress_reads.values()):
             if shard in rop.pending_shards:
@@ -251,14 +261,39 @@ class ECBackend:
             if shard in rop._pending:
                 del self._recovery_read_tids[tid]
                 rop.state = RecoveryState.IDLE
-                self.continue_recovery_op(rop)
-        # recovery pushes: a dead push target can never ack
+                try:
+                    self.continue_recovery_op(rop)
+                except IOError:
+                    # too few survivors: park; re-driven by on_shard_up
+                    self._stalled_recoveries.append(rop)
+        # recovery pushes: a dead target never acks and is still degraded —
+        # the op FAILS (the reference's _failed_push), it is not COMPLETE
         for oid, rop in list(self.recovery_ops.items()):
             if shard in rop.pending_pushes:
                 rop.pending_pushes.discard(shard)
                 if not rop.pending_pushes and rop.state == RecoveryState.WRITING:
-                    self._finish_recovery_op(rop)
+                    self._finish_recovery_op(rop, failed=True)
         self.try_finish_rmw()
+        self.check_ops()
+
+    def on_shard_up(self, shard: int) -> None:
+        """Re-drive work parked by unrecoverable shard loss once a shard
+        returns (the reference re-peers on the osdmap epoch bump)."""
+        for op in list(self.waiting_reads):
+            if getattr(op, "_rmw_stalled", False):
+                op.pending_read_shards.clear()
+                try:
+                    self._start_rmw_reads(op, op._rmw_need)
+                    op._rmw_stalled = False
+                except IOError:
+                    op.pending_read_shards.add(-1)
+                    op._rmw_stalled = True
+        stalled, self._stalled_recoveries = self._stalled_recoveries, []
+        for rop in stalled:
+            try:
+                self.continue_recovery_op(rop)
+            except IOError:
+                self._stalled_recoveries.append(rop)
         self.check_ops()
 
     # -- write pipeline ----------------------------------------------------
@@ -487,7 +522,6 @@ class ECBackend:
             for oid, tid in op.cache_claims:
                 self.extent_cache.release(oid, tid)
             del self.tid_to_op[op.tid]
-            self.completed_writes.append(op.tid)
             if op.on_commit:
                 op.on_commit(op.tid)
 
@@ -716,11 +750,11 @@ class ECBackend:
         if not rop.pending_pushes and rop.state == RecoveryState.WRITING:
             self._finish_recovery_op(rop)
 
-    def _finish_recovery_op(self, rop: RecoveryOp) -> None:
-        """COMPLETE + drop tracking state so late replies are inert
-        (the reference erases the RecoveryOp from recovery_ops on
-        on_global_recover)."""
-        rop.state = RecoveryState.COMPLETE
+    def _finish_recovery_op(self, rop: RecoveryOp, failed: bool = False) -> None:
+        """COMPLETE (or FAILED) + drop tracking state so late replies are
+        inert (the reference erases the RecoveryOp from recovery_ops on
+        on_global_recover; failures go through _failed_push)."""
+        rop.state = RecoveryState.FAILED if failed else RecoveryState.COMPLETE
         self.recovery_ops.pop(rop.oid, None)
         self._recovery_read_tids.pop(rop.read_tid, None)
         if rop.on_complete:

@@ -86,9 +86,10 @@ class MessageBus:
         self.handlers: dict[int, object] = {}
         self.down: set[int] = set()
         self.delivered = 0
-        # failure notification fan-out: the reference's analog is the osdmap
-        # epoch bump reaching each OSD after heartbeats report the failure
+        # failure/revival notification fan-out: the reference's analog is the
+        # osdmap epoch bump reaching each OSD after heartbeats report it
         self.down_listeners: list = []
+        self.up_listeners: list = []
 
     def register(self, shard: int, handler) -> None:
         self.queues.setdefault(shard, deque())
@@ -105,6 +106,8 @@ class MessageBus:
 
     def mark_up(self, shard: int) -> None:
         self.down.discard(shard)
+        for cb in self.up_listeners:
+            cb(shard)
 
     def send(self, to_shard: int, msg) -> None:
         if to_shard in self.down:

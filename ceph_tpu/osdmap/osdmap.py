@@ -245,6 +245,59 @@ class OSDMap:
     def clone(self) -> "OSDMap":
         return copy.deepcopy(self)
 
+    # -- (de)serialisation (the reference encodes maps as binary blobs;
+    #    this framework uses JSON-able dicts, cf. osdmaptool --dump json) --
+
+    def to_dict(self) -> dict:
+        def pgs(d):
+            return {f"{pg.pool}.{pg.ps}": v for pg, v in d.items()}
+        return {
+            "epoch": self.epoch,
+            "max_osd": self.max_osd,
+            "osd_state": list(self.osd_state),
+            "osd_weight": list(self.osd_weight),
+            "osd_primary_affinity": (
+                None if self.osd_primary_affinity is None
+                else list(self.osd_primary_affinity)),
+            "crush": self.crush.to_dict(),
+            "pools": {str(pid): {
+                "pool_id": p.pool_id, "type": p.type, "size": p.size,
+                "min_size": p.min_size, "pg_num": p.pg_num,
+                "pgp_num": p.pgp_num, "crush_rule": p.crush_rule,
+                "flags": p.flags, "name": p.name,
+                "erasure_code_profile": p.erasure_code_profile,
+            } for pid, p in self.pools.items()},
+            "pg_upmap": pgs(self.pg_upmap),
+            "pg_upmap_items": pgs(self.pg_upmap_items),
+            "pg_temp": pgs(self.pg_temp),
+            "primary_temp": pgs(self.primary_temp),
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "OSDMap":
+        def unpgs(m, conv=lambda v: v):
+            out = {}
+            for key, v in m.items():
+                pool_s, ps_s = key.split(".")
+                out[PG(int(pool_s), int(ps_s))] = conv(v)
+            return out
+        m = cls(crush=CrushMap.from_dict(d["crush"]))
+        m.epoch = d.get("epoch", 1)
+        m.set_max_osd(d["max_osd"])
+        m.osd_state = list(d["osd_state"])
+        m.osd_weight = list(d["osd_weight"])
+        pa = d.get("osd_primary_affinity")
+        m.osd_primary_affinity = None if pa is None else list(pa)
+        for pid_s, pd in d.get("pools", {}).items():
+            m.add_pool(Pool(**pd))
+        m.pg_upmap = unpgs(d.get("pg_upmap", {}), list)
+        m.pg_upmap_items = unpgs(
+            d.get("pg_upmap_items", {}),
+            lambda v: [tuple(x) for x in v])
+        m.pg_temp = unpgs(d.get("pg_temp", {}), list)
+        m.primary_temp = unpgs(d.get("primary_temp", {}), int)
+        return m
+
 
 @dataclass
 class Incremental:

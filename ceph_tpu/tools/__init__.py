@@ -1,0 +1,9 @@
+"""Offline CLI tools: osdmaptool / crushtool equivalents (SURVEY.md §2.3).
+
+The reference evaluates full clusters as pure functions offline
+(src/tools/osdmaptool.cc --test-map-pgs, src/crush/CrushTester.cc via
+crushtool --test); these modules do the same over the JAX bulk mappers."""
+from .osdmaptool import test_map_pgs, device_crush_weights
+from .crushtool import test_rule, test
+
+__all__ = ["test_map_pgs", "device_crush_weights", "test_rule", "test"]

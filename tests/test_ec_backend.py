@@ -625,3 +625,68 @@ class TestReviewRegressions:
             Transaction().remove(a).write(a, 0, b"new"))
         assert st.read(a) == b"new"
         assert st.read(b) == b"bbbb"
+
+    def test_unrecoverable_rmw_parks_then_redrives_on_mark_up(self, cluster):
+        """Too many shard deaths stall the write (PG down); revival
+        re-drives it instead of hanging forever."""
+        backend, bus = cluster
+        base = payload(2 * STRIPE, seed=31)
+        _write(backend, bus, "obj", 0, base)
+        done = []
+        patch = payload(10, seed=32)
+        backend.submit_transaction(PGTransaction().write("obj", 5, patch),
+                                   on_commit=done.append)
+        for s in (1, 2, 3):            # 3 of 6 dead: k=4 unreachable
+            bus.mark_down(s)
+        bus.deliver_all()
+        assert not done                # parked, not crashed
+        bus.mark_up(1)
+        bus.mark_up(2)
+        bus.deliver_all()
+        assert done, "write not re-driven after shards returned"
+        want = bytearray(base)
+        want[5:15] = patch
+        out = _read(backend, bus, "obj", 0, 2 * STRIPE)
+        assert out["result"]["obj"][0][2] == bytes(want)
+
+    def test_unrecoverable_recovery_parks_then_redrives(self, cluster, ec_impl):
+        backend, bus = cluster
+        data = payload(2 * STRIPE, seed=33)
+        _write(backend, bus, "obj", 0, data)
+        lost = GObject("obj", 5)
+        bus.handlers[5].store.queue_transaction(Transaction().remove(lost))
+        rop = backend.recover_object("obj", {5})
+        helpers = [s for s in rop._pending if s != backend.whoami][:2]
+        for s in helpers:
+            bus.mark_down(s)           # second death -> unrecoverable
+        bus.mark_down(4 if 4 not in helpers else 2)
+        assert rop.state != RecoveryState.COMPLETE
+        for s in helpers:
+            bus.mark_up(s)
+        bus.deliver_all()
+        assert rop.state == RecoveryState.COMPLETE
+        want = ecutil.encode(backend.sinfo, ec_impl, data)
+        assert bus.handlers[5].store.read(lost) == want[5].tobytes()
+
+    def test_push_target_death_fails_recovery(self, cluster):
+        """A recovery whose push target dies must report FAILED, not
+        COMPLETE (the shard is still degraded)."""
+        backend, bus = cluster
+        _write(backend, bus, "obj", 0, payload(STRIPE, seed=34))
+        bus.handlers[5].store.queue_transaction(
+            Transaction().remove(GObject("obj", 5)))
+        states = []
+        rop = backend.recover_object("obj", {5},
+                                     on_complete=lambda r: states.append(r.state))
+        # drain reads so the op reaches WRITING with the push in flight
+        for s in list(rop._pending):
+            while bus.deliver_one(s):
+                pass
+        while bus.deliver_one(backend.whoami):
+            pass
+        assert rop.state == RecoveryState.WRITING
+        bus.mark_down(5)               # push target dies before acking
+        bus.deliver_all()
+        assert rop.state == RecoveryState.FAILED
+        assert states == [RecoveryState.FAILED]
+        assert not backend.recovery_ops

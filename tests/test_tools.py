@@ -1,0 +1,135 @@
+"""osdmaptool/crushtool-equivalent CLI tools (SURVEY.md §2.3: the offline
+pure-function cluster evaluators, src/tools/osdmaptool.cc:491-610 and
+src/crush/CrushTester.cc:600-700)."""
+import io
+import json
+
+import numpy as np
+import pytest
+
+from ceph_tpu.crush import (CRUSH_ITEM_NONE, CRUSH_RULE_CHOOSELEAF_FIRSTN,
+                            CRUSH_RULE_EMIT, CRUSH_RULE_TAKE, crush_do_rule)
+from ceph_tpu.osdmap import OSDMap, PG
+from ceph_tpu.tools import test_map_pgs as map_pgs_report
+from ceph_tpu.tools import test_rule as rule_report
+from ceph_tpu.tools.crushtool import main as crushtool_main
+from ceph_tpu.tools.osdmaptool import main as osdmaptool_main
+
+from test_osdmap import build_cluster
+
+
+class TestMapPGs:
+    def test_counts_add_up(self):
+        m = build_cluster()
+        buf = io.StringIO()
+        stats = map_pgs_report(m, out=buf)
+        text = buf.getvalue()
+        assert "pool 1 pg_num 64" in text
+        assert "pool 2 pg_num 48" in text
+        assert "#osd\tcount\tfirst\tprimary" in text
+        # every acting entry counted once: 64*3 + 48*6
+        assert stats["total"] == 64 * 3 + 48 * 6
+        assert sum(stats["primary"]) == 64 + 48
+        assert stats["in"] == m.max_osd
+        assert stats["size_hist"] == {3: 64, 6: 48}
+
+    def test_counts_match_scalar_chain(self):
+        m = build_cluster(seed=9)
+        stats = map_pgs_report(m, pool=1)
+        want = [0] * m.max_osd
+        for ps in range(m.pools[1].pg_num):
+            _, _, acting, _ = m.pg_to_up_acting_osds(PG(1, ps))
+            for o in acting:
+                if o != CRUSH_ITEM_NONE:
+                    want[o] += 1
+        assert stats["count"] == want
+
+    def test_out_osds_excluded_from_table(self):
+        m = build_cluster()
+        m.osd_weight[0] = 0
+        buf = io.StringIO()
+        stats = map_pgs_report(m, out=buf)
+        assert stats["in"] == m.max_osd - 1
+        assert "osd.0\t" not in buf.getvalue()
+
+    def test_dump_format(self):
+        m = build_cluster()
+        buf = io.StringIO()
+        map_pgs_report(m, pool=1, dump=True, out=buf)
+        lines = [ln for ln in buf.getvalue().splitlines()
+                 if "\t" in ln and not ln.startswith("#") and
+                 not ln.startswith("osd.")]
+        assert len(lines) == 64
+        pgid, osds, primary = lines[0].split("\t")
+        assert pgid == "1.0"
+        assert json.loads(osds)  # list literal
+        assert int(primary) >= 0
+
+    def test_cli_roundtrip(self, tmp_path, capsys):
+        m = build_cluster()
+        path = tmp_path / "map.json"
+        path.write_text(json.dumps(m.to_dict()))
+        rc = osdmaptool_main([str(path), "--test-map-pgs", "--print",
+                              "--test-map-pg", "1.7"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "epoch 1" in out
+        assert "pool 1 'rbd' replicated size 3" in out
+        assert " parsed '1.7' -> 1.7" in out
+        assert " avg " in out and " stddev " in out
+
+
+class TestCrushTester:
+    def test_per_device_matches_interpreter(self):
+        m = build_cluster(seed=3)
+        ruleno = m.pools[1].crush_rule
+        res = rule_report(m.crush, ruleno, num_rep=3, min_x=0, max_x=127)
+        want = [0] * m.crush.max_devices
+        for x in range(128):
+            for o in crush_do_rule(m.crush, ruleno, x, 3):
+                if o != CRUSH_ITEM_NONE:
+                    want[o] += 1
+        assert res["per_device"] == want
+        assert res["bad_mappings"] == 0
+        assert res["sizes"] == {3: 128}
+
+    def test_bad_mappings_detected(self):
+        """Asking for more replicas than failure domains yields short/holey
+        results that must be flagged."""
+        m = build_cluster(n_racks=2, hosts_per_rack=2)
+        cmap = m.crush
+        root = max(b.type for b in cmap.buckets.values())
+        root_id = next(b.id for b in cmap.buckets.values() if b.type == 3)
+        ruleno = cmap.add_rule([(CRUSH_RULE_TAKE, root_id, 0),
+                                (CRUSH_RULE_CHOOSELEAF_FIRSTN, 0, 2),
+                                (CRUSH_RULE_EMIT, 0, 0)])
+        res = rule_report(cmap, ruleno, num_rep=3, min_x=0, max_x=63)
+        assert res["bad_mappings"] == 64      # only 2 racks exist
+
+    def test_utilization_expectation_weighted(self):
+        m = build_cluster(seed=5)
+        ruleno = m.pools[1].crush_rule
+        res = rule_report(m.crush, ruleno, num_rep=3, min_x=0, max_x=255)
+        exp = res["expected"]
+        assert exp, "no expectation computed"
+        total_expected = sum(exp.values())
+        assert total_expected == pytest.approx(3 * 256, rel=1e-6)
+        # zero reweight zeroes the expectation
+        w = [0x10000] * m.crush.max_devices
+        w[0] = 0
+        res2 = rule_report(m.crush, ruleno, num_rep=3, min_x=0, max_x=63,
+                         weights=w)
+        assert res2["expected"][0] == 0
+
+    def test_cli(self, tmp_path, capsys):
+        m = build_cluster()
+        path = tmp_path / "crush.json"
+        path.write_text(json.dumps(m.crush.to_dict()))
+        rc = crushtool_main(["-i", str(path), "--test",
+                             "--rule", str(m.pools[1].crush_rule),
+                             "--num-rep", "3", "--max-x", "63",
+                             "--show-statistics", "--show-utilization"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "num_rep 3 result size == 3:\t64/64" in out
+        assert "stored" in out and "expected" in out
