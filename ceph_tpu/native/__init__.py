@@ -1,0 +1,312 @@
+"""ctypes bindings for the native runtime (native/).
+
+The reference's plugin host is C++ loading plugin .so files via dlopen
+(reference: src/erasure-code/ErasureCodePlugin.cc:126-184); here the native
+registry (native/src/registry.cc) implements that exact contract and Python
+binds it with ctypes (no pybind11 in this environment).  The batch queue
+(native/src/batch_queue.cc) is the host side of the TPU sidecar boundary:
+C++ producer threads coalesce stripes, a registered Python callback runs
+the batched JAX dispatch.
+"""
+from __future__ import annotations
+
+import ctypes as C
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "native")
+BUILD_DIR = os.path.join(NATIVE_DIR, "build")
+
+_build_lock = threading.Lock()
+
+
+def build(force: bool = False) -> str:
+    """Run `make -C native` (idempotent); returns the build dir."""
+    with _build_lock:
+        if force or not os.path.exists(
+                os.path.join(BUILD_DIR, "libec_registry.so")):
+            subprocess.run(["make", "-C", NATIVE_DIR],
+                           check=True, capture_output=True)
+    return BUILD_DIR
+
+
+class _CodecOps(C.Structure):
+    _fields_ = [
+        ("create", C.c_void_p),
+        ("destroy", C.c_void_p),
+        ("get_data_chunk_count", C.c_void_p),
+        ("get_chunk_count", C.c_void_p),
+        ("get_chunk_size", C.c_void_p),
+        ("encode", C.c_void_p),
+        ("decode", C.c_void_p),
+        ("minimum_to_decode", C.c_void_p),
+    ]
+
+
+_CREATE = C.CFUNCTYPE(C.c_void_p, C.POINTER(C.c_char_p),
+                      C.POINTER(C.c_char_p), C.c_int, C.c_char_p, C.c_int)
+_DESTROY = C.CFUNCTYPE(None, C.c_void_p)
+_GETINT = C.CFUNCTYPE(C.c_int, C.c_void_p)
+_CHUNKSZ = C.CFUNCTYPE(C.c_uint, C.c_void_p, C.c_uint)
+_ENCODE = C.CFUNCTYPE(C.c_int, C.c_void_p, C.POINTER(C.c_ubyte),
+                      C.POINTER(C.c_ubyte), C.c_size_t)
+_DECODE = C.CFUNCTYPE(C.c_int, C.c_void_p, C.POINTER(C.c_void_p), C.c_size_t,
+                      C.POINTER(C.c_int), C.c_int)
+_MINIMUM = C.CFUNCTYPE(C.c_int, C.c_void_p, C.POINTER(C.c_int), C.c_int,
+                       C.POINTER(C.c_int), C.c_int, C.POINTER(C.c_int),
+                       C.c_int)
+
+
+class NativeRegistry:
+    """Binding for libec_registry.so (the dlopen plugin host)."""
+
+    _instance = None
+
+    def __init__(self):
+        build()
+        self.lib = C.CDLL(os.path.join(BUILD_DIR, "libec_registry.so"))
+        self.lib.ec_registry_load.argtypes = [C.c_char_p, C.c_char_p,
+                                              C.c_char_p, C.c_int]
+        self.lib.ec_registry_get.restype = C.POINTER(_CodecOps)
+        self.lib.ec_registry_get.argtypes = [C.c_char_p]
+        self.lib.ec_registry_count.restype = C.c_int
+        self.lib.ec_registry_preload.argtypes = [C.c_char_p, C.c_char_p,
+                                                 C.c_char_p, C.c_int]
+
+    @classmethod
+    def instance(cls) -> "NativeRegistry":
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def load(self, name: str, directory: str | None = None) -> None:
+        err = C.create_string_buffer(512)
+        rc = self.lib.ec_registry_load(
+            name.encode(), (directory or BUILD_DIR).encode(), err, 512)
+        if rc != 0:
+            raise IOError(rc, err.value.decode() or f"load {name} failed")
+
+    def preload(self, names_csv: str, directory: str | None = None) -> None:
+        err = C.create_string_buffer(512)
+        rc = self.lib.ec_registry_preload(
+            names_csv.encode(), (directory or BUILD_DIR).encode(), err, 512)
+        if rc != 0:
+            raise IOError(rc, err.value.decode() or "preload failed")
+
+    def count(self) -> int:
+        return self.lib.ec_registry_count()
+
+    def factory(self, name: str, profile: dict[str, str],
+                directory: str | None = None) -> "NativeCodec":
+        """registry.factory (ErasureCodePlugin.cc:92-120): load on demand,
+        instantiate with the profile."""
+        ops = self.lib.ec_registry_get(name.encode())
+        if not ops:
+            self.load(name, directory)
+            ops = self.lib.ec_registry_get(name.encode())
+        if not ops:
+            raise IOError(f"plugin {name} not registered after load")
+        return NativeCodec(ops.contents, profile)
+
+
+class NativeCodec:
+    """One codec instance behind the C vtable."""
+
+    def __init__(self, ops: _CodecOps, profile: dict[str, str]):
+        self._ops = ops
+        self._create = _CREATE(ops.create)
+        self._destroy = _DESTROY(ops.destroy)
+        self._k_fn = _GETINT(ops.get_data_chunk_count)
+        self._n_fn = _GETINT(ops.get_chunk_count)
+        self._chunk_size = _CHUNKSZ(ops.get_chunk_size)
+        self._encode = _ENCODE(ops.encode)
+        self._decode = _DECODE(ops.decode)
+        self._minimum = _MINIMUM(ops.minimum_to_decode)
+
+        keys = (C.c_char_p * len(profile))(
+            *[k.encode() for k in profile])
+        vals = (C.c_char_p * len(profile))(
+            *[str(v).encode() for v in profile.values()])
+        err = C.create_string_buffer(256)
+        self._h = self._create(keys, vals, len(profile), err, 256)
+        if not self._h:
+            raise ValueError(err.value.decode() or "codec init failed")
+
+    def __del__(self):
+        h = getattr(self, "_h", None)
+        if h:
+            self._destroy(h)
+            self._h = None
+
+    @property
+    def k(self) -> int:
+        return self._k_fn(self._h)
+
+    @property
+    def n(self) -> int:
+        return self._n_fn(self._h)
+
+    def get_chunk_size(self, object_size: int) -> int:
+        return self._chunk_size(self._h, object_size)
+
+    def encode(self, data: np.ndarray) -> np.ndarray:
+        """data [k, chunk] uint8 -> parity [m, chunk]."""
+        data = np.ascontiguousarray(data, dtype=np.uint8)
+        k, chunk = data.shape
+        assert k == self.k, f"expected {self.k} data chunks"
+        parity = np.zeros((self.n - k, chunk), dtype=np.uint8)
+        rc = self._encode(
+            self._h, data.ctypes.data_as(C.POINTER(C.c_ubyte)),
+            parity.ctypes.data_as(C.POINTER(C.c_ubyte)), chunk)
+        if rc != 0:
+            raise IOError(rc, "encode failed")
+        return parity
+
+    def decode(self, chunks: dict[int, np.ndarray],
+               erasures: list[int], chunk_size: int) -> dict[int, np.ndarray]:
+        """chunks: available chunk id -> [chunk] uint8; returns the
+        reconstructed chunks for `erasures`."""
+        n = self.n
+        bufs: list[np.ndarray | None] = [None] * n
+        ptrs = (C.c_void_p * n)()
+        for i, arr in chunks.items():
+            arr = np.ascontiguousarray(arr, dtype=np.uint8)
+            assert arr.nbytes == chunk_size
+            bufs[i] = arr
+            ptrs[i] = arr.ctypes.data
+        out = {}
+        for e in erasures:
+            buf = np.zeros(chunk_size, dtype=np.uint8)
+            bufs[e] = buf
+            ptrs[e] = buf.ctypes.data
+            out[e] = buf
+        er = (C.c_int * len(erasures))(*erasures)
+        rc = self._decode(self._h, ptrs, chunk_size, er, len(erasures))
+        if rc != 0:
+            raise IOError(rc, "decode failed")
+        return out
+
+    def minimum_to_decode(self, erasures: list[int],
+                          available: list[int]) -> list[int]:
+        er = (C.c_int * len(erasures))(*erasures)
+        av = (C.c_int * len(available))(*available)
+        out = (C.c_int * self.k)()
+        got = self._minimum(self._h, er, len(erasures), av, len(available),
+                            out, self.k)
+        if got < 0:
+            raise IOError(got, "cannot decode")
+        return list(out[:got])
+
+
+_BATCH_FN = C.CFUNCTYPE(C.c_int, C.c_void_p, C.POINTER(C.c_ubyte),
+                        C.POINTER(C.c_ubyte), C.c_size_t, C.c_size_t)
+_DONE_FN = C.CFUNCTYPE(None, C.c_void_p, C.c_int)
+
+
+class BatchQueue:
+    """Binding for the stripe-batching dispatch queue (batch_queue.cc).
+
+    ``fn(data, n_stripes, chunk) -> parity`` is the batched encode —
+    typically the JAX device dispatch over ``[n_stripes, k, chunk]``.
+    """
+
+    def __init__(self, k: int, m: int, chunk_size: int, fn,
+                 max_batch: int = 256):
+        build()
+        self.lib = C.CDLL(os.path.join(BUILD_DIR, "libec_batch.so"))
+        self.lib.ec_batch_queue_create.restype = C.c_void_p
+        self.lib.ec_batch_queue_create.argtypes = [
+            C.c_int, C.c_int, C.c_size_t, C.c_size_t, _BATCH_FN, C.c_void_p]
+        self.lib.ec_batch_queue_submit.argtypes = [
+            C.c_void_p, C.POINTER(C.c_ubyte), C.POINTER(C.c_ubyte),
+            _DONE_FN, C.c_void_p]
+        self.lib.ec_batch_queue_flush.argtypes = [C.c_void_p]
+        self.lib.ec_batch_queue_destroy.argtypes = [C.c_void_p]
+        self.lib.ec_batch_queue_batches.restype = C.c_size_t
+        self.lib.ec_batch_queue_batches.argtypes = [C.c_void_p]
+        self.lib.ec_batch_queue_stripes.restype = C.c_size_t
+        self.lib.ec_batch_queue_stripes.argtypes = [C.c_void_p]
+
+        self.k, self.m, self.chunk = k, m, chunk_size
+        self._fn = fn
+        self._err: list[BaseException] = []
+
+        def trampoline(_ctx, data_p, parity_p, n_stripes, chunk):
+            try:
+                data = np.ctypeslib.as_array(
+                    data_p, shape=(n_stripes, k, chunk))
+                parity = fn(data, n_stripes, chunk)
+                parity = np.ascontiguousarray(parity, dtype=np.uint8) \
+                    .reshape(n_stripes, m, chunk)
+                C.memmove(parity_p, parity.ctypes.data, parity.nbytes)
+                return 0
+            except BaseException as e:      # noqa: BLE001 - crosses C ABI
+                self._err.append(e)
+                return -1
+        self._trampoline = _BATCH_FN(trampoline)   # keep a reference!
+        self._done_keep: dict[int, object] = {}
+        self._retired: list[int] = []
+        self._q = self.lib.ec_batch_queue_create(
+            k, m, chunk_size, max_batch, self._trampoline, None)
+
+    def _reap(self) -> None:
+        """Free retired per-stripe callbacks.  Only called when the worker
+        is provably outside them (after flush's idle barrier / after
+        destroy joins) — freeing a CFUNCTYPE thunk from inside its own
+        invocation is a use-after-free."""
+        while self._retired:
+            self._done_keep.pop(self._retired.pop(), None)
+
+    def submit(self, data: np.ndarray, on_done=None) -> np.ndarray:
+        """Queue one stripe [k, chunk]; returns the parity buffer that will
+        be filled once the batch containing this stripe dispatches."""
+        data = np.ascontiguousarray(data, dtype=np.uint8)
+        parity = np.zeros((self.m, self.chunk), dtype=np.uint8)
+        key = id(parity)
+
+        def done(_ctx, rc):
+            # do NOT free the entry here: this very callback's thunk lives
+            # in it; mark it for _reap at the next safe point
+            self._retired.append(key)
+            if on_done is not None:
+                on_done(rc)
+        cb = _DONE_FN(done)
+        # keep data/parity/callback alive until the batch completes
+        self._done_keep[key] = (data, parity, cb)
+        rc = self.lib.ec_batch_queue_submit(
+            self._q, data.ctypes.data_as(C.POINTER(C.c_ubyte)),
+            parity.ctypes.data_as(C.POINTER(C.c_ubyte)), cb, None)
+        if rc != 0:
+            raise IOError("queue stopped")
+        return parity
+
+    def flush(self) -> None:
+        self.lib.ec_batch_queue_flush(self._q)
+        self._reap()                 # idle barrier passed: thunks are quiet
+        if self._err:
+            raise self._err.pop()
+
+    @property
+    def batches(self) -> int:
+        return self.lib.ec_batch_queue_batches(self._q)
+
+    @property
+    def stripes(self) -> int:
+        return self.lib.ec_batch_queue_stripes(self._q)
+
+    def close(self) -> None:
+        if getattr(self, "_q", None):
+            self.lib.ec_batch_queue_destroy(self._q)   # joins the worker
+            self._q = None
+            self._reap()
+
+    def __del__(self):
+        self.close()
+
+
+__all__ = ["build", "NativeRegistry", "NativeCodec", "BatchQueue",
+           "BUILD_DIR", "NATIVE_DIR"]

@@ -1,0 +1,90 @@
+"""cpp_rs: the native C++ Reed-Solomon codec as a framework plugin.
+
+Wraps the native runtime (native/src/plugin_cpp_rs.cc, loaded through the
+C registry's dlopen contract, see ceph_tpu/native) in the Python plugin
+interface — the same layering as the reference, where the C++ isa plugin
+wraps the isa-l assembly kernels (reference:
+src/erasure-code/isa/ErasureCodeIsa.cc).  This is the synchronous CPU path:
+single-stripe latency without a device dispatch; the jax_rs plugin is the
+batched TPU path.
+
+Profile: k, m, technique in {reed_sol_van (default), cauchy,
+vandermonde_isa}.
+"""
+from __future__ import annotations
+
+from typing import Mapping
+
+import numpy as np
+
+from .. import __version__
+from .base import ErasureCode
+from .interface import ErasureCodeProfile
+from .registry import ErasureCodePlugin, ErasureCodePluginRegistry
+
+
+class ErasureCodeCppRS(ErasureCode):
+    def __init__(self):
+        super().__init__()
+        self.k = 7
+        self.m = 3
+        self._codec = None
+
+    def init(self, profile: ErasureCodeProfile) -> None:
+        super().init(profile)
+        from ..native import NativeRegistry
+        self.parse_mapping(profile)
+        self.k = self.to_int("k", profile, "7")
+        self.m = self.to_int("m", profile, "3")
+        if self.chunk_mapping and len(self.chunk_mapping) != self.k + self.m:
+            raise ValueError(
+                f"mapping {profile.get('mapping')} maps "
+                f"{len(self.chunk_mapping)} chunks instead of {self.k + self.m}")
+        technique = self.to_string("technique", profile, "reed_sol_van")
+        self.sanity_check_k_m(self.k, self.m)
+        self._codec = NativeRegistry.instance().factory(
+            "cpp_rs", {"k": self.k, "m": self.m, "technique": technique})
+        profile["plugin"] = profile.get("plugin", "cpp_rs")
+        self._profile = profile
+
+    def get_chunk_count(self) -> int:
+        return self.k + self.m
+
+    def get_data_chunk_count(self) -> int:
+        return self.k
+
+    def encode_chunks(self, want_to_encode: set,
+                      encoded: dict[int, np.ndarray]) -> None:
+        data = np.stack([encoded[self.chunk_index(i)]
+                         for i in range(self.k)])
+        parity = self._codec.encode(data)
+        for i in range(self.m):
+            encoded[self.chunk_index(self.k + i)][:] = parity[i]
+
+    def decode_chunks(self, want_to_read: set,
+                      chunks: Mapping[int, np.ndarray],
+                      decoded: dict[int, np.ndarray]) -> None:
+        n = self.k + self.m
+        erasures = [i for i in range(n) if i not in chunks]
+        if not erasures:
+            return
+        chunk_size = next(iter(chunks.values())).nbytes
+        out = self._codec.decode(dict(chunks), erasures, chunk_size)
+        for e, buf in out.items():
+            decoded[e][:] = buf
+
+
+class ErasureCodePluginCppRS(ErasureCodePlugin):
+    def factory(self, directory: str,
+                profile: ErasureCodeProfile) -> ErasureCodeCppRS:
+        instance = ErasureCodeCppRS()
+        instance.init(dict(profile))
+        return instance
+
+
+def __erasure_code_version__() -> str:
+    return __version__
+
+
+def __erasure_code_init__(name: str, directory: str) -> None:
+    ErasureCodePluginRegistry.instance().add(name, ErasureCodePluginCppRS())

@@ -1,0 +1,151 @@
+/* Stripe-batching dispatch queue: the host side of the TPU sidecar boundary.
+ *
+ * The reference encodes one stripe per call from the OSD write pipeline
+ * (reference: src/osd/ECUtil.cc:136-148 — the per-stripe loop SURVEY.md §2.2
+ * flags as the TPU batching hook).  This queue restructures that: producer
+ * threads (the PG workers) submit stripes; a collector thread coalesces
+ * them into one contiguous [n_stripes, k, chunk] batch and hands it to a
+ * registered callback — the JAX sidecar's batched device dispatch — then
+ * completes each stripe's ticket.  Dispatch fires when `max_batch` stripes
+ * are pending or when the queue drains (adaptive batching, the same
+ * accumulate-then-launch economics as SURVEY.md §7 step 3).
+ *
+ * C ABI so Python can drive it via ctypes and register a CFUNCTYPE callback.
+ */
+#include <condition_variable>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+/* batch callback: data = n_stripes contiguous stripes of k*chunk bytes,
+ * parity_out = n_stripes contiguous stripes of m*chunk bytes.
+ * Returns 0 on success (nonzero fails every stripe in the batch). */
+typedef int (*ec_batch_fn)(void *ctx, const unsigned char *data,
+                           unsigned char *parity_out, size_t n_stripes,
+                           size_t chunk_size);
+typedef void (*ec_done_fn)(void *done_ctx, int rc);
+
+struct ec_batch_queue;
+ec_batch_queue *ec_batch_queue_create(int k, int m, size_t chunk_size,
+                                      size_t max_batch, ec_batch_fn fn,
+                                      void *ctx);
+void ec_batch_queue_destroy(ec_batch_queue *);
+int ec_batch_queue_submit(ec_batch_queue *, const unsigned char *data,
+                          unsigned char *parity_out, ec_done_fn done,
+                          void *done_ctx);
+void ec_batch_queue_flush(ec_batch_queue *);
+size_t ec_batch_queue_batches(ec_batch_queue *);
+size_t ec_batch_queue_stripes(ec_batch_queue *);
+
+}  /* extern "C" */
+
+namespace {
+struct Job {
+    const unsigned char *data;
+    unsigned char *parity_out;
+    ec_done_fn done;
+    void *done_ctx;
+};
+}  // namespace
+
+struct ec_batch_queue {
+    int k, m;
+    size_t chunk, max_batch;
+    ec_batch_fn fn;
+    void *ctx;
+
+    std::mutex mu;
+    std::condition_variable cv, idle_cv;
+    std::deque<Job> jobs;
+    bool stop = false;
+    size_t inflight = 0;
+    size_t n_batches = 0, n_stripes = 0;
+    std::thread worker;
+
+    void run() {
+        std::unique_lock<std::mutex> l(mu);
+        std::vector<unsigned char> in_buf, out_buf;
+        while (true) {
+            cv.wait(l, [&] { return stop || !jobs.empty(); });
+            if (stop && jobs.empty()) return;
+            size_t take = jobs.size() < max_batch ? jobs.size() : max_batch;
+            std::vector<Job> batch(jobs.begin(), jobs.begin() + take);
+            jobs.erase(jobs.begin(), jobs.begin() + take);
+            inflight += take;
+            l.unlock();
+
+            size_t dsz = (size_t)k * chunk, psz = (size_t)m * chunk;
+            in_buf.resize(take * dsz);
+            out_buf.resize(take * psz);
+            for (size_t i = 0; i < take; i++)
+                std::memcpy(&in_buf[i * dsz], batch[i].data, dsz);
+            int rc = fn(ctx, in_buf.data(), out_buf.data(), take, chunk);
+            for (size_t i = 0; i < take; i++) {
+                if (rc == 0)
+                    std::memcpy(batch[i].parity_out, &out_buf[i * psz], psz);
+                if (batch[i].done) batch[i].done(batch[i].done_ctx, rc);
+            }
+
+            l.lock();
+            inflight -= take;
+            n_batches++;
+            n_stripes += take;
+            if (jobs.empty() && inflight == 0) idle_cv.notify_all();
+        }
+    }
+};
+
+ec_batch_queue *ec_batch_queue_create(int k, int m, size_t chunk_size,
+                                      size_t max_batch, ec_batch_fn fn,
+                                      void *ctx) {
+    auto *q = new ec_batch_queue;
+    q->k = k;
+    q->m = m;
+    q->chunk = chunk_size;
+    q->max_batch = max_batch ? max_batch : 256;
+    q->fn = fn;
+    q->ctx = ctx;
+    q->worker = std::thread([q] { q->run(); });
+    return q;
+}
+
+void ec_batch_queue_destroy(ec_batch_queue *q) {
+    {
+        std::lock_guard<std::mutex> l(q->mu);
+        q->stop = true;
+    }
+    q->cv.notify_all();
+    q->worker.join();
+    delete q;
+}
+
+int ec_batch_queue_submit(ec_batch_queue *q, const unsigned char *data,
+                          unsigned char *parity_out, ec_done_fn done,
+                          void *done_ctx) {
+    {
+        std::lock_guard<std::mutex> l(q->mu);
+        if (q->stop) return -1;
+        q->jobs.push_back(Job{data, parity_out, done, done_ctx});
+    }
+    q->cv.notify_one();
+    return 0;
+}
+
+void ec_batch_queue_flush(ec_batch_queue *q) {
+    std::unique_lock<std::mutex> l(q->mu);
+    q->idle_cv.wait(l, [&] { return q->jobs.empty() && q->inflight == 0; });
+}
+
+size_t ec_batch_queue_batches(ec_batch_queue *q) {
+    std::lock_guard<std::mutex> l(q->mu);
+    return q->n_batches;
+}
+
+size_t ec_batch_queue_stripes(ec_batch_queue *q) {
+    std::lock_guard<std::mutex> l(q->mu);
+    return q->n_stripes;
+}

@@ -1,0 +1,229 @@
+/* See gf8.h.  Table generation mirrors ceph_tpu/gf/tables.py exactly. */
+#include "gf8.h"
+
+#include <algorithm>
+#include <cstring>
+#include <mutex>
+
+namespace gf8 {
+
+uint8_t EXP[512];
+uint8_t LOG[256];
+uint8_t MUL[256][256];
+
+void init_tables() {
+    /* thread-safe once-init: concurrent rs_create calls arrive with the
+     * GIL released (ctypes), so a plain bool guard would race */
+    static std::once_flag once;
+    std::call_once(once, [] {
+        int x = 1;
+        for (int i = 0; i < 255; i++) {
+            EXP[i] = (uint8_t)x;
+            LOG[x] = (uint8_t)i;
+            x <<= 1;
+            if (x & 0x100) x ^= POLY;
+        }
+        std::memcpy(EXP + 255, EXP, 255);
+        LOG[0] = 0;
+        for (int a = 0; a < 256; a++) {
+            MUL[0][a] = MUL[a][0] = 0;
+            for (int b = 1; b <= a; b++)
+                MUL[a][b] = MUL[b][a] = (a == 0) ? 0 : EXP[LOG[a] + LOG[b]];
+        }
+    });
+}
+
+uint8_t inv(uint8_t a) {
+    if (a == 0) return 0;             /* callers must not invert 0 */
+    return EXP[255 - LOG[a]];
+}
+
+uint8_t gfpow(uint8_t a, int n) {
+    if (n == 0) return 1;
+    if (a == 0) return 0;
+    return EXP[(LOG[a] * (long)n) % 255];
+}
+
+Matrix rs_vandermonde_isa(int k, int m) {
+    /* row r, col j = (2^r)^j (ErasureCodeIsa.cc:384 gf_gen_rs_matrix) */
+    Matrix a((size_t)m * k);
+    uint8_t gen = 1;
+    for (int r = 0; r < m; r++) {
+        uint8_t p = 1;
+        for (int j = 0; j < k; j++) {
+            a[(size_t)r * k + j] = p;
+            p = mul(p, gen);
+        }
+        gen = mul(gen, 2);
+    }
+    return a;
+}
+
+Matrix cauchy1(int k, int m) {
+    /* row i, col j = inv((i+k) ^ j) (gf_gen_cauchy1_matrix) */
+    Matrix a((size_t)m * k);
+    for (int i = 0; i < m; i++)
+        for (int j = 0; j < k; j++)
+            a[(size_t)i * k + j] = inv((uint8_t)((i + k) ^ j));
+    return a;
+}
+
+bool invert(const Matrix &in, Matrix &out, int n) {
+    std::vector<uint8_t> aug((size_t)n * 2 * n, 0);
+    for (int r = 0; r < n; r++) {
+        std::memcpy(&aug[(size_t)r * 2 * n], &in[(size_t)r * n], n);
+        aug[(size_t)r * 2 * n + n + r] = 1;
+    }
+    for (int col = 0; col < n; col++) {
+        int piv = col;
+        while (piv < n && aug[(size_t)piv * 2 * n + col] == 0) piv++;
+        if (piv == n) return false;
+        if (piv != col)
+            for (int j = 0; j < 2 * n; j++)
+                std::swap(aug[(size_t)col * 2 * n + j],
+                          aug[(size_t)piv * 2 * n + j]);
+        uint8_t v = aug[(size_t)col * 2 * n + col];
+        if (v != 1) {
+            uint8_t iv = inv(v);
+            for (int j = 0; j < 2 * n; j++)
+                aug[(size_t)col * 2 * n + j] =
+                    mul(aug[(size_t)col * 2 * n + j], iv);
+        }
+        for (int r = 0; r < n; r++) {
+            uint8_t t = aug[(size_t)r * 2 * n + col];
+            if (r != col && t != 0)
+                for (int j = 0; j < 2 * n; j++)
+                    aug[(size_t)r * 2 * n + j] ^=
+                        mul(aug[(size_t)col * 2 * n + j], t);
+        }
+    }
+    out.assign((size_t)n * n, 0);
+    for (int r = 0; r < n; r++)
+        std::memcpy(&out[(size_t)r * n], &aug[(size_t)r * 2 * n + n], n);
+    return true;
+}
+
+Matrix matmul(const Matrix &a, int ar, int ac, const Matrix &b, int bc) {
+    Matrix out((size_t)ar * bc, 0);
+    for (int i = 0; i < ar; i++)
+        for (int j = 0; j < ac; j++) {
+            uint8_t v = a[(size_t)i * ac + j];
+            if (!v) continue;
+            const uint8_t *row = MUL[v];
+            for (int c = 0; c < bc; c++)
+                out[(size_t)i * bc + c] ^= row[b[(size_t)j * bc + c]];
+        }
+    return out;
+}
+
+Matrix rs_vandermonde_jerasure(int k, int m) {
+    /* systematic extended-Vandermonde, first parity row scaled to ones
+     * (Plank & Ding 2003; matches ceph_tpu/gf/matrix.py) */
+    int rows = k + m;
+    Matrix vdm((size_t)rows * k);
+    for (int i = 0; i < rows; i++) {
+        vdm[(size_t)i * k] = 1;
+        for (int j = 1; j < k; j++)
+            vdm[(size_t)i * k + j] = mul(vdm[(size_t)i * k + j - 1],
+                                         (uint8_t)i);
+    }
+    Matrix top((size_t)k * k);
+    std::memcpy(top.data(), vdm.data(), (size_t)k * k);
+    Matrix top_inv;
+    if (!invert(top, top_inv, k)) return Matrix();
+    Matrix bottom((size_t)m * k);
+    std::memcpy(bottom.data(), &vdm[(size_t)k * k], (size_t)m * k);
+    Matrix parity = matmul(bottom, m, k, top_inv, k);
+    for (int r = 0; r < m; r++) {
+        uint8_t first = parity[(size_t)r * k];
+        if (first == 0) return Matrix();   /* degenerate */
+        if (first != 1) {
+            uint8_t iv = inv(first);
+            for (int j = 0; j < k; j++)
+                parity[(size_t)r * k + j] = mul(parity[(size_t)r * k + j], iv);
+        }
+    }
+    return parity;
+}
+
+bool decode_matrix(const Matrix &parity, int k, int m,
+                   const std::vector<int> &erasures,
+                   const std::vector<int> &available,
+                   Matrix &rows, std::vector<int> &src) {
+    std::vector<char> erased(k + m, 0);
+    for (int e : erasures) erased[e] = 1;
+    src.clear();
+    for (int a : available)
+        if (!erased[a] && (int)src.size() < k) src.push_back(a);
+    if ((int)src.size() < k) return false;
+
+    /* generator rows of the survivors */
+    Matrix sub((size_t)k * k, 0);
+    for (int r = 0; r < k; r++) {
+        int id = src[r];
+        if (id < k)
+            sub[(size_t)r * k + id] = 1;
+        else
+            std::memcpy(&sub[(size_t)r * k], &parity[(size_t)(id - k) * k], k);
+    }
+    Matrix invm;
+    if (!invert(sub, invm, k)) return false;
+
+    rows.assign(erasures.size() * (size_t)k, 0);
+    size_t out_r = 0;
+    std::vector<int> sorted_erasures(erasures.begin(), erasures.end());
+    std::sort(sorted_erasures.begin(), sorted_erasures.end());
+    for (int e : sorted_erasures) {
+        if (e < k) {
+            std::memcpy(&rows[out_r * k], &invm[(size_t)e * k], k);
+        } else {
+            Matrix prow((size_t)k);
+            std::memcpy(prow.data(), &parity[(size_t)(e - k) * k], k);
+            Matrix res = matmul(prow, 1, k, invm, k);
+            std::memcpy(&rows[out_r * k], res.data(), k);
+        }
+        out_r++;
+    }
+    return true;
+}
+
+void apply_matrix(const uint8_t *coef, int nout, int nin,
+                  const uint8_t *in, uint8_t *out, size_t chunk_size) {
+    for (int r = 0; r < nout; r++) {
+        uint8_t *dst = out + (size_t)r * chunk_size;
+        std::memset(dst, 0, chunk_size);
+        for (int j = 0; j < nin; j++) {
+            uint8_t c = coef[(size_t)r * nin + j];
+            if (!c) continue;
+            const uint8_t *row = MUL[c];
+            const uint8_t *srcp = in + (size_t)j * chunk_size;
+            if (c == 1) {
+                for (size_t i = 0; i < chunk_size; i++) dst[i] ^= srcp[i];
+            } else {
+                for (size_t i = 0; i < chunk_size; i++) dst[i] ^= row[srcp[i]];
+            }
+        }
+    }
+}
+
+void apply_matrix_ptrs(const uint8_t *coef, int nout, int nin,
+                       const uint8_t *const *in, uint8_t *const *out,
+                       size_t chunk_size) {
+    for (int r = 0; r < nout; r++) {
+        uint8_t *dst = out[r];
+        std::memset(dst, 0, chunk_size);
+        for (int j = 0; j < nin; j++) {
+            uint8_t c = coef[(size_t)r * nin + j];
+            if (!c) continue;
+            const uint8_t *row = MUL[c];
+            const uint8_t *srcp = in[j];
+            if (c == 1) {
+                for (size_t i = 0; i < chunk_size; i++) dst[i] ^= srcp[i];
+            } else {
+                for (size_t i = 0; i < chunk_size; i++) dst[i] ^= row[srcp[i]];
+            }
+        }
+    }
+}
+
+}  // namespace gf8

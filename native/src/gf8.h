@@ -1,0 +1,63 @@
+/* GF(2^8) arithmetic, RS matrices, and region encode/decode kernels.
+ *
+ * Native analog of ceph_tpu/gf (poly 0x11D, the jerasure w=8 field) —
+ * bit-identical tables and matrix constructions so the C++ fallback path
+ * and the JAX device path produce the same chunks.  Matrix semantics cite
+ * the reference: gf_gen_rs_matrix / gf_gen_cauchy1_matrix usage at
+ * src/erasure-code/isa/ErasureCodeIsa.cc:384-387, decode-matrix
+ * construction at :227-307.
+ */
+#ifndef CEPH_TPU_GF8_H
+#define CEPH_TPU_GF8_H
+
+#include <cstddef>
+#include <cstdint>
+#include <vector>
+
+namespace gf8 {
+
+constexpr uint16_t POLY = 0x11D;
+
+extern uint8_t EXP[512];
+extern uint8_t LOG[256];      /* LOG[0] undefined; callers special-case 0 */
+extern uint8_t MUL[256][256];
+
+void init_tables();           /* idempotent */
+
+inline uint8_t mul(uint8_t a, uint8_t b) { return MUL[a][b]; }
+uint8_t inv(uint8_t a);
+uint8_t gfpow(uint8_t a, int n);
+
+using Matrix = std::vector<uint8_t>;  /* row-major */
+
+/* parity matrices [m, k] */
+Matrix rs_vandermonde_isa(int k, int m);
+Matrix cauchy1(int k, int m);
+Matrix rs_vandermonde_jerasure(int k, int m);
+
+/* [n, n] Gauss-Jordan inverse; returns false when singular */
+bool invert(const Matrix &in, Matrix &out, int n);
+/* [a_r, a_c] x [a_c, b_c] */
+Matrix matmul(const Matrix &a, int ar, int ac, const Matrix &b, int bc);
+
+/* decode matrix for erased chunk ids given the parity matrix:
+ * returns rows [n_erased, k] and fills src with the k surviving chunk ids
+ * used as inputs (first k survivors in ascending order,
+ * ErasureCodeIsa.cc:227-307 semantics) */
+bool decode_matrix(const Matrix &parity, int k, int m,
+                   const std::vector<int> &erasures,
+                   const std::vector<int> &available,
+                   Matrix &rows, std::vector<int> &src);
+
+/* region ops: out[r] ^= sum_j coef[r,j] * in[j] over chunk_size bytes.
+ * in = nin contiguous chunks, out = nout contiguous chunks. */
+void apply_matrix(const uint8_t *coef, int nout, int nin,
+                  const uint8_t *in, uint8_t *out, size_t chunk_size);
+/* gather variant: input chunks via pointer array */
+void apply_matrix_ptrs(const uint8_t *coef, int nout, int nin,
+                       const uint8_t *const *in, uint8_t *const *out,
+                       size_t chunk_size);
+
+}  // namespace gf8
+
+#endif

@@ -1,0 +1,205 @@
+/* libec_cpp_rs.so: native Reed-Solomon GF(2^8) codec plugin.
+ *
+ * The framework's CPU-side sibling of the reference's isa/jerasure plugins
+ * (reference: src/erasure-code/isa/ErasureCodeIsa.cc — technique selection
+ * :36-38, decode-table LRU keyed by erasure signature :227-304, parameter
+ * envelope :323-364; src/erasure-code/jerasure/ErasureCodeJerasure.cc —
+ * reed_sol_van defaults :81).  Serves as the synchronous fallback path of
+ * the TPU plugin (single-stripe latency) and as the registry's
+ * proof-of-contract plugin.  Profile keys: k, m, technique
+ * (reed_sol_van | cauchy | vandermonde_isa).
+ */
+#include "../include/ec_abi.h"
+#include "gf8.h"
+
+#include <algorithm>
+#include <cerrno>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <list>
+#include <map>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr unsigned SIMD_ALIGN = 32;   /* ErasureCode.cc:42 */
+constexpr int DECODE_LRU_CAP = 2516;  /* ErasureCodeIsaTableCache.h:46-48 */
+
+struct Codec;
+struct CachedDecode {
+    gf8::Matrix rows;
+    std::vector<int> src;
+};
+
+struct Codec {
+    int k = 0, m = 0;
+    gf8::Matrix parity;               /* [m, k] */
+    /* decode-table LRU keyed by erasure signature, the reference's
+     * ErasureCodeIsaTableCache scheme (ErasureCodeIsa.cc:227-304) */
+    std::mutex lru_mutex;
+    std::map<std::string, std::pair<CachedDecode,
+        std::list<std::string>::iterator>> cache;
+    std::list<std::string> lru;
+};
+
+ec_codec *rs_create(const char *const *keys, const char *const *vals,
+                    int nprof, char *errbuf, int errlen) {
+    gf8::init_tables();
+    int k = 7, m = 3;                 /* reed_sol_van defaults (:81) */
+    std::string technique = "reed_sol_van";
+    for (int i = 0; i < nprof; i++) {
+        if (!std::strcmp(keys[i], "k")) k = std::atoi(vals[i]);
+        else if (!std::strcmp(keys[i], "m")) m = std::atoi(vals[i]);
+        else if (!std::strcmp(keys[i], "technique")) technique = vals[i];
+    }
+    if (k < 1 || m < 1 || k + m > 256) {
+        if (errbuf) std::snprintf(errbuf, errlen,
+                                  "bad k=%d m=%d (k+m must be <= 256)", k, m);
+        return nullptr;
+    }
+    auto *c = new Codec;
+    c->k = k;
+    c->m = m;
+    if (technique == "cauchy")
+        c->parity = gf8::cauchy1(k, m);
+    else if (technique == "vandermonde_isa")
+        c->parity = gf8::rs_vandermonde_isa(k, m);
+    else if (technique == "reed_sol_van")
+        c->parity = gf8::rs_vandermonde_jerasure(k, m);
+    else {
+        if (errbuf) std::snprintf(errbuf, errlen, "unknown technique %s",
+                                  technique.c_str());
+        delete c;
+        return nullptr;
+    }
+    if (c->parity.empty()) {
+        if (errbuf) std::snprintf(errbuf, errlen,
+                                  "degenerate matrix for k=%d m=%d", k, m);
+        delete c;
+        return nullptr;
+    }
+    return (ec_codec *)c;
+}
+
+void rs_destroy(ec_codec *cc) { delete (Codec *)cc; }
+
+int rs_k(const ec_codec *cc) { return ((const Codec *)cc)->k; }
+int rs_n(const ec_codec *cc) {
+    const Codec *c = (const Codec *)cc;
+    return c->k + c->m;
+}
+
+unsigned rs_chunk_size(const ec_codec *cc, unsigned object_size) {
+    /* ceil(object_size / k) padded to SIMD_ALIGN per chunk
+     * (ErasureCode::get_chunk_size + encode_prepare, ErasureCode.cc:151) */
+    const Codec *c = (const Codec *)cc;
+    unsigned per = (object_size + c->k - 1) / c->k;
+    return (per + SIMD_ALIGN - 1) / SIMD_ALIGN * SIMD_ALIGN;
+}
+
+int rs_encode(ec_codec *cc, const unsigned char *data, unsigned char *parity,
+              size_t chunk_size) {
+    Codec *c = (Codec *)cc;
+    gf8::apply_matrix(c->parity.data(), c->m, c->k, data, parity, chunk_size);
+    return 0;
+}
+
+bool lookup_decode(Codec *c, const std::vector<int> &erasures,
+                   const std::vector<int> &available, CachedDecode &out) {
+    /* canonical signature like the reference's "+0+1-3..." key (:169-189);
+     * inputs must be pre-sorted by the caller so equivalent requests share
+     * one entry.  `out` is a copy: the cached entry may be evicted by a
+     * concurrent decode the moment the lock drops. */
+    std::string sig;
+    for (int e : erasures) sig += "-" + std::to_string(e);
+    sig += "|";
+    for (int a : available) sig += "+" + std::to_string(a);
+
+    std::lock_guard<std::mutex> l(c->lru_mutex);
+    auto it = c->cache.find(sig);
+    if (it != c->cache.end()) {
+        c->lru.erase(it->second.second);
+        c->lru.push_front(sig);
+        it->second.second = c->lru.begin();
+        out = it->second.first;
+        return true;
+    }
+    CachedDecode cd;
+    if (!gf8::decode_matrix(c->parity, c->k, c->m, erasures, available,
+                            cd.rows, cd.src))
+        return false;
+    out = cd;
+    if ((int)c->cache.size() >= DECODE_LRU_CAP) {
+        c->cache.erase(c->lru.back());
+        c->lru.pop_back();
+    }
+    c->lru.push_front(sig);
+    c->cache.emplace(sig, std::make_pair(std::move(cd), c->lru.begin()));
+    return true;
+}
+
+int rs_decode(ec_codec *cc, unsigned char **chunks, size_t chunk_size,
+              const int *erasures, int n_erasures) {
+    Codec *c = (Codec *)cc;
+    int n = c->k + c->m;
+    std::vector<int> er(erasures, erasures + n_erasures);
+    std::vector<int> avail;
+    std::vector<char> is_er(n, 0);
+    for (int e : er) {
+        if (e < 0 || e >= n) return -EINVAL;
+        is_er[e] = 1;
+    }
+    for (int i = 0; i < n; i++)
+        if (!is_er[i] && chunks[i]) avail.push_back(i);
+    std::sort(er.begin(), er.end());       /* canonical cache key + row order */
+    CachedDecode cd;
+    if (!lookup_decode(c, er, avail, cd)) return -EIO;
+
+    std::vector<const uint8_t *> in;
+    for (int s : cd.src) in.push_back(chunks[s]);
+    std::vector<uint8_t *> out;
+    for (int e : er) out.push_back(chunks[e]);
+    gf8::apply_matrix_ptrs(cd.rows.data(), (int)er.size(), c->k,
+                           in.data(), out.data(), chunk_size);
+    return 0;
+}
+
+int rs_minimum(ec_codec *cc, const int *erasures, int n_erasures,
+               const int *available, int n_available, int *want_out,
+               int cap) {
+    /* "want if all available, else first k available"
+     * (ErasureCode::_minimum_to_decode, ErasureCode.cc:103-120) */
+    Codec *c = (Codec *)cc;
+    int n = c->k + c->m;
+    std::vector<char> is_er(n, 0);
+    for (int i = 0; i < n_erasures; i++) {
+        if (erasures[i] < 0 || erasures[i] >= n) return -EINVAL;
+        is_er[erasures[i]] = 1;
+    }
+    int got = 0;
+    for (int i = 0; i < n_available && got < c->k; i++) {
+        if (available[i] < 0 || available[i] >= n) return -EINVAL;
+        if (is_er[available[i]]) continue;
+        if (got < cap) want_out[got] = available[i];
+        got++;
+    }
+    return got >= c->k ? got : -EIO;
+}
+
+const ec_codec_ops RS_OPS = {
+    rs_create, rs_destroy, rs_k, rs_n, rs_chunk_size,
+    rs_encode, rs_decode, rs_minimum,
+};
+
+}  // namespace
+
+extern "C" const char *__erasure_code_version(void) { return EC_ABI_VERSION; }
+
+extern "C" int __erasure_code_init(const char *plugin_name,
+                                   const char *directory) {
+    (void)directory;
+    return ec_registry_add(plugin_name, &RS_OPS);
+}

@@ -1,0 +1,132 @@
+/* Plugin registry: name -> ops map + dlopen loader.
+ *
+ * Mirror of the reference's ErasureCodePluginRegistry
+ * (reference: src/erasure-code/ErasureCodePlugin.cc): process-wide
+ * singleton (:37), load() dlopens "libec_<name>.so" with RTLD_NOW (:126-137),
+ * rejects version mismatches against the host's version (:139-150), calls
+ * the C entry point __erasure_code_init(name, directory) which must
+ * self-register (:151-173), and preload() walks a comma-separated list the
+ * way global_init does with osd_erasure_code_plugins (:186-202).
+ */
+#include "../include/ec_abi.h"
+
+#include <dlfcn.h>
+#include <cerrno>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+
+namespace {
+std::mutex g_mutex;                      /* the registry's Mutex (:37) */
+std::map<std::string, const ec_codec_ops *> &plugins() {
+    static std::map<std::string, const ec_codec_ops *> m;
+    return m;
+}
+void seterr(char *errbuf, int errlen, const char *fmt, const char *a,
+            const char *b) {
+    if (errbuf && errlen > 0) std::snprintf(errbuf, errlen, fmt, a, b);
+}
+}  // namespace
+
+extern "C" int ec_registry_add(const char *name, const ec_codec_ops *ops) {
+    /* no lock: called from __erasure_code_init which runs under the load
+     * lock, matching the reference's add() contract (:59-69) */
+    if (!name || !ops) return -EINVAL;
+    auto &m = plugins();
+    if (m.count(name)) return -EEXIST;
+    m[name] = ops;
+    return 0;
+}
+
+extern "C" const ec_codec_ops *ec_registry_get(const char *name) {
+    std::lock_guard<std::mutex> l(g_mutex);
+    auto &m = plugins();
+    auto it = m.find(name);
+    return it == m.end() ? nullptr : it->second;
+}
+
+extern "C" int ec_registry_count(void) {
+    std::lock_guard<std::mutex> l(g_mutex);
+    return (int)plugins().size();
+}
+
+extern "C" int ec_registry_load(const char *name, const char *directory,
+                                char *errbuf, int errlen) {
+    std::lock_guard<std::mutex> l(g_mutex);
+    if (plugins().count(name)) return 0;         /* already registered */
+
+    std::string fname = std::string(directory && *directory ? directory : ".")
+        + "/" + EC_PLUGIN_PREFIX + name + EC_PLUGIN_SUFFIX;
+    void *library = dlopen(fname.c_str(), RTLD_NOW);   /* (:134) */
+    if (!library) {
+        seterr(errbuf, errlen, "load dlopen(%s): %s", fname.c_str(),
+               dlerror());
+        return -EIO;
+    }
+
+    using version_fn = const char *(*)(void);
+    version_fn vf = (version_fn)dlsym(library, "__erasure_code_version");
+    if (!vf) {                                   /* (:139-143) */
+        seterr(errbuf, errlen, "%s lacks __erasure_code_version%s",
+               fname.c_str(), "");
+        dlclose(library);
+        return -ENOENT;
+    }
+    const char *ver = vf();
+    if (std::strcmp(ver, EC_ABI_VERSION) != 0) { /* (:144-150) */
+        seterr(errbuf, errlen,
+               "plugin version %s does not match host %s", ver,
+               EC_ABI_VERSION);
+        dlclose(library);
+        return -ENXIO;
+    }
+
+    using init_fn = int (*)(const char *, const char *);
+    init_fn init = (init_fn)dlsym(library, "__erasure_code_init");
+    if (!init) {                                 /* (:163-168) */
+        seterr(errbuf, errlen, "%s lacks __erasure_code_init%s",
+               fname.c_str(), "");
+        dlclose(library);
+        return -ENOENT;
+    }
+    int r = init(name, directory ? directory : "");
+    if (r != 0) {                                /* (:151-162) */
+        seterr(errbuf, errlen, "init of %s failed%s", name, "");
+        /* an init that self-registered and THEN failed must not leave a
+         * dangling ops pointer into the soon-unmapped library */
+        plugins().erase(name);
+        dlclose(library);
+        return r;
+    }
+    if (!plugins().count(name)) {                /* init must self-register */
+        seterr(errbuf, errlen, "%s did not register plugin %s",
+               fname.c_str(), name);
+        dlclose(library);
+        return -EBADF;
+    }
+    /* library intentionally stays open for the process lifetime, like the
+     * reference (registry keeps the handle, never dlcloses on success) */
+    return 0;
+}
+
+extern "C" int ec_registry_preload(const char *names_csv,
+                                   const char *directory,
+                                   char *errbuf, int errlen) {
+    if (!names_csv) return 0;
+    std::string csv(names_csv);
+    size_t pos = 0;
+    while (pos < csv.size()) {
+        size_t comma = csv.find(',', pos);
+        std::string name = csv.substr(
+            pos, comma == std::string::npos ? std::string::npos : comma - pos);
+        if (!name.empty()) {
+            int r = ec_registry_load(name.c_str(), directory, errbuf, errlen);
+            if (r && r != -EEXIST) return r;
+        }
+        if (comma == std::string::npos) break;
+        pos = comma + 1;
+    }
+    return 0;
+}

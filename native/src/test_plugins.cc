@@ -1,0 +1,29 @@
+/* Deliberately-broken plugins for registry failure-path tests.
+ *
+ * Mirror of the reference's test plugin family (reference:
+ * src/test/erasure-code/ErasureCodePlugin{FailToInitialize,FailToRegister,
+ * MissingEntryPoint,MissingVersion}.cc): each TEST_PLUGIN_* macro selects
+ * one failure mode at compile time; the Makefile builds one .so per mode.
+ */
+#include "../include/ec_abi.h"
+
+#if defined(TEST_PLUGIN_WRONG_VERSION)
+extern "C" const char *__erasure_code_version(void) { return "bogus-0"; }
+extern "C" int __erasure_code_init(const char *, const char *) { return 0; }
+
+#elif defined(TEST_PLUGIN_FAIL_INIT)
+extern "C" const char *__erasure_code_version(void) { return EC_ABI_VERSION; }
+extern "C" int __erasure_code_init(const char *, const char *) { return -5; }
+
+#elif defined(TEST_PLUGIN_FAIL_REGISTER)
+/* init "succeeds" but never calls ec_registry_add */
+extern "C" const char *__erasure_code_version(void) { return EC_ABI_VERSION; }
+extern "C" int __erasure_code_init(const char *, const char *) { return 0; }
+
+#elif defined(TEST_PLUGIN_MISSING_ENTRY)
+/* version only; no __erasure_code_init symbol */
+extern "C" const char *__erasure_code_version(void) { return EC_ABI_VERSION; }
+
+#else
+#error "define one TEST_PLUGIN_* mode"
+#endif
