@@ -1,0 +1,97 @@
+"""Pallas TPU kernel for GF(2^8) matrix application (the RS hot op).
+
+Same math as :mod:`ceph_tpu.ops.rs_kernels` (out = mat @GF data), but the
+whole bitslice pipeline — byte->bit-plane unpack, GF(2) matmul on the MXU,
+mod-2, bit-plane->byte pack — is fused into ONE kernel over VMEM tiles.
+
+Why it can beat the XLA path: the XLA bitslice graph materialises the
+unpacked bit-planes ([8k, N] bf16 = 16x the input bytes) and the f32
+accumulator ([8r, N] = 32x the output bytes) in HBM between fusions; this
+kernel streams uint8 in and uint8 out, holding the 16x/32x inflation only
+in VMEM — HBM traffic drops to the information-theoretic (k+r)/N bytes per
+byte, and the op is HBM-bound (SURVEY.md: HBM bandwidth is the usual
+bottleneck; pallas_guide.md "fuse what XLA can't").
+
+Bit-plane layout is plane-major (row b*k+j = bit b of chunk j) so the
+in-kernel unpack/pack are static concatenates/slices — no sublane
+reshuffles for Mosaic to choke on.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..gf.tables import MUL_TABLE
+
+DEFAULT_TILE = 8192   # best sustained stream in the k=8,m=4 sweep on v5e
+
+
+def expand_bits_plane_major(mat: jax.Array) -> jax.Array:
+    """GF(2^8) matrix [r, k] -> GF(2) bit-matrix [8r, 8k], plane-major:
+
+    B[bi*r + i, bj*k + j] = bit bi of (mat[i, j] * 2^bj  in GF(2^8)).
+    """
+    from .rs_kernels import expand_bits_raw
+    r, k = mat.shape
+    bits = expand_bits_raw(mat)                   # [r, bi, k, bj]
+    return bits.transpose(1, 0, 3, 2).reshape(8 * r, 8 * k)
+
+
+def _gf_kernel(bmat_ref, data_ref, out_ref, *, r: int, k: int):
+    d = data_ref[:].astype(jnp.int32)             # [k, T]
+    planes = [((d >> b) & 1) for b in range(8)]
+    bits = jnp.concatenate(planes, axis=0).astype(jnp.bfloat16)  # [8k, T]
+    acc = jax.lax.dot_general(
+        bmat_ref[:], bits, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)       # [8r, T] exact int sums
+    acc = acc.astype(jnp.int32) & 1               # mod 2
+    out = acc[0:r]
+    for b in range(1, 8):
+        out = out | (acc[b * r:(b + 1) * r] << b)
+    out_ref[:] = out.astype(jnp.uint8)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("tile_n", "interpret"))
+def gf_apply_pallas(mat: jax.Array, data: jax.Array,
+                    tile_n: int = DEFAULT_TILE,
+                    interpret: bool = False) -> jax.Array:
+    """out[r, N] = mat @GF data, fused bitslice pipeline in one kernel.
+
+    mat: [r, k] uint8, data: [k, N] uint8.  N is padded to a tile multiple
+    internally (zero GF columns contribute zero parity).
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu  # noqa: F401
+
+    mat = jnp.asarray(mat, dtype=jnp.uint8)
+    data = jnp.asarray(data, dtype=jnp.uint8)
+    r, k = mat.shape
+    _, n = data.shape
+    bmat = expand_bits_plane_major(mat).astype(jnp.bfloat16)
+
+    # pick the tile so padding waste stays < 128 columns per tile (a fixed
+    # 8k tile would do up to 8x wasted work at N just over a tile boundary):
+    # spread N over ceil(N/tile) tiles of the smallest 128-multiple size
+    n_tiles = max(1, -(-n // tile_n))
+    tile_n = max(128, (-(-n // n_tiles) + 127) // 128 * 128)
+    n_pad = n_tiles * tile_n
+    if n_pad != n:
+        data = jnp.pad(data, ((0, 0), (0, n_pad - n)))
+    grid = (n_tiles,)
+
+    out = pl.pallas_call(
+        functools.partial(_gf_kernel, r=r, k=k),
+        out_shape=jax.ShapeDtypeStruct((r, n_pad), jnp.uint8),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((8 * r, 8 * k), lambda i: (0, 0)),
+            pl.BlockSpec((k, tile_n), lambda i: (0, i)),
+        ],
+        out_specs=pl.BlockSpec((r, tile_n), lambda i: (0, i)),
+        interpret=interpret,
+    )(bmat, data)
+    return out[:, :n] if n_pad != n else out
