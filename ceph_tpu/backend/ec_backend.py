@@ -134,6 +134,7 @@ class Op:
     cache_claims: list[tuple[str, int]] = field(default_factory=list)
     # reads unrecoverable with current up set; re-driven by on_shard_up
     _rmw_stalled: bool = False
+    tracked: object = None      # OpTracker request (mark_event timeline)
 
 
 @dataclass
@@ -156,7 +157,7 @@ class ECBackend:
     """Primary-side EC backend over a set of shard OSDs on a message bus."""
 
     def __init__(self, ec_impl, sinfo: StripeInfo, bus: MessageBus,
-                 acting: list[int], whoami: int = 0):
+                 acting: list[int], whoami: int = 0, cct=None):
         n = ec_impl.get_chunk_count()
         assert len(acting) == n, f"acting set must have {n} shards"
         self.ec_impl = ec_impl
@@ -182,6 +183,36 @@ class ECBackend:
         self._stalled_recoveries: list[RecoveryOp] = []
         bus.down_listeners.append(self.on_shard_down)
         bus.up_listeners.append(self.on_shard_up)
+        # observability (SURVEY.md §5): counters + op tracking + admin cmds
+        from ..common import OpTracker, PerfCountersBuilder, default_context
+        self.cct = cct if cct is not None else default_context()
+        self.perf = (
+            PerfCountersBuilder(f"ec_backend.{whoami}")
+            .add_u64_counter("writes", "client writes committed")
+            .add_u64_counter("reads", "client reads completed")
+            .add_u64_counter("read_errors", "per-object read failures (EIO)")
+            .add_u64_counter("write_bytes", "client bytes written")
+            .add_u64_counter("stripe_bytes_encoded",
+                             "stripe-aligned bytes through encode (>= "
+                             "write_bytes: RMW pads to whole stripes)")
+            .add_u64_counter("read_bytes", "logical bytes returned")
+            .add_u64_counter("recoveries", "recovery ops completed")
+            .add_u64_counter("recovery_failures", "recovery ops failed")
+            .add_time_avg("encode_time", "batched encode wall time")
+            .add_time_avg("decode_time", "batched decode wall time")
+            .add_u64("pipeline_depth", "ops across the three wait lists")
+            .create_perf_counters())
+        self.cct.perf.add(self.perf)
+        self.op_tracker = OpTracker()
+        for cmd, fn in ((f"dump_ops_in_flight.{whoami}",
+                         lambda **kw: self.op_tracker.dump_ops_in_flight()),
+                        (f"dump_historic_ops.{whoami}",
+                         lambda **kw: self.op_tracker.dump_historic_ops())):
+            # a re-created backend with the same whoami takes over the
+            # hook (leaving the old registration would serve — and pin —
+            # the dead backend's tracker)
+            self.cct.admin_socket.unregister(cmd)
+            self.cct.admin_socket.register(cmd, fn)
 
     # -- helpers -----------------------------------------------------------
 
@@ -304,10 +335,19 @@ class ECBackend:
         tid = self.next_tid
         plan = get_write_plan(self.sinfo, t, self._hinfo)
         op = Op(tid=tid, plan=plan, on_commit=on_commit)
+        op.tracked = self.op_tracker.create_request(
+            f"osd_op(write tid={tid} objects={sorted(t.ops)})")
+        op.tracked.mark_event("queued_for_pg")
         self.tid_to_op[tid] = op
         self.waiting_state.append(op)
+        self._update_pipeline_depth()
         self.check_ops()
         return tid
+
+    def _update_pipeline_depth(self) -> None:
+        self.perf.set("pipeline_depth",
+                      len(self.waiting_state) + len(self.waiting_reads) +
+                      len(self.waiting_commit))
 
     def check_ops(self) -> None:
         """Advance each pipeline stage's head as far as possible
@@ -426,7 +466,11 @@ class ECBackend:
             # ONE batched encode over all extents' stripes
             logical = np.concatenate(
                 [np.frombuffer(b, dtype=np.uint8) for _, b in pieces])
-            encoded = ecutil.encode(self.sinfo, self.ec_impl, logical)
+            with self.perf.time("encode_time"):
+                encoded = ecutil.encode(self.sinfo, self.ec_impl, logical)
+            self.perf.inc("stripe_bytes_encoded", int(logical.nbytes))
+            if op.tracked:
+                op.tracked.mark_event("encoded")
             # scatter per-extent chunk ranges into shard transactions
             c_cursor = 0
             old_size = hinfo.total_chunk_size
@@ -522,6 +566,14 @@ class ECBackend:
             for oid, tid in op.cache_claims:
                 self.extent_cache.release(oid, tid)
             del self.tid_to_op[op.tid]
+            self.perf.inc("writes")
+            self.perf.inc("write_bytes", sum(
+                len(d) for objop in op.plan.t.ops.values()
+                for _, d in objop.buffer_updates))
+            self._update_pipeline_depth()
+            if op.tracked:
+                op.tracked.mark_event("commit_sent")
+                op.tracked.finish()
             if op.on_commit:
                 op.on_commit(op.tid)
 
@@ -657,7 +709,8 @@ class ECBackend:
                 continue
             # keep exactly k shards for decode
             chosen = dict(sorted(by_chunk.items())[:k])
-            logical = ecutil.decode(self.sinfo, self.ec_impl, chosen)
+            with self.perf.time("decode_time"):
+                logical = ecutil.decode(self.sinfo, self.ec_impl, chosen)
             c_off, _ = rop.shard_extents[oid]
             base = self.sinfo.aligned_chunk_offset_to_logical_offset(c_off)
             obj_size = self.object_size(oid)
@@ -668,6 +721,12 @@ class ECBackend:
                 out.append((off, length, seg))
             result[oid] = out
         del self.in_progress_reads[rop.tid]
+        if result:
+            self.perf.inc("reads")
+        if errors:
+            self.perf.inc("read_errors", len(errors))
+        self.perf.inc("read_bytes", sum(
+            len(seg) for segs in result.values() for _, _, seg in segs))
         rop.on_complete(result, errors)
 
     # -- recovery (ECBackend.cc:565-732; state ECBackend.h:249-293) --------
@@ -757,6 +816,7 @@ class ECBackend:
         rop.state = RecoveryState.FAILED if failed else RecoveryState.COMPLETE
         self.recovery_ops.pop(rop.oid, None)
         self._recovery_read_tids.pop(rop.read_tid, None)
+        self.perf.inc("recovery_failures" if failed else "recoveries")
         if rop.on_complete:
             rop.on_complete(rop)
 
@@ -788,7 +848,7 @@ class ECBackend:
         return out
 
 
-def make_cluster(ec_impl, chunk_size: int = 4096):
+def make_cluster(ec_impl, chunk_size: int = 4096, cct=None):
     """Build a primary + shard OSDs wired on one bus; returns (backend, bus).
 
     Chunk i lives on shard id i (identity crush mapping) with the primary
@@ -799,7 +859,7 @@ def make_cluster(ec_impl, chunk_size: int = 4096):
     k = ec_impl.get_data_chunk_count()
     bus = MessageBus()
     backend = ECBackend(ec_impl, StripeInfo(k, chunk_size), bus,
-                        acting=list(range(n)), whoami=0)
+                        acting=list(range(n)), whoami=0, cct=cct)
     for shard in range(1, n):
         OSDShard(shard, bus)
     return backend, bus

@@ -1,0 +1,23 @@
+"""Common runtime services (SURVEY.md §5): typed config with observers,
+perf counters, ring-buffered log, admin-socket command registry, op
+tracker, bundled by Context (the CephContext analog)."""
+from .options import (ConfigProxy, Option, OPTIONS, SCHEMA, parse_size,
+                      LEVEL_BASIC, LEVEL_ADVANCED, LEVEL_DEV,
+                      TYPE_STR, TYPE_INT, TYPE_UINT, TYPE_FLOAT, TYPE_BOOL,
+                      TYPE_SIZE)
+from .perf_counters import (PerfCounters, PerfCountersBuilder,
+                            PerfCountersCollection)
+from .log import Log, Entry
+from .admin_socket import AdminSocket
+from .optracker import OpTracker, TrackedOp
+from .context import Context, default_context
+
+__all__ = [
+    "ConfigProxy", "Option", "OPTIONS", "SCHEMA", "parse_size",
+    "LEVEL_BASIC", "LEVEL_ADVANCED", "LEVEL_DEV",
+    "TYPE_STR", "TYPE_INT", "TYPE_UINT", "TYPE_FLOAT", "TYPE_BOOL",
+    "TYPE_SIZE",
+    "PerfCounters", "PerfCountersBuilder", "PerfCountersCollection",
+    "Log", "Entry", "AdminSocket", "OpTracker", "TrackedOp",
+    "Context", "default_context",
+]

@@ -1,0 +1,55 @@
+"""Context: the per-process service bundle (CephContext analog).
+
+Mirror of the reference's ``CephContext`` (reference:
+src/common/ceph_context.cc, ~950 LoC): owns the config store, the log, the
+perf-counter collection, and the admin socket, and pre-registers the
+standard admin commands (``perf dump``, ``config show``, ``config set``,
+``log dump``).  Daemon-ish objects (ECBackend, shards) take a Context and
+hang their counters/commands off it.
+"""
+from __future__ import annotations
+
+from .admin_socket import AdminSocket
+from .log import Log
+from .options import ConfigProxy
+from .perf_counters import PerfCountersCollection
+
+
+class Context:
+    def __init__(self, overrides: dict | None = None):
+        self.conf = ConfigProxy(overrides)
+        self.log = Log(self.conf)
+        self.perf = PerfCountersCollection()
+        self.admin_socket = AdminSocket()
+
+        self.admin_socket.register(
+            "perf dump", lambda **kw: self.perf.perf_dump(),
+            "dump all perf counters")
+        self.admin_socket.register(
+            "config show", lambda **kw: self.conf.show_config(),
+            "show all config values")
+        self.admin_socket.register(
+            "config diff", lambda **kw: self.conf.diff(),
+            "show non-default config values")
+
+        def _config_set(name: str = "", value: str = "", **kw):
+            self.conf.set(name, value)
+            return {"success": f"{name} = {value}"}
+        self.admin_socket.register("config set", _config_set,
+                                   "set a config option")
+        self.admin_socket.register(
+            "log dump", lambda **kw: self.log.dump_recent(),
+            "dump recent log entries")
+
+    def dout(self, subsys: str, level: int, message: str) -> None:
+        self.log.dout(subsys, level, message)
+
+
+_default: Context | None = None
+
+
+def default_context() -> Context:
+    global _default
+    if _default is None:
+        _default = Context()
+    return _default

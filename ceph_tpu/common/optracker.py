@@ -1,0 +1,115 @@
+"""Op tracker: per-op event history + in-flight/slow-op dumps.
+
+Mirror of the reference's OpTracker (reference: src/common/TrackedOp.{h,cc};
+``op->mark_event`` timeline entries surfaced over the admin socket as
+``dump_ops_in_flight`` / ``dump_historic_ops``; the FUNCTRACE/OID event
+usage at src/osd/OSD.cc:9549-9578 is the same mechanism at the dispatch
+points).
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+
+@dataclass
+class TrackedOp:
+    tracker: "OpTracker"
+    seq: int
+    description: str
+    initiated_at: float = field(default_factory=time.time)
+    events: list[tuple[float, str]] = field(default_factory=list)
+    _done: bool = False
+
+    def mark_event(self, event: str) -> None:
+        self.events.append((time.time(), event))
+
+    def finish(self) -> None:
+        if not self._done:
+            self._done = True
+            self.mark_event("done")
+            self.tracker._finish(self)
+
+    @property
+    def age(self) -> float:
+        return time.time() - self.initiated_at
+
+    @property
+    def duration(self) -> float:
+        end = self.events[-1][0] if self._done and self.events \
+            else time.time()
+        return end - self.initiated_at
+
+    def dump(self) -> dict:
+        return {
+            "description": self.description,
+            "initiated_at": self.initiated_at,
+            "age": self.age,
+            "duration": self.duration,
+            "type_data": {
+                "events": [{"time": t, "event": e} for t, e in self.events],
+            },
+        }
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.finish()
+        return False
+
+
+class OpTracker:
+    """In-flight registry + bounded history of completed/slow ops."""
+
+    def __init__(self, history_size: int = 20, history_duration: float = 600.0,
+                 complaint_time: float = 30.0):
+        self._inflight: dict[int, TrackedOp] = {}
+        self._history: deque[TrackedOp] = deque(maxlen=history_size)
+        self._slow: deque[TrackedOp] = deque(maxlen=history_size)
+        self._seq = itertools.count(1)
+        self._lock = threading.Lock()
+        self.history_duration = history_duration
+        self.complaint_time = complaint_time
+
+    def create_request(self, description: str) -> TrackedOp:
+        op = TrackedOp(self, next(self._seq), description)
+        op.mark_event("initiated")
+        with self._lock:
+            self._inflight[op.seq] = op
+        return op
+
+    def _finish(self, op: TrackedOp) -> None:
+        with self._lock:
+            self._inflight.pop(op.seq, None)
+            self._history.append(op)
+            if op.duration >= self.complaint_time:
+                self._slow.append(op)
+
+    def get_age_histogram(self) -> dict[str, int]:
+        with self._lock:
+            ops = list(self._inflight.values())
+        hist: dict[str, int] = {}
+        for op in ops:
+            bucket = "<1s" if op.age < 1 else \
+                "<10s" if op.age < 10 else "<60s" if op.age < 60 else ">=60s"
+            hist[bucket] = hist.get(bucket, 0) + 1
+        return hist
+
+    def dump_ops_in_flight(self) -> dict:
+        with self._lock:
+            ops = [op.dump() for op in self._inflight.values()]
+        return {"ops": ops, "num_ops": len(ops)}
+
+    def dump_historic_ops(self) -> dict:
+        with self._lock:
+            ops = [op.dump() for op in self._history]
+        return {"ops": ops, "num_ops": len(ops)}
+
+    def dump_historic_slow_ops(self) -> dict:
+        with self._lock:
+            ops = [op.dump() for op in self._slow]
+        return {"ops": ops, "num_ops": len(ops)}

@@ -1,0 +1,177 @@
+"""Perf counters: typed metric registry with builder + JSON dump.
+
+Mirror of the reference's PerfCounters machinery (reference:
+src/common/perf_counters.h — ``PerfCountersBuilder`` :59-116 with
+``add_u64_counter``/``add_u64_avg``/``add_time_avg``/histogram adders
+:83-99; per-subsystem collections registered in the CephContext and dumped
+over the admin socket as ``perf dump``).  Averages store (sum, count) pairs
+and dump as {avgcount, sum, avgtime} exactly like the reference so existing
+``perf dump`` consumers parse them.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+PERFCOUNTER_U64 = "u64"
+PERFCOUNTER_COUNTER = "counter"
+PERFCOUNTER_AVG = "avg"
+PERFCOUNTER_TIME_AVG = "time_avg"
+PERFCOUNTER_HISTOGRAM = "histogram"
+
+
+@dataclass
+class _Metric:
+    kind: str
+    description: str = ""
+    value: float = 0
+    sum: float = 0.0
+    count: int = 0
+    buckets: list[float] = field(default_factory=list)   # histogram bounds
+    bucket_counts: list[int] = field(default_factory=list)
+
+
+class PerfCounters:
+    """One subsystem's counters (e.g. 'osd', 'ec_backend')."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._metrics: dict[str, _Metric] = {}
+        self._lock = threading.Lock()
+
+    # -- updates -----------------------------------------------------------
+
+    def inc(self, key: str, amount: int = 1) -> None:
+        with self._lock:
+            m = self._metrics[key]
+            if m.kind == PERFCOUNTER_AVG:
+                m.sum += amount
+                m.count += 1
+            else:
+                m.value += amount
+
+    def dec(self, key: str, amount: int = 1) -> None:
+        with self._lock:
+            self._metrics[key].value -= amount
+
+    def set(self, key: str, value) -> None:
+        with self._lock:
+            self._metrics[key].value = value
+
+    def tinc(self, key: str, seconds: float) -> None:
+        """Add one timed sample (the reference's utime_t tinc)."""
+        with self._lock:
+            m = self._metrics[key]
+            m.sum += seconds
+            m.count += 1
+
+    def hinc(self, key: str, value: float) -> None:
+        with self._lock:
+            m = self._metrics[key]
+            for i, bound in enumerate(m.buckets):
+                if value <= bound:
+                    m.bucket_counts[i] += 1
+                    break
+            else:
+                m.bucket_counts[-1] += 1
+            m.sum += value
+            m.count += 1
+
+    class _Timer:
+        def __init__(self, pc, key):
+            self.pc, self.key = pc, key
+
+        def __enter__(self):
+            self.t0 = time.perf_counter()
+            return self
+
+        def __exit__(self, *exc):
+            self.pc.tinc(self.key, time.perf_counter() - self.t0)
+            return False
+
+    def time(self, key: str) -> "_Timer":
+        return self._Timer(self, key)
+
+    # -- dump --------------------------------------------------------------
+
+    def dump(self) -> dict:
+        out = {}
+        with self._lock:
+            for key, m in self._metrics.items():
+                if m.kind in (PERFCOUNTER_AVG, PERFCOUNTER_TIME_AVG):
+                    entry = {"avgcount": m.count, "sum": m.sum}
+                    if m.count:
+                        entry["avgtime" if m.kind == PERFCOUNTER_TIME_AVG
+                              else "avgvalue"] = m.sum / m.count
+                    out[key] = entry
+                elif m.kind == PERFCOUNTER_HISTOGRAM:
+                    out[key] = {"sum": m.sum, "count": m.count,
+                                "buckets": dict(zip(
+                                    [str(b) for b in m.buckets] + ["inf"],
+                                    m.bucket_counts))}
+                else:
+                    out[key] = m.value
+        return out
+
+
+class PerfCountersBuilder:
+    """(perf_counters.h:59-116)."""
+
+    def __init__(self, name: str):
+        self._pc = PerfCounters(name)
+
+    def add_u64(self, key: str, description: str = "") -> "PerfCountersBuilder":
+        self._pc._metrics[key] = _Metric(PERFCOUNTER_U64, description)
+        return self
+
+    def add_u64_counter(self, key: str,
+                        description: str = "") -> "PerfCountersBuilder":
+        self._pc._metrics[key] = _Metric(PERFCOUNTER_COUNTER, description)
+        return self
+
+    def add_u64_avg(self, key: str,
+                    description: str = "") -> "PerfCountersBuilder":
+        self._pc._metrics[key] = _Metric(PERFCOUNTER_AVG, description)
+        return self
+
+    def add_time_avg(self, key: str,
+                     description: str = "") -> "PerfCountersBuilder":
+        self._pc._metrics[key] = _Metric(PERFCOUNTER_TIME_AVG, description)
+        return self
+
+    def add_histogram(self, key: str, buckets: list[float],
+                      description: str = "") -> "PerfCountersBuilder":
+        m = _Metric(PERFCOUNTER_HISTOGRAM, description,
+                    buckets=list(buckets))
+        m.bucket_counts = [0] * (len(buckets) + 1)
+        self._pc._metrics[key] = m
+        return self
+
+    def create_perf_counters(self) -> PerfCounters:
+        return self._pc
+
+
+class PerfCountersCollection:
+    """Process-wide registry dumped as one JSON doc (perf dump)."""
+
+    def __init__(self):
+        self._loggers: dict[str, PerfCounters] = {}
+        self._lock = threading.Lock()
+
+    def add(self, pc: PerfCounters) -> None:
+        with self._lock:
+            self._loggers[pc.name] = pc
+
+    def remove(self, name: str) -> None:
+        with self._lock:
+            self._loggers.pop(name, None)
+
+    def get(self, name: str) -> PerfCounters | None:
+        with self._lock:
+            return self._loggers.get(name)
+
+    def perf_dump(self) -> dict:
+        with self._lock:
+            loggers = dict(self._loggers)
+        return {name: pc.dump() for name, pc in sorted(loggers.items())}

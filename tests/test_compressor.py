@@ -1,0 +1,47 @@
+"""Compressor plugin registry (SURVEY.md §2.4: src/compressor/ — same
+registry pattern as the EC plugins)."""
+import numpy as np
+import pytest
+
+from ceph_tpu.compressor import CompressorRegistry, create
+
+
+def payload(n=65536, seed=0):
+    rng = np.random.default_rng(seed)
+    # compressible: repeated structured blocks + noise tail
+    block = rng.integers(0, 32, size=256, dtype=np.uint8).tobytes()
+    return block * (n // 256) + rng.bytes(n % 256)
+
+
+@pytest.mark.parametrize("alg", ["zlib", "zstd", "lzma", "bz2"])
+def test_roundtrip_and_ratio(alg):
+    c = create(alg)
+    data = payload()
+    comp = c.compress(data)
+    assert c.decompress(comp) == data
+    assert len(comp) < len(data)        # structured data must shrink
+
+
+def test_unavailable_algorithms_fail_like_unloadable_plugins():
+    reg = CompressorRegistry.instance()
+    for alg in ("snappy", "lz4"):
+        with pytest.raises(FileNotFoundError):
+            reg.create(alg)
+    with pytest.raises(ValueError):
+        reg.create("nope")
+
+
+def test_supported_list():
+    assert set(CompressorRegistry.instance().supported()) >= \
+        {"zlib", "zstd", "lzma", "bz2"}
+
+
+def test_custom_registration():
+    class Null:
+        name = "null"
+        def compress(self, b): return bytes(b)
+        def decompress(self, b): return bytes(b)
+    reg = CompressorRegistry()
+    reg.register("null", Null)
+    c = reg.create("null")
+    assert c.decompress(c.compress(b"abc")) == b"abc"
