@@ -157,7 +157,10 @@ class ECBackend:
     """Primary-side EC backend over a set of shard OSDs on a message bus."""
 
     def __init__(self, ec_impl, sinfo: StripeInfo, bus: MessageBus,
-                 acting: list[int], whoami: int = 0, cct=None):
+                 acting: list[int], whoami: int = 0, cct=None,
+                 name: str = ""):
+        # `name` disambiguates observability registrations when several
+        # backends (e.g. one per PG) share a Context and a primary OSD id
         n = ec_impl.get_chunk_count()
         assert len(acting) == n, f"acting set must have {n} shards"
         self.ec_impl = ec_impl
@@ -186,8 +189,9 @@ class ECBackend:
         # observability (SURVEY.md §5): counters + op tracking + admin cmds
         from ..common import OpTracker, PerfCountersBuilder, default_context
         self.cct = cct if cct is not None else default_context()
+        self.instance_name = name or str(whoami)
         self.perf = (
-            PerfCountersBuilder(f"ec_backend.{whoami}")
+            PerfCountersBuilder(f"ec_backend.{self.instance_name}")
             .add_u64_counter("writes", "client writes committed")
             .add_u64_counter("reads", "client reads completed")
             .add_u64_counter("read_errors", "per-object read failures (EIO)")
@@ -204,13 +208,13 @@ class ECBackend:
             .create_perf_counters())
         self.cct.perf.add(self.perf)
         self.op_tracker = OpTracker()
-        for cmd, fn in ((f"dump_ops_in_flight.{whoami}",
+        for cmd, fn in ((f"dump_ops_in_flight.{self.instance_name}",
                          lambda **kw: self.op_tracker.dump_ops_in_flight()),
-                        (f"dump_historic_ops.{whoami}",
+                        (f"dump_historic_ops.{self.instance_name}",
                          lambda **kw: self.op_tracker.dump_historic_ops())):
-            # a re-created backend with the same whoami takes over the
-            # hook (leaving the old registration would serve — and pin —
-            # the dead backend's tracker)
+            # a re-created backend with the same name takes over the hook
+            # (leaving the old registration would serve — and pin — the
+            # dead backend's tracker)
             self.cct.admin_socket.unregister(cmd)
             self.cct.admin_socket.register(cmd, fn)
 
@@ -248,6 +252,24 @@ class ECBackend:
             self.handle_push_reply(msg)
         else:
             self.local_shard.handle_message(msg)
+
+    def shutdown(self) -> None:
+        """Unhook from the shared Context and bus so a discarded backend is
+        collectable (registration without teardown pins the backend — and
+        its trackers/stores — for the context's lifetime)."""
+        self.cct.perf.remove(self.perf.name)
+        self.cct.admin_socket.unregister(
+            f"dump_ops_in_flight.{self.instance_name}")
+        self.cct.admin_socket.unregister(
+            f"dump_historic_ops.{self.instance_name}")
+        for lst in (self.bus.down_listeners, self.bus.up_listeners):
+            for cb in list(lst):
+                if getattr(cb, "__self__", None) is self:
+                    lst.remove(cb)
+        # hand the shard queue back to the plain shard handler so the bus
+        # no longer references this backend
+        if self.bus.handlers.get(self.whoami) is self:
+            self.bus.handlers[self.whoami] = self.local_shard
 
     # -- failure handling --------------------------------------------------
 
@@ -692,7 +714,8 @@ class ECBackend:
         for oid, runs in op._rmw_buf.items():
             for c_off, by_chunk in runs.items():
                 logical_off = self.sinfo.aligned_chunk_offset_to_logical_offset(c_off)
-                data = ecutil.decode(self.sinfo, self.ec_impl, by_chunk)
+                with self.perf.time("decode_time"):
+                    data = ecutil.decode(self.sinfo, self.ec_impl, by_chunk)
                 op.remote_reads.setdefault(oid, {})[logical_off] = data
 
     def _complete_read_op(self, rop: ReadOp) -> None:

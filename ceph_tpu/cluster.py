@@ -1,0 +1,187 @@
+"""MiniCluster: an in-process cluster harness (the vstart.sh analog).
+
+Mirror of the reference's dev-cluster workflow (reference: src/vstart.sh +
+qa/standalone/ceph-helpers.sh run_osd/wait_for_clean;
+qa/standalone/erasure-code/test-erasure-code.sh:21-66 creates an EC pool
+over 11 OSDs and does put/get): builds a CRUSH tree + OSDMap, creates EC
+pools from profiles (plugin factory + create_rule, the mon's pool-creation
+path), places every PG via the OSDMap mapping chain, and instantiates one
+EC group (primary ECBackend + shard OSDs on a message bus) per PG with the
+acting set CRUSH chose.  Objects route to PGs with the librados placement
+(ceph_str_hash_rjenkins + ceph_stable_mod).
+
+Scope note: each PG gets its own MessageBus and per-PG shard stores (the
+reference's OSD runs many PGs against one ObjectStore; here stores are
+per-(PG, shard), which preserves all placement/EC semantics while keeping
+PG pipelines independent — the same simplification MemStore-backed unit
+tests make).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .backend import ECBackend, MessageBus, PGTransaction, StripeInfo
+from .backend.ec_backend import OSDShard
+from .common import Context, default_context
+from .crush import (CRUSH_BUCKET_STRAW2, CRUSH_RULE_CHOOSELEAF_INDEP,
+                    CRUSH_RULE_EMIT, CRUSH_RULE_TAKE, CrushMap)
+from .osdmap import (OSDMap, PG, Pool, POOL_TYPE_ERASURE, ceph_stable_mod)
+from .osdmap.str_hash import ceph_str_hash_rjenkins
+from .plugins.registry import ErasureCodePluginRegistry
+
+
+import itertools
+
+_cluster_ids = itertools.count(1)
+
+
+class PGGroup:
+    """One placement group: primary backend + shard OSDs on its own bus."""
+
+    def __init__(self, pgid: PG, acting: list[int], ec_impl,
+                 chunk_size: int, cct, name_prefix: str):
+        self.pgid = pgid
+        self.acting = acting
+        self.bus = MessageBus()
+        k = ec_impl.get_data_chunk_count()
+        primary = acting[0]
+        # name is unique across PGs sharing a primary AND across clusters
+        # sharing a Context (salted with the cluster id)
+        self.backend = ECBackend(
+            ec_impl, StripeInfo(k, chunk_size), self.bus,
+            acting=list(acting), whoami=primary, cct=cct,
+            name=f"{name_prefix}.pg{pgid}")
+        for osd in acting:
+            if osd != primary:
+                OSDShard(osd, self.bus)
+
+
+class MiniCluster:
+    def __init__(self, n_osds: int = 12, osds_per_host: int = 3,
+                 chunk_size: int = 4096, cct: Context | None = None):
+        self.cct = cct if cct is not None else default_context()
+        self.chunk_size = chunk_size
+        self.cluster_id = next(_cluster_ids)
+        cmap = CrushMap()
+        cmap.set_type_name(1, "host")
+        cmap.set_type_name(2, "root")
+        hosts = []
+        for h0 in range(0, n_osds, osds_per_host):
+            items = list(range(h0, min(h0 + osds_per_host, n_osds)))
+            hosts.append(cmap.add_bucket(
+                CRUSH_BUCKET_STRAW2, 1, items, [0x10000] * len(items)))
+        root = cmap.add_bucket(
+            CRUSH_BUCKET_STRAW2, 2, hosts,
+            [sum(cmap.buckets[h].item_weights) for h in hosts])
+        cmap.set_item_name(root, "default")
+        cmap.finalize()
+        self.osdmap = OSDMap(crush=cmap)
+        for o in range(n_osds):
+            self.osdmap.create_osd(o)
+        self._next_pool = 1
+        self.pools: dict[int, dict] = {}       # pool_id -> {pgs, pool, ec}
+        self.pool_ids: dict[str, int] = {}
+
+    # -- pool creation (the mon's osd pool create path) --------------------
+
+    def create_ec_pool(self, name: str, profile: dict | None = None,
+                      pg_num: int = 8) -> int:
+        profile = dict(profile or {})
+        profile.setdefault("plugin", "jax_rs")
+        profile.setdefault("k", "4")
+        profile.setdefault("m", "2")
+        plugin = profile["plugin"]
+        ec = ErasureCodePluginRegistry.instance().factory(
+            plugin, "", dict(profile))
+        n = ec.get_chunk_count()
+        # ErasureCode::create_rule semantics: chooseleaf indep over hosts
+        # when enough hosts exist, else osds (ErasureCode.cc:64-83)
+        root = self.osdmap.crush.item_id("default")
+        n_hosts = sum(1 for b in self.osdmap.crush.buckets.values()
+                      if b.type == 1)
+        ftype = 1 if n_hosts >= n else 0
+        ruleno = self.osdmap.crush.add_rule(
+            [(CRUSH_RULE_TAKE, root, 0),
+             (CRUSH_RULE_CHOOSELEAF_INDEP, n, ftype),
+             (CRUSH_RULE_EMIT, 0, 0)])
+        pool_id = self._next_pool
+        self._next_pool += 1
+        pool = Pool(pool_id=pool_id, type=POOL_TYPE_ERASURE, size=n,
+                    min_size=ec.get_data_chunk_count() + 1, pg_num=pg_num,
+                    crush_rule=ruleno, name=name,
+                    erasure_code_profile=str(sorted(profile.items())))
+        self.osdmap.add_pool(pool)
+
+        pgs = {}
+        for ps in range(pg_num):
+            pgid = PG(pool_id, ps)
+            up, up_primary, acting, _ = self.osdmap.pg_to_up_acting_osds(pgid)
+            if not acting or any(a == 0x7FFFFFFF for a in acting):
+                raise RuntimeError(
+                    f"pg {pgid} not fully mapped (acting={acting}); "
+                    f"add OSDs or shrink k+m")
+            pgs[ps] = PGGroup(pgid, acting, ec, self.chunk_size, self.cct,
+                              name_prefix=f"c{self.cluster_id}")
+        self.pools[pool_id] = {"pool": pool, "pgs": pgs, "ec": ec}
+        self.pool_ids[name] = pool_id
+        return pool_id
+
+    # -- object placement (librados object_locator -> pg) ------------------
+
+    def object_pg(self, pool_id: int, oid: str) -> int:
+        pool = self.pools[pool_id]["pool"]
+        ps = ceph_str_hash_rjenkins(oid)
+        return ceph_stable_mod(ps, pool.pg_num, pool.pg_num_mask)
+
+    def pg_group(self, pool_id: int, oid: str) -> PGGroup:
+        return self.pools[pool_id]["pgs"][self.object_pg(pool_id, oid)]
+
+    # -- client I/O --------------------------------------------------------
+
+    def put(self, pool_id: int, oid: str, data: bytes,
+            deliver: bool = True) -> PGGroup:
+        g = self.pg_group(pool_id, oid)
+        sw = g.backend.sinfo.stripe_width
+        pad = (-len(data)) % sw
+        g.backend.submit_transaction(
+            PGTransaction().write(oid, 0, bytes(data) + b"\0" * pad))
+        if deliver:
+            g.bus.deliver_all()
+        return g
+
+    def get(self, pool_id: int, oid: str, length: int) -> bytes:
+        g = self.pg_group(pool_id, oid)
+        out = {}
+        g.backend.objects_read_and_reconstruct(
+            {oid: [(0, length)]},
+            lambda result, errors: out.update(result=result, errors=errors))
+        g.bus.deliver_all()
+        if out.get("errors"):
+            raise IOError(out["errors"])
+        return out["result"][oid][0][2][:length]
+
+    def deliver_all(self) -> None:
+        for p in self.pools.values():
+            for g in p["pgs"].values():
+                g.bus.deliver_all()
+
+    def shutdown(self) -> None:
+        """Unhook every PG backend from the (possibly shared) Context so a
+        discarded cluster is collectable and does not shadow later ones."""
+        for p in self.pools.values():
+            for g in p["pgs"].values():
+                g.backend.shutdown()
+
+    # -- cluster-wide status (ceph -s shape) -------------------------------
+
+    def status(self) -> dict:
+        n_pgs = sum(len(p["pgs"]) for p in self.pools.values())
+        return {
+            "osdmap": {"epoch": self.osdmap.epoch,
+                       "num_osds": self.osdmap.max_osd,
+                       "num_up_osds": sum(
+                           1 for o in range(self.osdmap.max_osd)
+                           if self.osdmap.is_up(o))},
+            "pgmap": {"num_pgs": n_pgs,
+                      "num_pools": len(self.pools)},
+        }

@@ -25,9 +25,10 @@ class Entry:
     message: str
 
     def format(self) -> str:
-        t = time.strftime("%Y-%m-%dT%H:%M:%S", time.localtime(self.stamp))
-        frac = f"{self.stamp % 1:.6f}"[1:]
-        return f"{t}{frac} {self.level:2d} {self.subsys}: {self.message}"
+        whole = int(self.stamp)
+        t = time.strftime("%Y-%m-%dT%H:%M:%S", time.localtime(whole))
+        usec = int((self.stamp - whole) * 1e6)   # truncate: no carry issues
+        return f"{t}.{usec:06d} {self.level:2d} {self.subsys}: {self.message}"
 
 
 class Log:

@@ -87,13 +87,17 @@ class CompressorRegistry:
     def __init__(self):
         self._factories = {
             "zlib": ZlibCompressor,
-            "zstd": ZstdCompressor,
             "lzma": LzmaCompressor,
             "bz2": Bz2Compressor,
         }
-        # the reference also ships snappy and lz4; their libraries are not
-        # in this environment, so they surface as load failures
+        # the reference also ships snappy and lz4; algorithms whose library
+        # is missing surface as load failures, never as ImportError
         self._unavailable = {"snappy", "lz4"}
+        try:
+            import zstandard  # noqa: F401
+            self._factories["zstd"] = ZstdCompressor
+        except ImportError:
+            self._unavailable.add("zstd")
 
     @classmethod
     def instance(cls) -> "CompressorRegistry":

@@ -99,6 +99,25 @@ class ErasureCode(ErasureCodeInterface):
     def chunk_index(self, i: int) -> int:
         return self.chunk_mapping[i] if len(self.chunk_mapping) > i else i
 
+    def chunk_rank(self, phys: int) -> int:
+        """Physical wire position -> logical chunk id (the inverse of
+        chunk_index; the reference's ErasureCode::chunk_rank shape)."""
+        if len(self.chunk_mapping) > phys:
+            return self.chunk_mapping.index(phys)
+        return phys
+
+    def remap_for_decode(self, chunks, erasures):
+        """Translate physically-keyed available chunks + erasure ids into
+        the codec's logical row space (decode-side counterpart of the
+        chunk_index remap encode applies)."""
+        if not self.chunk_mapping:
+            return dict(chunks), list(erasures)
+        inv = [0] * len(self.chunk_mapping)
+        for logical, phys in enumerate(self.chunk_mapping):
+            inv[phys] = logical
+        return ({inv[i]: v for i, v in chunks.items()},
+                [inv[i] for i in erasures])
+
     def get_chunk_mapping(self) -> list[int]:
         return self.chunk_mapping
 

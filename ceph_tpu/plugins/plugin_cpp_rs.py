@@ -68,10 +68,12 @@ class ErasureCodeCppRS(ErasureCode):
         erasures = [i for i in range(n) if i not in chunks]
         if not erasures:
             return
+        # physical wire positions -> logical matrix rows (see jax_rs)
+        avail, erasures_l = self.remap_for_decode(chunks, erasures)
         chunk_size = next(iter(chunks.values())).nbytes
-        out = self._codec.decode(dict(chunks), erasures, chunk_size)
+        out = self._codec.decode(avail, erasures_l, chunk_size)
         for e, buf in out.items():
-            decoded[e][:] = buf
+            decoded[self.chunk_index(e)][:] = buf
 
 
 class ErasureCodePluginCppRS(ErasureCodePlugin):

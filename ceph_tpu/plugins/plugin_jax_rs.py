@@ -111,11 +111,15 @@ class ErasureCodeJaxRS(ErasureCode):
         erasures = [i for i in range(self.k + self.m) if i not in chunks]
         if not erasures:
             return
-        avail = {i: decoded[i] for i in chunks}
+        # chunk ids on the wire are PHYSICAL positions; the codec's matrix
+        # rows are LOGICAL — translate through the profile mapping both
+        # ways (encode remaps via chunk_index; decode must invert it)
+        avail, erasures_l = self.remap_for_decode(
+            {i: decoded[i] for i in chunks}, erasures)
         nbytes = sum(v.nbytes for v in avail.values())
-        rec = self._route(nbytes).decode(avail, erasures)
+        rec = self._route(nbytes).decode(avail, erasures_l)
         for e, buf in rec.items():
-            decoded[e][:] = buf
+            decoded[self.chunk_index(e)][:] = buf
 
 
 class ErasureCodePluginJaxRS(ErasureCodePlugin):

@@ -225,3 +225,29 @@ class TestContextAndBackendWiring:
                   for e in hist["ops"][0]["type_data"]["events"]]
         assert events == ["initiated", "queued_for_pg", "encoded",
                           "commit_sent", "done"]
+
+
+def test_backend_shutdown_unhooks_context_and_bus():
+    """shutdown() must remove every registration the constructor added
+    (review regression: leaked closures pinned dead backends)."""
+    from ceph_tpu.backend import make_cluster
+    from ceph_tpu.plugins.registry import ErasureCodePluginRegistry
+    ec = ErasureCodePluginRegistry.instance().factory(
+        "jax_rs", "", {"k": "4", "m": "2", "device": "numpy",
+                       "technique": "reed_sol_van"})
+    cct = Context()
+    backend, bus = make_cluster(ec, chunk_size=128, cct=cct)
+    assert "dump_ops_in_flight.0" in cct.admin_socket.call("help")
+    assert backend.on_shard_down in bus.down_listeners
+    backend.shutdown()
+    assert "dump_ops_in_flight.0" not in cct.admin_socket.call("help")
+    assert "ec_backend.0" not in cct.perf.perf_dump()
+    assert backend.on_shard_down not in bus.down_listeners
+    assert backend.on_shard_up not in bus.up_listeners
+
+
+def test_log_timestamp_no_rounding_carry():
+    from ceph_tpu.common.log import Entry
+    e = Entry(stamp=1000000.9999996, subsys="osd", level=1, message="x")
+    # truncation: fraction stays within the same second
+    assert ".999999" in e.format()

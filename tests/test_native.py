@@ -251,3 +251,18 @@ class TestPythonPluginBridge:
         for t in threads:
             t.join()
         assert not errors, errors[:1]
+
+    def test_mapped_decode_roundtrip_cpp_rs(self):
+        """Decode must invert the physical->logical mapping (review/corpus
+        regression: encode remapped but decode did not)."""
+        from ceph_tpu.plugins.registry import ErasureCodePluginRegistry
+        reg = ErasureCodePluginRegistry.instance()
+        ec = reg.factory("cpp_rs", "", {"k": "4", "m": "2",
+                                        "technique": "reed_sol_van",
+                                        "mapping": "_DDD_D"})
+        data = bytes(payload(1, 8192, seed=12)[0].tobytes())
+        enc = ec.encode(set(range(6)), data)
+        for lost in ((0,), (1,), (0, 1), (1, 5), (0, 4)):
+            avail = {i: v for i, v in enc.items() if i not in lost}
+            got = ec.decode_concat(avail)[:len(data)]
+            assert bytes(got) == data, f"erasure {lost}"

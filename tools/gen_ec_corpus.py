@@ -1,0 +1,94 @@
+#!/usr/bin/env python3
+"""Generate the erasure-code non-regression corpus.
+
+Mirror of the reference's corpus scheme (reference:
+src/test/erasure-code/ceph_erasure_code_non_regression.cc — writes chunk
+files for a fixed pseudo-random payload per (plugin, profile) and re-checks
+them across versions via
+qa/workunits/erasure-code/encode-decode-non-regression.sh:19-40; the
+archived corpus is the ceph-erasure-code-corpus submodule).  Here the
+corpus records SHA-256 digests of every chunk instead of raw chunk files —
+equally binding for bit-stability, kilobytes instead of megabytes in git.
+
+Run from the repo root to (re)generate tests/golden/ec_corpus.json; the
+committed file is what tests/test_ec_corpus.py replays.  Only add entries;
+changing an existing digest is an encoding break.
+"""
+import hashlib
+import json
+import os
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+OUT = os.path.join(REPO, "tests", "golden", "ec_corpus.json")
+
+PAYLOAD_SIZE = 31116      # deliberately unaligned (forces padding paths)
+PAYLOAD_SEED = 0xEC
+
+PROFILES = [
+    ("jax_rs", {"k": "2", "m": "1", "technique": "reed_sol_van"}),
+    ("jax_rs", {"k": "4", "m": "2", "technique": "reed_sol_van"}),
+    ("jax_rs", {"k": "8", "m": "4", "technique": "reed_sol_van"}),
+    ("jax_rs", {"k": "10", "m": "4", "technique": "reed_sol_van"}),
+    ("jax_rs", {"k": "4", "m": "2", "technique": "cauchy"}),
+    ("jax_rs", {"k": "8", "m": "4", "technique": "cauchy"}),
+    ("jax_rs", {"k": "6", "m": "3", "technique": "vandermonde"}),
+    ("jax_rs", {"k": "4", "m": "2", "technique": "reed_sol_van",
+                "mapping": "_DDD_D"}),
+    ("cpp_rs", {"k": "4", "m": "2", "technique": "reed_sol_van"}),
+    ("cpp_rs", {"k": "8", "m": "4", "technique": "cauchy"}),
+    ("xor", {"k": "3", "m": "1"}),
+    ("shec", {"k": "4", "m": "3", "c": "2"}),
+    ("lrc", {"k": "4", "m": "2", "l": "3"}),
+    ("clay", {"k": "4", "m": "2", "d": "5",
+              "scalar_mds": "jax_rs"}),
+]
+
+
+def payload() -> bytes:
+    rng = np.random.default_rng(PAYLOAD_SEED)
+    return rng.integers(0, 256, size=PAYLOAD_SIZE, dtype=np.uint8).tobytes()
+
+
+def entry_name(plugin: str, profile: dict) -> str:
+    parts = "_".join(f"{k}={v}" for k, v in sorted(profile.items())
+                     if k != "plugin")
+    return f"{plugin}/{parts}"
+
+
+def main() -> int:
+    from ceph_tpu.plugins.registry import ErasureCodePluginRegistry
+    reg = ErasureCodePluginRegistry.instance()
+    data = payload()
+    corpus = {"payload_seed": PAYLOAD_SEED, "payload_size": PAYLOAD_SIZE,
+              "entries": {}}
+    for plugin, profile in PROFILES:
+        prof = dict(profile)
+        if plugin in ("jax_rs", "clay"):
+            prof.setdefault("device", "numpy")
+        ec = reg.factory(plugin, "", prof)
+        n = ec.get_chunk_count()
+        encoded = ec.encode(set(range(n)), data)
+        digests = {str(i): hashlib.sha256(
+            np.ascontiguousarray(encoded[i]).tobytes()).hexdigest()
+            for i in sorted(encoded)}
+        corpus["entries"][entry_name(plugin, profile)] = {
+            "plugin": plugin,
+            "profile": profile,
+            "chunk_size": int(encoded[0].nbytes),
+            "chunk_sha256": digests,
+        }
+        print(f"{entry_name(plugin, profile)}: {n} chunks x "
+              f"{encoded[0].nbytes}")
+    with open(OUT, "w") as f:
+        json.dump(corpus, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(f"wrote {OUT}: {len(corpus['entries'])} entries")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
