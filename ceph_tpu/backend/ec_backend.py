@@ -233,6 +233,7 @@ class ECBackend:
                 h.total_chunk_size = stored["total_chunk_size"]
                 h.cumulative_shard_hashes = list(stored["cumulative_shard_hashes"])
                 h.projected_total_chunk_size = h.total_chunk_size
+                h.version = stored.get("version", 0)
             except (FileNotFoundError, KeyError):
                 h = HashInfo(n)
             self.hinfo_cache[oid] = h
@@ -459,6 +460,7 @@ class ECBackend:
         for oid, will_write in op.plan.will_write.items():
             objop = op.plan.t.ops[oid]
             hinfo = op.plan.hash_infos[oid]
+            hinfo.version += 1      # down shards miss this bump -> stale
             if objop.delete_first:
                 for chunk, shard in enumerate(self.acting):
                     shard_txns[shard].remove(GObject(oid, shard))
@@ -862,9 +864,15 @@ class ECBackend:
             except (FileNotFoundError, KeyError):
                 out[chunk] = False
                 continue
+            # version check first: a shard that missed writes while down is
+            # stale even when overwrites cleared the chunk hashes (the
+            # PG-log-version role; see HashInfo.version)
+            if stored.get("version", 0) != self._hinfo(oid).version:
+                out[chunk] = False
+                continue
             hashes = stored.get("cumulative_shard_hashes") or []
             if not hashes:
-                out[chunk] = True  # hash cleared by overwrite: nothing to check
+                out[chunk] = True  # hash cleared by overwrite; version matched
                 continue
             out[chunk] = crc32c(0xFFFFFFFF, data) == hashes[chunk] and \
                 len(data) == stored["total_chunk_size"]

@@ -123,6 +123,12 @@ class HashInfo:
         self.total_chunk_size = 0
         self.cumulative_shard_hashes = [0xFFFFFFFF] * num_chunks
         self.projected_total_chunk_size = 0
+        # per-object write version, bumped on every committed transaction
+        # and persisted with each shard: a shard that missed writes while
+        # down is detectably stale even after overwrites cleared the chunk
+        # hashes (the role the reference's PG log versions play,
+        # src/osd/PGLog.cc divergence detection)
+        self.version = 0
 
     def append(self, old_size: int, to_append: dict[int, np.ndarray]) -> None:
         assert old_size == self.total_chunk_size
@@ -169,7 +175,8 @@ class HashInfo:
 
     def to_dict(self) -> dict:
         return {"total_chunk_size": self.total_chunk_size,
-                "cumulative_shard_hashes": list(self.cumulative_shard_hashes)}
+                "cumulative_shard_hashes": list(self.cumulative_shard_hashes),
+                "version": self.version}
 
 
 # -- batched stripe codec ----------------------------------------------------

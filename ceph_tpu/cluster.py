@@ -32,6 +32,8 @@ from .plugins.registry import ErasureCodePluginRegistry
 
 import itertools
 
+NONE_ID = 0x7FFFFFFF          # CRUSH_ITEM_NONE
+
 _cluster_ids = itertools.count(1)
 
 
@@ -81,6 +83,7 @@ class MiniCluster:
         self._next_pool = 1
         self.pools: dict[int, dict] = {}       # pool_id -> {pgs, pool, ec}
         self.pool_ids: dict[str, int] = {}
+        self.objects: dict[int, set[str]] = {}  # pool_id -> written oids
 
     # -- pool creation (the mon's osd pool create path) --------------------
 
@@ -147,6 +150,7 @@ class MiniCluster:
             PGTransaction().write(oid, 0, bytes(data) + b"\0" * pad))
         if deliver:
             g.bus.deliver_all()
+        self.objects.setdefault(pool_id, set()).add(oid)
         return g
 
     def get(self, pool_id: int, oid: str, length: int) -> bytes:
@@ -171,6 +175,95 @@ class MiniCluster:
         for p in self.pools.values():
             for g in p["pgs"].values():
                 g.backend.shutdown()
+
+    # -- control plane -----------------------------------------------------
+
+    def _pg_objects(self, pool_id: int, g: PGGroup) -> list[str]:
+        return [oid for oid in sorted(self.objects.get(pool_id, ()))
+                if self.pools[pool_id]["pgs"][self.object_pg(pool_id, oid)]
+                is g]
+
+    def _repair_after_boot(self, pool_id: int, g: PGGroup) -> None:
+        """Bring a rebooted shard current BEFORE it serves reads: deep-scrub
+        every object and recover stale/missing chunks (the role peering +
+        log-based recovery play in the reference — a revived OSD never
+        serves until caught up)."""
+        from .backend.ec_backend import RecoveryState
+        for oid in self._pg_objects(pool_id, g):
+            report = g.backend.be_deep_scrub(oid)
+            missing = {c for c, clean in report.items() if not clean}
+            if missing:
+                rop = g.backend.recover_object(oid, missing)
+                g.bus.deliver_all()
+                if rop.state != RecoveryState.COMPLETE:
+                    raise IOError(
+                        f"repair of {oid} chunks {missing} after boot "
+                        f"failed: {rop.state}")
+
+    def _backfill_pg(self, pool_id: int, ps: int, new_acting: list[int],
+                     ec) -> None:
+        """Acting set changed (auto-out remapping): move the PG's data to
+        the new layout — read every object through the old group (degraded
+        reads reconstruct), re-encode into a fresh group (the reference's
+        backfill)."""
+        old = self.pools[pool_id]["pgs"][ps]
+        new = PGGroup(PG(pool_id, ps), new_acting, ec, self.chunk_size,
+                      self.cct, name_prefix=f"c{self.cluster_id}e"
+                                            f"{self.osdmap.epoch}")
+        for oid in self._pg_objects(pool_id, old):
+            size = old.backend.object_size(oid)
+            out = {}
+            old.backend.objects_read_and_reconstruct(
+                {oid: [(0, size)]},
+                lambda result, errors: out.update(result=result,
+                                                  errors=errors))
+            old.bus.deliver_all()
+            if out.get("errors"):
+                raise IOError(f"backfill read of {oid}: {out['errors']}")
+            data = out["result"][oid][0][2]
+            new.backend.submit_transaction(PGTransaction().write(oid, 0, data))
+            new.bus.deliver_all()
+        old.backend.shutdown()
+        self.pools[pool_id]["pgs"][ps] = new
+
+    def attach_monitor(self):
+        """Wire a Monitor over this cluster's OSDMap: committed epochs
+        propagate to the data path the way daemons react to osdmap epoch
+        bumps in the reference — down-marks route around the shard,
+        boot-marks repair it before it serves, and weight changes
+        (auto-out) backfill PGs onto their new acting sets."""
+        from .mon import Monitor
+        from .osdmap import OSD_UP
+        mon = Monitor(self.osdmap, cct=self.cct)
+
+        def on_map(new_map, inc):
+            self.osdmap = new_map
+            for o, st in inc.new_state.items():
+                if not (st & OSD_UP):
+                    continue
+                down_now = new_map.is_down(o)
+                for pid, pool in self.pools.items():
+                    for g in pool["pgs"].values():
+                        if o not in g.acting:
+                            continue
+                        if down_now:
+                            g.bus.mark_down(o)
+                        else:
+                            g.bus.mark_up(o)
+                            self._repair_after_boot(pid, g)
+            if inc.new_weight:
+                # CRUSH remapping: re-place every PG, backfill the changed
+                for pid, pool in self.pools.items():
+                    ec = pool["ec"]
+                    for ps, g in list(pool["pgs"].items()):
+                        _, _, acting, _ = new_map.pg_to_up_acting_osds(
+                            PG(pid, ps))
+                        if (acting and NONE_ID not in acting and
+                                list(acting) != list(g.acting)):
+                            self._backfill_pg(pid, ps, list(acting), ec)
+        mon.subscribers.append(on_map)
+        self.monitor = mon
+        return mon
 
     # -- cluster-wide status (ceph -s shape) -------------------------------
 

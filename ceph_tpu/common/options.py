@@ -107,6 +107,13 @@ OPTIONS: list[Option] = [
            description="seconds without heartbeat before reporting down"),
     Option("mon_osd_min_down_reporters", TYPE_UINT, LEVEL_ADVANCED,
            default=2, description="failure reports needed to mark down"),
+    Option("mon_osd_min_up_ratio", TYPE_FLOAT, LEVEL_ADVANCED, default=0.3,
+           description="refuse down-marks below this up fraction"),
+    Option("mon_osd_down_out_interval", TYPE_INT, LEVEL_ADVANCED,
+           default=600, description="seconds down before auto-out"),
+    Option("mon_osd_reporter_subtree_level", TYPE_STR, LEVEL_ADVANCED,
+           default="host",
+           description="crush level for counting distinct failure reporters"),
     Option("ec_batch_max_stripes", TYPE_UINT, LEVEL_ADVANCED, default=256,
            description="stripes coalesced per device dispatch"),
     Option("ec_device_threshold_bytes", TYPE_SIZE, LEVEL_ADVANCED,

@@ -204,6 +204,28 @@ class CrushMap:
                 return i
         raise KeyError(f"unknown crush item {name}")
 
+    def parent_of(self, item: int) -> int | None:
+        """Containing bucket id (None at a root)."""
+        for b in self.buckets.values():
+            if item in b.items:
+                return b.id
+        return None
+
+    def get_full_location(self, item: int) -> dict[str, str]:
+        """type-name -> bucket/item-name chain from item to root
+        (CrushWrapper::get_full_location shape; feeds the failure
+        reporter-subtree grouping, OSDMonitor.cc:2772-2820)."""
+        loc: dict[str, str] = {}
+        cur = item
+        while True:
+            parent = self.parent_of(cur)
+            if parent is None:
+                return loc
+            b = self.buckets[parent]
+            tname = self.type_names.get(b.type, str(b.type))
+            loc[tname] = self.item_names.get(parent, str(parent))
+            cur = parent
+
     def add_simple_rule(self, name: str, root_name: str,
                         failure_domain: str, device_class: str = "",
                         mode: str = "firstn", num_rep: int = 0) -> int:

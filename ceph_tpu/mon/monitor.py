@@ -1,0 +1,152 @@
+"""Monitor: failure reports -> map commits, the control-plane authority.
+
+Mirror of the reference's OSDMonitor failure handling (reference:
+src/mon/OSDMonitor.cc): ``prepare_failure`` collects per-target reports
+(:2874-2930, ``failure_info_t.add_report``), ``check_failure`` marks a
+target down once the failure has aged past the heartbeat grace AND enough
+*distinct failure-domain subtrees* have reported it (:2764-2850 — reporters
+are grouped by ``mon_osd_reporter_subtree_level`` so one flapping host
+can't take peers down), gated by ``can_mark_down``'s nodown flag and
+minimum up-ratio (:2671-2705).  Commits are OSDMap incrementals (the Paxos
+``propose_pending`` analog — single-monitor here, so a commit IS quorum);
+subscribers receive each new map like daemons receiving osdmap epochs.
+Down OSDs age out via ``mon_osd_down_out_interval`` (tick), triggering
+CRUSH remapping exactly like the reference's auto-out.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..common import Context, default_context
+from ..osdmap import Incremental, OSDMap, OSD_UP, apply_incremental
+
+
+@dataclass
+class _FailureInfo:
+    """failure_info_t: reporter -> earliest failed_since."""
+    reporters: dict[int, float] = field(default_factory=dict)
+
+    def add_report(self, reporter: int, failed_since: float) -> None:
+        self.reporters.setdefault(reporter, failed_since)
+
+    def max_failed_since(self) -> float:
+        return max(self.reporters.values()) if self.reporters else 0.0
+
+
+class Monitor:
+    def __init__(self, osdmap: OSDMap, cct: Context | None = None):
+        self.cct = cct if cct is not None else default_context()
+        self.osdmap = osdmap
+        self.failure_info: dict[int, _FailureInfo] = {}
+        self.pending = Incremental()
+        self.subscribers: list = []             # fn(new_map, inc)
+        self.down_stamp: dict[int, float] = {}  # osd -> when marked down
+        self.nodown: set[int] = set()
+
+    # -- failure reports (OSDMonitor.cc:2874) ------------------------------
+
+    def prepare_failure(self, target: int, reporter: int,
+                        failed_since: float, now: float) -> bool:
+        """One OSD reporting a peer failed.  Returns True when the report
+        pushed the target over the down threshold (committed on the next
+        propose/tick)."""
+        if not self.osdmap.is_up(target):
+            return False
+        fi = self.failure_info.setdefault(target, _FailureInfo())
+        fi.add_report(reporter, failed_since)
+        if self.can_mark_down(target):
+            return self.check_failure(now, target)
+        return False
+
+    def cancel_failure(self, target: int, reporter: int) -> None:
+        """A peer heard from the target again (:2911-2930)."""
+        fi = self.failure_info.get(target)
+        if fi is None:
+            return
+        fi.reporters.pop(reporter, None)
+        if not fi.reporters:
+            del self.failure_info[target]
+
+    def can_mark_down(self, osd: int) -> bool:
+        """(:2671-2705): nodown flag + minimum up ratio."""
+        if osd in self.nodown:
+            return False
+        num = self.osdmap.max_osd
+        if num == 0:
+            return False
+        pending_down = sum(
+            1 for o, st in self.pending.new_state.items()
+            if st & OSD_UP and self.osdmap.is_up(o))
+        up = sum(1 for o in range(num) if self.osdmap.is_up(o)) - pending_down
+        return (up / num) >= self.cct.conf.get("mon_osd_min_up_ratio")
+
+    def check_failure(self, now: float, target: int) -> bool:
+        """(:2764-2850): grace + distinct reporter subtrees."""
+        if (self.pending.new_state.get(target, 0) & OSD_UP):
+            return True                          # already pending
+        fi = self.failure_info.get(target)
+        if fi is None or not fi.reporters:
+            return False
+        failed_for = now - fi.max_failed_since()
+        grace = self.cct.conf.get("osd_heartbeat_grace")
+        level = self.cct.conf.get("mon_osd_reporter_subtree_level")
+        subtrees = set()
+        for reporter in fi.reporters:
+            loc = self.osdmap.crush.get_full_location(reporter)
+            subtrees.add(loc.get(level, f"osd.{reporter}"))
+        if (failed_for >= grace and
+                len(subtrees) >=
+                self.cct.conf.get("mon_osd_min_down_reporters")):
+            self.pending.new_state[target] = \
+                self.pending.new_state.get(target, 0) | OSD_UP
+            self.cct.dout("osd", 1,
+                          f"osd.{target} failed ({len(subtrees)} reporters "
+                          f"from different {level} after {failed_for:.1f} "
+                          f">= grace {grace})")
+            return True
+        return False
+
+    # -- boots / outs ------------------------------------------------------
+
+    def osd_boot(self, osd: int) -> None:
+        """An OSD (re)announcing itself (OSDMonitor preprocess_boot path)."""
+        if not self.osdmap.is_up(osd):
+            self.pending.new_state[osd] = \
+                self.pending.new_state.get(osd, 0) | OSD_UP
+        self.failure_info.pop(osd, None)
+
+    # -- commit (the Paxos propose_pending analog) -------------------------
+
+    def propose_pending(self, now: float) -> OSDMap | None:
+        if (not self.pending.new_state and not self.pending.new_weight and
+                not self.pending.new_pg_temp and
+                not self.pending.new_pg_upmap_items):
+            return None
+        inc, self.pending = self.pending, Incremental()
+        old = self.osdmap
+        self.osdmap = apply_incremental(old, inc)
+        for o, st in inc.new_state.items():
+            if st & OSD_UP:
+                if old.is_up(o) and not self.osdmap.is_up(o):
+                    self.down_stamp[o] = now
+                    self.failure_info.pop(o, None)
+                elif not old.is_up(o) and self.osdmap.is_up(o):
+                    self.down_stamp.pop(o, None)
+        for fn in self.subscribers:
+            fn(self.osdmap, inc)
+        return self.osdmap
+
+    def tick(self, now: float) -> OSDMap | None:
+        """Periodic work: age pending failures, auto-out long-down OSDs."""
+        for target in list(self.failure_info):
+            if self.can_mark_down(target):
+                self.check_failure(now, target)
+        out_after = self.cct.conf.get("mon_osd_down_out_interval")
+        for o, since in list(self.down_stamp.items()):
+            if (now - since >= out_after and self.osdmap.is_in(o) and
+                    not self.osdmap.is_up(o)):
+                self.pending.new_weight[o] = 0
+                self.cct.dout("osd", 1, f"osd.{o} auto-out after "
+                                        f"{now - since:.0f}s down")
+                del self.down_stamp[o]
+        return self.propose_pending(now)

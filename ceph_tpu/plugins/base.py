@@ -99,13 +99,6 @@ class ErasureCode(ErasureCodeInterface):
     def chunk_index(self, i: int) -> int:
         return self.chunk_mapping[i] if len(self.chunk_mapping) > i else i
 
-    def chunk_rank(self, phys: int) -> int:
-        """Physical wire position -> logical chunk id (the inverse of
-        chunk_index; the reference's ErasureCode::chunk_rank shape)."""
-        if len(self.chunk_mapping) > phys:
-            return self.chunk_mapping.index(phys)
-        return phys
-
     def remap_for_decode(self, chunks, erasures):
         """Translate physically-keyed available chunks + erasure ids into
         the codec's logical row space (decode-side counterpart of the

@@ -1,0 +1,285 @@
+"""Monitor failure path + heartbeats: grace aging, distinct-subtree
+reporters, min-up-ratio refusal, nodown, auto-out, boot.
+
+Mirrors the reference semantics at src/mon/OSDMonitor.cc (prepare_failure
+:2874, check_failure :2764-2850, can_mark_down :2671) and src/osd/OSD.cc
+heartbeats (:4547-4996)."""
+import pytest
+
+from ceph_tpu.common import Context
+from ceph_tpu.mon import HeartbeatAgent, Monitor, VirtualClock
+from ceph_tpu.mon.heartbeat import build_heartbeat_mesh
+from ceph_tpu.osdmap import PG
+
+from test_osdmap import build_cluster
+
+GRACE = 20          # osd_heartbeat_grace default
+
+
+def make_mon(**conf):
+    cct = Context(overrides=conf or None)
+    m = build_cluster()                   # 3 racks x 3 hosts x 3 osds
+    return Monitor(m, cct=cct), cct
+
+
+class TestMonitorFailurePath:
+    def test_two_subtree_reporters_after_grace_marks_down(self):
+        mon, _ = make_mon()
+        t0 = 100.0
+        # reporters 3 and 6 are on different hosts
+        assert not mon.prepare_failure(0, 3, failed_since=t0, now=t0 + 1)
+        assert not mon.prepare_failure(0, 6, failed_since=t0, now=t0 + 1)
+        # too early: within grace
+        assert mon.propose_pending(t0 + 1) is None
+        # after grace, a re-check succeeds
+        assert mon.prepare_failure(0, 6, failed_since=t0, now=t0 + GRACE)
+        new = mon.propose_pending(t0 + GRACE)
+        assert new is not None and new.is_down(0)
+        assert new.epoch == 2
+
+    def test_same_host_reporters_insufficient(self):
+        """Reporters from ONE host don't satisfy min_down_reporters=2
+        distinct subtrees (OSDMonitor.cc:2772-2820)."""
+        mon, _ = make_mon()
+        t0 = 0.0
+        # osds 1 and 2 share osd.0's host (first host holds osds 0,1,2)
+        mon.prepare_failure(0, 1, failed_since=t0, now=t0 + GRACE + 1)
+        assert not mon.prepare_failure(0, 2, failed_since=t0,
+                                       now=t0 + GRACE + 1)
+        assert mon.propose_pending(t0 + GRACE + 1) is None
+        # a reporter from another host tips it
+        assert mon.prepare_failure(0, 8, failed_since=t0, now=t0 + GRACE + 1)
+
+    def test_cancel_report_retracts(self):
+        mon, _ = make_mon()
+        mon.prepare_failure(0, 3, failed_since=0.0, now=1.0)
+        mon.cancel_failure(0, 3)
+        assert 0 not in mon.failure_info
+        mon.tick(GRACE + 5)
+        assert mon.osdmap.is_up(0)
+
+    def test_min_up_ratio_refuses_mass_downs(self):
+        mon, _ = make_mon(mon_osd_min_up_ratio=0.75)
+        n = mon.osdmap.max_osd               # 27
+        t0 = 0.0
+        now = GRACE + 1.0
+        marked = 0
+        for target in range(n):
+            mon.prepare_failure(target, (target + 3) % n, t0, now)
+            mon.prepare_failure(target, (target + 9) % n, t0, now)
+            mon.propose_pending(now)
+        up = sum(1 for o in range(n) if mon.osdmap.is_up(o))
+        # the reference checks the ratio BEFORE each mark, so the floor can
+        # dip at most one mark below it (OSDMonitor.cc:2683-2693)
+        assert up / n >= 0.75 - 1.0 / n - 1e-9
+        assert up < n                        # but marks did happen
+
+    def test_nodown_flag(self):
+        mon, _ = make_mon()
+        mon.nodown.add(0)
+        mon.prepare_failure(0, 3, failed_since=0.0, now=GRACE + 1)
+        mon.prepare_failure(0, 6, failed_since=0.0, now=GRACE + 1)
+        mon.tick(GRACE + 2)
+        assert mon.osdmap.is_up(0)
+
+    def test_auto_out_after_interval(self):
+        mon, _ = make_mon(mon_osd_down_out_interval=600)
+        mon.prepare_failure(0, 3, 0.0, GRACE + 1)
+        mon.prepare_failure(0, 6, 0.0, GRACE + 1)
+        mon.propose_pending(GRACE + 1)
+        assert mon.osdmap.is_down(0) and mon.osdmap.is_in(0)
+        mon.tick(GRACE + 1 + 599)
+        assert mon.osdmap.is_in(0)
+        mon.tick(GRACE + 1 + 601)
+        assert mon.osdmap.is_out(0)          # weight 0 -> CRUSH remaps
+
+    def test_boot_marks_up_and_clears_reports(self):
+        mon, _ = make_mon()
+        mon.prepare_failure(0, 3, 0.0, GRACE + 1)
+        mon.prepare_failure(0, 6, 0.0, GRACE + 1)
+        mon.propose_pending(GRACE + 1)
+        assert mon.osdmap.is_down(0)
+        mon.osd_boot(0)
+        new = mon.propose_pending(GRACE + 2)
+        assert new.is_up(0)
+        assert new.epoch == 3
+
+    def test_subscribers_see_commits(self):
+        mon, _ = make_mon()
+        seen = []
+        mon.subscribers.append(lambda m, inc: seen.append(m.epoch))
+        mon.prepare_failure(0, 3, 0.0, GRACE + 1)
+        mon.prepare_failure(0, 6, 0.0, GRACE + 1)
+        mon.propose_pending(GRACE + 1)
+        assert seen == [2]
+
+
+class TestHeartbeats:
+    def test_silent_osd_detected_and_marked_down(self):
+        mon, _ = make_mon()
+        clock = VirtualClock()
+        agents = build_heartbeat_mesh(mon, clock, mon.osdmap.max_osd)
+        for _ in range(3):                   # establish baselines
+            clock.advance(6)
+            for a in agents.values():
+                a.tick()
+        victim = 5
+        agents[victim] = None
+        mon_net = next(iter(agents.values())).network
+        mon_net[victim] = None               # dead: drops pings
+        for _ in range(6):                   # ride out the grace
+            clock.advance(6)
+            for a in agents.values():
+                if a is not None:
+                    a.tick()
+            mon.tick(clock.now())
+        assert mon.osdmap.is_down(victim)
+        for o in range(mon.osdmap.max_osd):
+            if o != victim:
+                assert mon.osdmap.is_up(o), f"osd.{o} wrongly down"
+
+    def test_recovered_peer_cancels_reports(self):
+        mon, _ = make_mon(mon_osd_min_down_reporters=26)  # never commits
+        clock = VirtualClock()
+        agents = build_heartbeat_mesh(mon, clock, mon.osdmap.max_osd)
+        clock.advance(6)
+        for a in agents.values():
+            a.tick()
+        net = agents[0].network
+        net[5] = None                        # silence osd.5
+        for _ in range(5):
+            clock.advance(6)
+            for o, a in agents.items():
+                if net.get(o) is not None:
+                    a.tick()
+        assert 5 in mon.failure_info
+        net[5] = agents[5]                   # revive
+        for _ in range(2):
+            clock.advance(6)
+            for o, a in agents.items():
+                if net.get(o) is not None:
+                    a.tick()
+        assert 5 not in mon.failure_info     # reports canceled
+
+
+class TestMapDrivenRemap:
+    def test_down_then_out_remaps_pgs(self):
+        """The end-to-end control loop: failure -> down (holes) -> out
+        (CRUSH refills), driving the data path's acting sets."""
+        mon, _ = make_mon(mon_osd_down_out_interval=60)
+        pgid = PG(2, 0)
+        acting0 = mon.osdmap.pg_to_up_acting_osds(pgid)[2]
+        victim = acting0[0]
+        reporters = [o for o in range(mon.osdmap.max_osd)
+                     if o not in (victim,)][:6]
+        for r in reporters:
+            mon.prepare_failure(victim, r, 0.0, GRACE + 1)
+        mon.propose_pending(GRACE + 1)
+        acting_down = mon.osdmap.pg_to_up_acting_osds(pgid)[2]
+        assert acting_down[0] == 0x7FFFFFFF  # EC positional hole
+        mon.tick(GRACE + 100)                # past down_out_interval
+        acting_out = mon.osdmap.pg_to_up_acting_osds(pgid)[2]
+        assert victim not in acting_out
+        assert 0x7FFFFFFF not in acting_out  # CRUSH refilled the slot
+
+
+class TestClusterControlLoop:
+    def test_heartbeat_failure_drives_data_path(self):
+        """Full loop: heartbeats detect a silent OSD -> monitor commits the
+        down-mark -> PG buses route around it -> degraded reads succeed ->
+        boot revives -> repair restores the shard."""
+        import numpy as np
+        from ceph_tpu.cluster import MiniCluster
+        from ceph_tpu.mon.heartbeat import build_heartbeat_mesh
+        from ceph_tpu.backend.ec_backend import RecoveryState
+
+        cluster = MiniCluster(n_osds=12, chunk_size=128)
+        pid = cluster.create_ec_pool(
+            "loop", {"plugin": "jax_rs", "k": "4", "m": "2",
+                     "device": "numpy", "technique": "reed_sol_van"},
+            pg_num=4)
+        mon = cluster.attach_monitor()
+        data = np.arange(4 * 128, dtype=np.uint8).tobytes() * 2
+        for i in range(8):
+            cluster.put(pid, f"o{i}", data)
+
+        clock = VirtualClock()
+        agents = build_heartbeat_mesh(mon, clock, 12)
+        for _ in range(2):
+            clock.advance(6)
+            for a in agents.values():
+                a.tick()
+        # pick a non-primary victim and silence it
+        primaries = {g.backend.whoami
+                     for g in cluster.pools[pid]["pgs"].values()}
+        victim = next(o for o in range(12) if o not in primaries)
+        net = agents[0].network
+        net[victim] = None
+        for _ in range(6):
+            clock.advance(6)
+            for o, a in agents.items():
+                if net.get(o) is not None:
+                    a.tick()
+            mon.tick(clock.now())
+        assert mon.osdmap.is_down(victim)
+        # data path saw the mark: PG buses route around the victim
+        for g in cluster.pools[pid]["pgs"].values():
+            if victim in g.acting:
+                assert victim in g.bus.down
+        for i in range(8):
+            assert cluster.get(pid, f"o{i}", len(data)) == data
+        # write while the victim is down (it goes stale), then boot + repair
+        cluster.put(pid, "o0", data[::-1])
+        net[victim] = agents[victim]
+        mon.osd_boot(victim)
+        mon.propose_pending(clock.now())
+        assert mon.osdmap.is_up(victim)
+        for g in cluster.pools[pid]["pgs"].values():
+            if victim not in g.acting:
+                continue
+            for oid in [f"o{i}" for i in range(8)]:
+                if cluster.pg_group(pid, oid) is not g:
+                    continue
+                report = g.backend.be_deep_scrub(oid)
+                missing = {c for c, ok in report.items() if not ok}
+                if missing:
+                    rop = g.backend.recover_object(oid, missing)
+                    g.bus.deliver_all()
+                    assert rop.state == RecoveryState.COMPLETE
+        want0 = data[::-1]
+        assert cluster.get(pid, "o0", len(want0)) == want0
+
+    def test_auto_out_triggers_backfill(self):
+        """down -> auto-out -> CRUSH remap -> backfill: data lands on the
+        new acting sets and reads survive with the old OSD gone for good."""
+        import numpy as np
+        from ceph_tpu.cluster import MiniCluster
+
+        cct = Context(overrides={"mon_osd_down_out_interval": 60})
+        cluster = MiniCluster(n_osds=12, chunk_size=128, cct=cct)
+        pid = cluster.create_ec_pool(
+            "bf", {"plugin": "jax_rs", "k": "4", "m": "2",
+                   "device": "numpy", "technique": "reed_sol_van"},
+            pg_num=4)
+        mon = cluster.attach_monitor()
+        data = {f"b{i}": np.random.default_rng(i).integers(
+                    0, 256, size=1024, dtype=np.uint8).tobytes()
+                for i in range(12)}
+        for oid, v in data.items():
+            cluster.put(pid, oid, v)
+
+        primaries = {g.backend.whoami
+                     for g in cluster.pools[pid]["pgs"].values()}
+        victim = next(o for o in range(12) if o not in primaries)
+        reporters = [o for o in range(12) if o != victim][:4]
+        for r in reporters:
+            mon.prepare_failure(victim, r, 0.0, GRACE + 1)
+        mon.propose_pending(GRACE + 1)
+        assert mon.osdmap.is_down(victim)
+        mon.tick(GRACE + 1000)               # way past down_out_interval
+        assert mon.osdmap.is_out(victim)
+        # every PG was re-placed without the victim and holds the data
+        for g in cluster.pools[pid]["pgs"].values():
+            assert victim not in g.acting
+        for oid, want in data.items():
+            assert cluster.get(pid, oid, len(want)) == want
