@@ -109,10 +109,35 @@ class BulkMapper:
     holes/padding, placed [N] int32).
     """
 
+    # process-wide kernel cache keyed by map content: cloned/equal maps
+    # (the balancer clones per optimization pass) share compilations.
+    # LRU-bounded: reweight churn produces a new digest per distinct map,
+    # and each entry pins jitted closures over the compiled arrays.
+    _global_cache: "collections.OrderedDict" = None
+    _GLOBAL_CACHE_CAP = 16
+
     def __init__(self, cmap: CrushMap):
+        import collections
+        import hashlib
+        cls = type(self)
+        if cls._global_cache is None:
+            cls._global_cache = collections.OrderedDict()
         self.cm = CompiledMap.compile(cmap)
         self.cmap = cmap
-        self._cache = {}
+        h = hashlib.sha256()
+        for part in (self.cm.items.tobytes(), self.cm.weights.tobytes(),
+                     self.cm.sizes.tobytes(), self.cm.types.tobytes()):
+            h.update(part)
+        h.update(repr(sorted(self.cm.tunables.items())).encode())
+        self._digest = h.hexdigest()
+        cache = cls._global_cache
+        if self._digest in cache:
+            cache.move_to_end(self._digest)
+        else:
+            cache[self._digest] = {}
+            while len(cache) > cls._GLOBAL_CACHE_CAP:
+                cache.popitem(last=False)
+        self._cache = cache[self._digest]
 
     # -- kernel construction ------------------------------------------------
 

@@ -204,6 +204,19 @@ class CrushMap:
                 return i
         raise KeyError(f"unknown crush item {name}")
 
+    def device_weights(self) -> dict[int, int]:
+        """Leaf item -> 16.16 weight from its containing bucket
+        (CrushWrapper::get_item_weight semantics)."""
+        out: dict[int, int] = {}
+        for b in self.buckets.values():
+            for i, item in enumerate(b.items):
+                if item >= 0:
+                    if b.item_weights is not None:
+                        out[item] = b.item_weights[i]
+                    elif b.item_weight is not None:
+                        out[item] = b.item_weight
+        return out
+
     def parent_of(self, item: int) -> int | None:
         """Containing bucket id (None at a root)."""
         for b in self.buckets.values():

@@ -1,0 +1,10 @@
+"""Manager modules (SURVEY.md §2.4 mgr): balancer + pg_autoscaler analogs.
+
+The reference runs these as Python modules inside ceph-mgr
+(src/pybind/mgr/{balancer,pg_autoscaler}); here they are library functions
+over OSDMap — same decision logic, emitted as OSDMap incrementals."""
+from .balancer import calc_pg_upmaps, osd_deviation
+from .pg_autoscaler import autoscale_recommendations, nearest_power_of_two
+
+__all__ = ["calc_pg_upmaps", "osd_deviation",
+           "autoscale_recommendations", "nearest_power_of_two"]

@@ -1,0 +1,154 @@
+"""Balancer: even out PG counts with pg_upmap_items.
+
+Mirror of the reference's upmap balancer (reference:
+src/pybind/mgr/balancer/module.py upmap mode driving
+``OSDMap::calc_pg_upmaps``, src/osd/OSDMap.h:1439 — iterate: find the most
+overfull OSD vs its weight-proportional target, move one of its PGs to the
+most underfull OSD via a ``pg_upmap_items`` entry, re-check).  Like the
+reference, moves operate on the **up mapping** (raw CRUSH + upmap, no
+pg_temp — temp mappings are transient recovery state) and every candidate
+is applied speculatively and re-verified through the real mapping chain
+before being kept: the item must actually remove ``over``, land ``under``,
+keep all OSDs distinct, and preserve host-separation where the layout had
+it.
+
+Placement counting runs through the vmapped bulk mapper, one device
+dispatch per pool per iteration (the reference walks PGs on CPU threads).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..crush.map import CRUSH_ITEM_NONE
+from ..osdmap import Incremental, OSDMap, PG
+from ..osdmap.bulk import BulkPGMapper
+
+
+def osd_deviation(m: OSDMap, pools: list[int] | None = None,
+                  mapper: BulkPGMapper | None = None):
+    """Per-OSD (count, target) from the **up** sets; target is
+    weight-proportional.  Returns (counts, targets, mappings) where
+    mappings is {pool_id: PoolMapping} for reuse by the move search."""
+    counts = np.zeros(m.max_osd, dtype=np.int64)
+    total_slots = 0
+    if mapper is None:
+        mapper = BulkPGMapper(m)
+    mappings = {}
+    for pid in (pools if pools is not None else sorted(m.pools)):
+        pm = mapper.map_pool(pid)
+        mappings[pid] = pm
+        for row in pm.up:
+            for o in row:
+                if o != CRUSH_ITEM_NONE:
+                    counts[o] += 1
+                    total_slots += 1
+    cw = m.crush.device_weights()
+    eff = np.zeros(m.max_osd)
+    for o in range(m.max_osd):
+        if m.is_in(o):
+            eff[o] = cw.get(o, 0) * (m.osd_weight[o] / 0x10000)
+    tw = eff.sum()
+    targets = (eff / tw * total_slots) if tw else eff
+    return counts, targets, mappings
+
+
+def _host_of(m: OSDMap) -> dict[int, int]:
+    host = {}
+    for b in m.crush.buckets.values():
+        if m.crush.type_names.get(b.type) == "host":
+            for item in b.items:
+                if item >= 0:
+                    host[item] = b.id
+    return host
+
+
+def _try_move(work: OSDMap, pg: PG, over: int, under: int,
+              host_of: dict[int, int]) -> list[tuple[int, int]] | None:
+    """Build the pg_upmap_items list that moves `over` -> `under` for this
+    PG, apply it speculatively, and verify through the real chain
+    (the reference's try_pg_upmap + re-check).  Returns the verified items
+    list, or None."""
+    up_before, *_ = work.pg_to_raw_up(pg)
+    real_before = [o for o in up_before if o != CRUSH_ITEM_NONE]
+    if over not in real_before or under in real_before:
+        return None
+
+    raw, _ = work.pg_to_raw_osds(pg)
+    items = list(work.pg_upmap_items.get(pg, []))
+    if over in raw:
+        # raw slot maps to `over` directly: add a fresh item
+        items = [(f, t) for f, t in items if f != over] + [(over, under)]
+    else:
+        # `over` only appears via an existing item (f -> over): rewrite it
+        rewritten = False
+        for i, (f, t) in enumerate(items):
+            if t == over:
+                items[i] = (f, under)
+                rewritten = True
+                break
+        if not rewritten:
+            return None
+
+    saved = work.pg_upmap_items.get(pg)
+    work.pg_upmap_items[pg] = items
+    up_after, *_ = work.pg_to_raw_up(pg)
+    real_after = [o for o in up_after if o != CRUSH_ITEM_NONE]
+
+    ok = (over not in real_after and under in real_after and
+          len(real_after) == len(set(real_after)) and
+          len(real_after) == len(real_before))
+    if ok and host_of:
+        hosts_before = [host_of.get(o) for o in real_before]
+        if len(set(hosts_before)) == len(hosts_before):  # was host-separated
+            hosts_after = [host_of.get(o) for o in real_after]
+            ok = len(set(hosts_after)) == len(hosts_after)
+    if not ok:
+        if saved is None:
+            del work.pg_upmap_items[pg]
+        else:
+            work.pg_upmap_items[pg] = saved
+        return None
+    return items
+
+
+def calc_pg_upmaps(m: OSDMap, max_iterations: int = 32,
+                   max_deviation: float = 1.0,
+                   pools: list[int] | None = None) -> Incremental:
+    """Propose pg_upmap_items to bring every OSD within ``max_deviation``
+    PGs of its target.  Returns an Incremental (possibly empty); apply with
+    ``apply_incremental`` or feed to Monitor.pending."""
+    work = m.clone()
+    inc = Incremental()
+    host_of = _host_of(work)
+    pool_ids = pools if pools is not None else sorted(work.pools)
+    mapper = BulkPGMapper(work)     # kernels depend only on the crush tree
+
+    for _ in range(max_iterations):
+        counts, targets, mappings = osd_deviation(work, pool_ids,
+                                                  mapper=mapper)
+        dev = counts - targets
+        mask = np.array([work.is_in(o) and work.is_up(o)
+                         for o in range(work.max_osd)])
+        dev_masked = np.where(mask, dev, 0.0)
+        over = int(dev_masked.argmax())
+        under = int(np.where(mask, dev, np.inf).argmin())
+        if dev_masked[over] <= max_deviation:
+            break
+        moved = False
+        for pid in pool_ids:
+            pm = mappings[pid]
+            for ps in range(work.pools[pid].pg_num):
+                row = [int(o) for o in pm.up[ps] if o != CRUSH_ITEM_NONE]
+                if over not in row or under in row:
+                    continue
+                pg = PG(pid, ps)
+                items = _try_move(work, pg, over, under, host_of)
+                if items is not None:
+                    inc.new_pg_upmap_items[pg] = list(items)
+                    moved = True
+                    break
+            if moved:
+                break
+        if not moved:
+            break
+    return inc

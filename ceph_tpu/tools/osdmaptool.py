@@ -31,17 +31,8 @@ from ..osdmap.bulk import BulkPGMapper
 
 
 def device_crush_weights(crush) -> dict[int, int]:
-    """Leaf item -> 16.16 weight, from the deepest bucket that holds it
-    (CrushWrapper::get_item_weight semantics)."""
-    out: dict[int, int] = {}
-    for b in crush.buckets.values():
-        for i, item in enumerate(b.items):
-            if item >= 0:
-                if b.item_weights is not None:
-                    out[item] = b.item_weights[i]
-                elif b.item_weight is not None:
-                    out[item] = b.item_weight
-    return out
+    """Leaf item -> 16.16 weight (delegates to CrushMap.device_weights)."""
+    return crush.device_weights()
 
 
 def test_map_pgs(m: OSDMap, pool: int = -1, dump: bool = False,
@@ -130,6 +121,12 @@ def main(argv=None) -> int:
     ap.add_argument("--pool", type=int, default=-1)
     ap.add_argument("--print", dest="do_print", action="store_true",
                     help="summarize the map")
+    ap.add_argument("--upmap", metavar="OUT",
+                    help="calculate pg upmap entries to balance pg layout "
+                         "and write them as JSON (osdmaptool --upmap)")
+    ap.add_argument("--upmap-deviation", type=float, default=1.0)
+    ap.add_argument("--upmap-max", type=int, default=32,
+                    help="max optimization iterations")
     args = ap.parse_args(argv)
 
     import jax
@@ -157,6 +154,18 @@ def main(argv=None) -> int:
     if args.test_map_pgs or args.test_map_pgs_dump or args.test_map_pgs_dump_all:
         test_map_pgs(m, pool=args.pool, dump=args.test_map_pgs_dump,
                      dump_all=args.test_map_pgs_dump_all, out=sys.stdout)
+    if args.upmap:
+        from ..mgr import calc_pg_upmaps
+        inc = calc_pg_upmaps(
+            m, max_iterations=args.upmap_max,
+            max_deviation=args.upmap_deviation,
+            pools=None if args.pool == -1 else [args.pool])
+        entries = {f"{pg.pool}.{pg.ps}": items
+                   for pg, items in inc.new_pg_upmap_items.items()}
+        with open(args.upmap, "w") as f:
+            json.dump({"pg_upmap_items": entries}, f, indent=1)
+            f.write("\n")
+        print(f"wrote {len(entries)} pg_upmap_items to {args.upmap}")
     return 0
 
 

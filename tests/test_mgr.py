@@ -1,0 +1,123 @@
+"""mgr modules: balancer (pg_upmap_items optimizer) + pg_autoscaler.
+
+Mirrors the decision logic of src/pybind/mgr/balancer (upmap mode via
+OSDMap::calc_pg_upmaps) and src/pybind/mgr/pg_autoscaler/module.py
+(:270-330)."""
+import numpy as np
+import pytest
+
+from ceph_tpu.mgr import (autoscale_recommendations, calc_pg_upmaps,
+                          nearest_power_of_two, osd_deviation)
+from ceph_tpu.osdmap import apply_incremental
+
+from test_osdmap import build_cluster
+
+
+class TestBalancer:
+    def test_balancing_reduces_deviation(self):
+        m = build_cluster(seed=7)
+        m.pools[1].pg_num = 128          # more PGs: more room to balance
+        m.pools[1].pgp_num = 128
+        counts0, targets0, _ = osd_deviation(m, [1])
+        before = float(np.abs(counts0 - targets0).max())
+        inc = calc_pg_upmaps(m, max_iterations=48, max_deviation=1.0,
+                             pools=[1])
+        assert inc.new_pg_upmap_items, "balancer proposed nothing"
+        m2 = apply_incremental(m, inc)
+        counts1, targets1, _ = osd_deviation(m2, [1])
+        after = float(np.abs(counts1 - targets1).max())
+        assert after < before, f"deviation {before} -> {after}"
+
+    def test_upmaps_keep_placements_valid(self):
+        m = build_cluster(seed=8)
+        m.pools[2].pg_num = 64
+        m.pools[2].pgp_num = 64
+        inc = calc_pg_upmaps(m, max_iterations=24, pools=[2])
+        m2 = apply_incremental(m, inc)
+        for ps in range(64):
+            from ceph_tpu.osdmap import PG
+            up, _, acting, _ = m2.pg_to_up_acting_osds(PG(2, ps))
+            real = [o for o in acting if o != 0x7FFFFFFF]
+            assert len(real) == len(set(real)), f"pg {ps}: duplicate osd"
+
+    def test_already_balanced_proposes_nothing(self):
+        m = build_cluster(seed=9)
+        inc = calc_pg_upmaps(m, max_deviation=10_000.0)
+        assert not inc.new_pg_upmap_items
+
+
+    def test_existing_items_rewritten_not_dropped(self):
+        """A pre-existing (f -> over) item must be REWRITTEN to (f, under),
+        not dropped (review regression: dropping resurrects the raw osd
+        and the appended item becomes a no-op)."""
+        from ceph_tpu.osdmap import PG
+        m = build_cluster(seed=12)
+        m.pools[1].pg_num = 64
+        m.pools[1].pgp_num = 64
+        # seed an existing upmap: move raw[0] of pg 1.1 somewhere else
+        raw, _ = m.pg_to_raw_osds(PG(1, 1))
+        other = next(o for o in range(m.max_osd) if o not in raw)
+        m.pg_upmap_items[PG(1, 1)] = [(raw[0], other)]
+        up0, _ = m.pg_to_raw_up(PG(1, 1))
+        assert other in up0
+        # force the balancer to move `other` off this pg
+        inc = calc_pg_upmaps(m, max_iterations=48, max_deviation=0.0,
+                             pools=[1])
+        m2 = apply_incremental(m, inc)
+        for pg, items in inc.new_pg_upmap_items.items():
+            up, _ = m2.pg_to_raw_up(pg)
+            real = [o for o in up if o != 0x7FFFFFFF]
+            assert len(real) == len(set(real))
+            for f, t in items:
+                assert t in real or f not in real, (
+                    f"{pg}: item ({f},{t}) is a no-op")
+
+    def test_moves_verified_against_full_chain(self):
+        """Every proposed item, applied, must actually change the up set
+        it claims to change."""
+        from ceph_tpu.osdmap import PG
+        m = build_cluster(seed=13)
+        m.pools[1].pg_num = 128
+        m.pools[1].pgp_num = 128
+        inc = calc_pg_upmaps(m, max_iterations=32, pools=[1])
+        m2 = apply_incremental(m, inc)
+        for pg, items in inc.new_pg_upmap_items.items():
+            up, _ = m2.pg_to_raw_up(pg)
+            real = [o for o in up if o != 0x7FFFFFFF]
+            for f, t in items:
+                assert f not in real, f"{pg}: {f} still mapped"
+
+
+class TestAutoscaler:
+    def test_nearest_power_of_two(self):
+        assert nearest_power_of_two(1) == 1
+        assert nearest_power_of_two(3) == 4       # 3 is nearer 4 than 2
+        assert nearest_power_of_two(5) == 4
+        assert nearest_power_of_two(6.1) == 8
+        assert nearest_power_of_two(1500) == 1024
+
+    def test_recommendations_shape_and_adjustment(self):
+        m = build_cluster()
+        cap = 100 << 30
+        # pool 1 (replicated size 3, pg_num 64) nearly empty -> shrink
+        # pool 2 (EC 4+2, pg_num 48) holding ~60% of capacity -> grow
+        recs = {r["pool_id"]: r for r in autoscale_recommendations(
+            m, {1: 1 << 20, 2: 40 << 30}, cap,
+            options={2: {"k": 4}})}
+        assert recs[2]["raw_used_rate"] == pytest.approx(6 / 4)
+        assert recs[1]["pg_num_final"] >= 4
+        assert recs[1]["would_adjust"]            # 64 -> tiny
+        assert recs[2]["pg_num_final"] > 48       # grow
+        ideal = recs[2]["pg_num_ideal"]
+        # allowance math: 27 osds * 100 pgs * ratio / rate
+        ratio = 40 * 1.5 / 100
+        assert ideal == int(27 * 100 * ratio / 1.5)
+
+    def test_target_size_ratio_dominates_usage(self):
+        m = build_cluster()
+        recs = autoscale_recommendations(
+            m, {1: 0, 2: 0}, 100 << 30,
+            options={1: {"target_size_ratio": 0.5}})
+        r1 = next(r for r in recs if r["pool_id"] == 1)
+        assert r1["final_ratio"] == 0.5
+        assert r1["pg_num_final"] > 64
