@@ -27,6 +27,11 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops import rs_kernels
 
+try:                                    # jax >= 0.8 moved it out of
+    from jax import shard_map as _shard_map   # experimental
+except ImportError:                     # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+
 
 def make_mesh(n_devices: int | None = None, dp: int | None = None) -> Mesh:
     """A (dp, sp) mesh over the first n_devices devices."""
@@ -72,9 +77,61 @@ def sharded_encode_step(mesh: Mesh, parity_mat: np.ndarray):
             perm=[(i, (i + 1) % ndp) for i in range(ndp)])
         return parity, checksum, rotated
 
-    from jax.experimental.shard_map import shard_map
-    step = shard_map(
+    step = _shard_map(
         local_step, mesh=mesh,
         in_specs=(P("dp", None, "sp"),),
         out_specs=(P("dp", None, "sp"), P("dp"), P("dp", None, "sp")))
     return jax.jit(step)
+
+
+def sharded_decode_step(mesh: Mesh):
+    """Distributed reconstruction: survivors sharded over chips, partial
+    GF products reduced over ICI.
+
+    The reference rebuilds a lost shard by pulling chunks from helper OSDs
+    over the messenger and combining them on the primary
+    (src/osd/ECBackend.cc:565-732 recovery, clay's fractional helper reads).
+    The TPU-native shape: survivor chunks live chunk-sharded on the mesh's
+    dp axis; each chip applies its columns of the decode matrix to its
+    local chunks (a partial GF(2^8) product = XOR-accumulable), and one
+    ``psum`` over the axis IS the helper->rebuilder transfer, riding ICI.
+    GF addition is XOR, which is exactly bitwise-reduce-able: psum over
+    bit-planes mod 2 keeps the math exact.
+
+    Returns step(D, chunks) with D [r, n_survivors] uint8 (replicated) and
+    chunks [n_survivors, N] uint8 sharded [n@dp, N@sp]; output [r, N]
+    sharded [None, N@sp] (fully reconstructed on every dp row).  Survivor
+    counts that don't divide over dp are zero-padded internally (zero
+    chunks contribute nothing to the XOR sum).
+    """
+    ndp = mesh.shape["dp"]
+
+    def local_step(D_blk, chunks_blk):
+        # D_blk: [r, n/dp] this chip's columns; chunks_blk: [n/dp, N/sp]
+        partial = rs_kernels.gf_apply_lookup(D_blk, chunks_blk)  # [r, N/sp]
+        # XOR-reduce over dp: unpack to bit-planes, psum, mod 2, repack —
+        # exact because XOR == addition mod 2 per bit; the per-bit sum is
+        # bounded by ndp, so uint16 keeps the ICI payload small
+        bits = jnp.unpackbits(partial, axis=0, bitorder="little")
+        summed = jax.lax.psum(bits.astype(jnp.uint16), axis_name="dp")
+        rec_bits = (summed & 1).astype(jnp.uint8)
+        return jnp.packbits(rec_bits, axis=0, bitorder="little")
+
+    jitted = jax.jit(_shard_map(
+        local_step, mesh=mesh,
+        in_specs=(P(None, "dp"), P("dp", "sp")),
+        out_specs=P(None, "sp")))
+
+    def step(D, chunks):
+        D = jnp.asarray(D, dtype=jnp.uint8)
+        chunks = jnp.asarray(chunks, dtype=jnp.uint8)
+        n = chunks.shape[0]
+        if D.shape[1] != n:
+            raise ValueError(
+                f"D has {D.shape[1]} columns for {n} survivor chunks")
+        pad = (-n) % ndp
+        if pad:
+            D = jnp.pad(D, ((0, 0), (0, pad)))
+            chunks = jnp.pad(chunks, ((0, pad), (0, 0)))
+        return jitted(D, chunks)
+    return step
