@@ -119,6 +119,10 @@ class RecoveryOp:
     state: RecoveryState = RecoveryState.IDLE
     read_tid: int | None = None
     pending_pushes: set[int] = field(default_factory=set)
+    # sticky: a push target died before acking; even if the remaining
+    # pushes ack, the op must finish FAILED (reference _failed_push fails
+    # the whole op for any dead push target)
+    failed: bool = False
     on_complete: object = None
 
 
@@ -304,7 +308,10 @@ class ECBackend:
             if shard in rop.pending_shards:
                 rop.pending_shards.pop(shard, None)
                 for oid in rop.to_read:
-                    if (chunk in rop.want_shards.get(oid, ()) and
+                    # tried_shards holds every chunk actually requested
+                    # (including retry-widened ones); want_shards is only
+                    # the initial minimum set
+                    if (chunk in rop.tried_shards.get(oid, ()) and
                             chunk not in rop.results.get(oid, {})):
                         rop.errors.setdefault(oid, set()).add(chunk)
                         self._retry_remaining_shards(rop, oid)
@@ -325,6 +332,7 @@ class ECBackend:
         for oid, rop in list(self.recovery_ops.items()):
             if shard in rop.pending_pushes:
                 rop.pending_pushes.discard(shard)
+                rop.failed = True
                 if not rop.pending_pushes and rop.state == RecoveryState.WRITING:
                     self._finish_recovery_op(rop, failed=True)
         self.try_finish_rmw()
@@ -832,7 +840,7 @@ class ECBackend:
             return
         rop.pending_pushes.discard(reply.from_shard)
         if not rop.pending_pushes and rop.state == RecoveryState.WRITING:
-            self._finish_recovery_op(rop)
+            self._finish_recovery_op(rop, failed=rop.failed)
 
     def _finish_recovery_op(self, rop: RecoveryOp, failed: bool = False) -> None:
         """COMPLETE (or FAILED) + drop tracking state so late replies are

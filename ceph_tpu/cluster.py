@@ -95,7 +95,7 @@ class MiniCluster:
         profile.setdefault("m", "2")
         plugin = profile["plugin"]
         ec = ErasureCodePluginRegistry.instance().factory(
-            plugin, "", dict(profile))
+            plugin, "", dict(profile), cct=self.cct)
         n = ec.get_chunk_count()
         # ErasureCode::create_rule semantics: chooseleaf indep over hosts
         # when enough hosts exist, else osds (ErasureCode.cc:64-83)
@@ -112,7 +112,9 @@ class MiniCluster:
         pool = Pool(pool_id=pool_id, type=POOL_TYPE_ERASURE, size=n,
                     min_size=ec.get_data_chunk_count() + 1, pg_num=pg_num,
                     crush_rule=ruleno, name=name,
-                    erasure_code_profile=str(sorted(profile.items())))
+                    erasure_code_profile=" ".join(
+                        f"{k}={v}" for k, v in sorted(profile.items())),
+                    params=dict(profile))
         self.osdmap.add_pool(pool)
 
         pgs = {}

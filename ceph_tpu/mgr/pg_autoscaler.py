@@ -32,10 +32,17 @@ def raw_used_rate(m: OSDMap, pool_id: int, k: int | None = None) -> float:
     (OSDMap::pool_raw_used_rate)."""
     pool = m.pools[pool_id]
     if pool.type == POOL_TYPE_ERASURE:
+        if k is None and pool.params:
+            kv = pool.params.get("k")
+            k = int(kv) if kv is not None else None
         if k is None:
-            # parse from the profile string when available; fall back to
-            # treating size as k+m with m unknown -> conservative size/1
-            k = pool.params.get("k") if pool.params else None
+            # pools rebuilt from a serialized map only carry the profile
+            # string ("k=4 m=2 ..."); parse k from there
+            for kv in (pool.erasure_code_profile or "").split():
+                key, _, val = kv.partition("=")
+                if key == "k" and val.isdigit():
+                    k = int(val)
+                    break
         if k:
             return pool.size / float(k)
         return float(pool.size)

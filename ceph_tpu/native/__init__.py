@@ -281,6 +281,9 @@ class BatchQueue:
             self._q, data.ctypes.data_as(C.POINTER(C.c_ubyte)),
             parity.ctypes.data_as(C.POINTER(C.c_ubyte)), cb, None)
         if rc != 0:
+            # the stripe never entered the queue: its done callback will
+            # never fire, so retire the keep-alive entry now
+            self._done_keep.pop(key, None)
             raise IOError("queue stopped")
         return parity
 
@@ -288,7 +291,10 @@ class BatchQueue:
         self.lib.ec_batch_queue_flush(self._q)
         self._reap()                 # idle barrier passed: thunks are quiet
         if self._err:
-            raise self._err.pop()
+            errs, self._err = self._err, []
+            if len(errs) == 1:
+                raise errs[0]
+            raise BaseExceptionGroup("batch dispatch failures", errs)
 
     @property
     def batches(self) -> int:

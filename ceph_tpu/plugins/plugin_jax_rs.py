@@ -17,7 +17,8 @@ Profile parameters:
   device      jax (TPU) | numpy (exact CPU fallback) | auto (numpy below
               jax-threshold bytes per call, jax above — the latency-vs-
               throughput split from SURVEY.md §7 "dispatch economics")
-  jax-threshold   byte cutoff for device=auto (default 65536)
+  jax-threshold   byte cutoff for device=auto; when absent, the config
+              option ``ec_device_threshold_bytes`` is read live per call
   variant     bitslice | lookup | auto (kernel choice)
   mapping     DDD_D_-style chunk remapping (ErasureCode.cc:274-293)
 """
@@ -72,7 +73,15 @@ class ErasureCodeJaxRS(ErasureCode):
         self.device = self.to_string("device", profile, "auto")
         if self.device not in ("jax", "numpy", "auto"):
             raise ValueError(f"device={self.device} must be jax|numpy|auto")
-        self.jax_threshold = self.to_int("jax-threshold", profile, "65536")
+        # routing cutoff: a profile override pins it; otherwise the
+        # config-store option ``ec_device_threshold_bytes`` is consulted
+        # live per call, so ``config set`` reaches the routing decision
+        from ..common.context import default_context
+        if "jax-threshold" in profile:
+            self.jax_threshold = self.to_int("jax-threshold", profile, "65536")
+        else:
+            self.jax_threshold = None
+        self._conf = default_context().conf
         self.variant = self.to_string("variant", profile, "auto")
         # one codec per backend; 'auto' keeps both and routes per call size
         dev = "numpy" if self.device == "numpy" else "jax"
@@ -84,9 +93,12 @@ class ErasureCodeJaxRS(ErasureCode):
         self._profile = profile
 
     def _route(self, nbytes: int) -> RSCodec:
-        if self.device == "auto" and nbytes < self.jax_threshold:
-            return self._cpu_codec
-        return self.codec
+        if self.device != "auto":
+            return self.codec
+        cutoff = self.jax_threshold
+        if cutoff is None:
+            cutoff = int(self._conf.get("ec_device_threshold_bytes"))
+        return self._cpu_codec if nbytes < cutoff else self.codec
 
     # -- counts ------------------------------------------------------------
 

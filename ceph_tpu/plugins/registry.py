@@ -124,7 +124,8 @@ class ErasureCodePluginRegistry:
     # -- factory (ErasureCodePlugin.cc:92-120) -----------------------------
 
     def factory(self, plugin_name: str, directory: str,
-                profile: ErasureCodeProfile) -> ErasureCodeInterface:
+                profile: ErasureCodeProfile,
+                cct=None) -> ErasureCodeInterface:
         with self._lock:
             plugin = self._plugins.get(plugin_name)
         if plugin is None:
@@ -135,6 +136,12 @@ class ErasureCodePluginRegistry:
             raise ValueError(
                 f"profile plugin={profile['plugin']} != factory({plugin_name})")
         instance = plugin.factory(directory, profile)
+        if cct is not None:
+            # bind the caller's context so live config (e.g. the device
+            # routing cutoff) is read from its store, not the global one
+            instance.cct = cct
+            if hasattr(instance, "_conf"):
+                instance._conf = cct.conf
         return instance
 
     # -- preload (ErasureCodePlugin.cc:186-202) ----------------------------

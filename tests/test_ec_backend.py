@@ -690,3 +690,29 @@ class TestReviewRegressions:
         assert rop.state == RecoveryState.FAILED
         assert states == [RecoveryState.FAILED]
         assert not backend.recovery_ops
+
+    def test_push_target_death_sticky_with_surviving_pushes(self, cluster):
+        """One of two push targets dies while the other's push is still in
+        flight: the surviving ack must NOT flip the op to COMPLETE — the
+        dead target never got its chunk (reference _failed_push fails the
+        op for any dead push target)."""
+        backend, bus = cluster
+        _write(backend, bus, "obj", 0, payload(STRIPE, seed=35))
+        for shard in (4, 5):
+            bus.handlers[shard].store.queue_transaction(
+                Transaction().remove(GObject("obj", shard)))
+        states = []
+        rop = backend.recover_object(
+            "obj", {4, 5}, on_complete=lambda r: states.append(r.state))
+        for s in list(rop._pending):
+            while bus.deliver_one(s):
+                pass
+        while bus.deliver_one(backend.whoami):
+            pass
+        assert rop.state == RecoveryState.WRITING
+        assert rop.pending_pushes == {4, 5}
+        bus.mark_down(5)               # one target dies, 4's push pending
+        assert rop.state == RecoveryState.WRITING    # not finished yet
+        bus.deliver_all()              # 4 receives its push and acks
+        assert rop.state == RecoveryState.FAILED
+        assert states == [RecoveryState.FAILED]
