@@ -117,8 +117,11 @@ OPTIONS: list[Option] = [
     Option("ec_batch_max_stripes", TYPE_UINT, LEVEL_ADVANCED, default=256,
            description="stripes coalesced per device dispatch"),
     Option("ec_device_threshold_bytes", TYPE_SIZE, LEVEL_ADVANCED,
-           default=65536,
-           description="below this, encode on host; above, on device"),
+           default=8 * 1024 * 1024,
+           description="single calls below this encode on the SIMD host "
+                       "codec; above (or batched via the pipeline/queue "
+                       "paths), on device — BASELINE_RESULTS.json config 2 "
+                       "measures the crossover"),
     Option("log_file", TYPE_STR, LEVEL_BASIC, default="",
            description="path to log file"),
     Option("log_max_recent", TYPE_UINT, LEVEL_ADVANCED, default=500,

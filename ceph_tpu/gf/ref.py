@@ -34,6 +34,43 @@ def apply_matrix(mat: np.ndarray, data: np.ndarray) -> np.ndarray:
     return out
 
 
+_native_apply = None
+
+
+def _load_native():
+    """ctypes handle to the SIMD region kernel (gf8_simd.cc), or None.
+
+    The pure-numpy ``apply_matrix`` above stays untouched — it is the
+    oracle the JAX kernels AND the native kernels are tested against;
+    only ``apply_matrix_fast`` (the production CPU path) dispatches here.
+    """
+    global _native_apply
+    if _native_apply is not None:
+        return _native_apply or None
+    try:
+        from ..native import registry_lib
+        _native_apply = registry_lib().ec_apply_matrix
+    except Exception:
+        _native_apply = False
+    return _native_apply or None
+
+
+def apply_matrix_fast(mat: np.ndarray, data: np.ndarray) -> np.ndarray:
+    """Production CPU path: SIMD (GFNI/AVX-512 or AVX2) region kernel when
+    the native build is available, exact numpy otherwise.  Bit-identical
+    to ``apply_matrix`` either way."""
+    fn = _load_native()
+    if fn is None:
+        return apply_matrix(mat, data)
+    mat = np.ascontiguousarray(mat, dtype=np.uint8)
+    data = np.ascontiguousarray(data, dtype=np.uint8)
+    r, k = mat.shape
+    out = np.empty((r, data.shape[1]), dtype=np.uint8)
+    fn(mat.ctypes.data, r, k, data.ctypes.data, out.ctypes.data,
+       data.shape[1])
+    return out
+
+
 def encode(parity_mat: np.ndarray, data: np.ndarray) -> np.ndarray:
     """data: [k, N] -> parity [m, N]."""
     return apply_matrix(parity_mat, data)

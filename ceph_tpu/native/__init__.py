@@ -22,16 +22,45 @@ NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
 BUILD_DIR = os.path.join(NATIVE_DIR, "build")
 
 _build_lock = threading.Lock()
+_built = False
+_registry_lib = None
 
 
 def build(force: bool = False) -> str:
-    """Run `make -C native` (idempotent); returns the build dir."""
+    """Run `make -C native`; returns the build dir.
+
+    make itself is the staleness check (cheap no-op when up to date), so a
+    stale pre-existing build/ never masks newer kernels — it runs once per
+    process, unconditionally."""
+    global _built
     with _build_lock:
-        if force or not os.path.exists(
-                os.path.join(BUILD_DIR, "libec_registry.so")):
+        if force or not _built:
             subprocess.run(["make", "-C", NATIVE_DIR],
                            check=True, capture_output=True)
+            _built = True
     return BUILD_DIR
+
+
+def registry_lib() -> C.CDLL:
+    """The process-wide handle to libec_registry.so (builds on demand).
+
+    Shared by every ctypes consumer (NativeRegistry, the gf8 SIMD fast
+    path, the native crc32c) so the library is built and dlopened once."""
+    global _registry_lib
+    with _build_lock:
+        if _registry_lib is not None:
+            return _registry_lib
+    build()
+    lib = C.CDLL(os.path.join(BUILD_DIR, "libec_registry.so"))
+    lib.ec_simd_level.restype = C.c_int
+    lib.ec_crc32c.restype = C.c_uint32
+    lib.ec_crc32c.argtypes = [C.c_uint32, C.c_void_p, C.c_size_t]
+    lib.ec_apply_matrix.restype = C.c_int
+    lib.ec_apply_matrix.argtypes = [
+        C.c_void_p, C.c_int, C.c_int, C.c_void_p, C.c_void_p, C.c_size_t]
+    with _build_lock:
+        _registry_lib = lib
+    return _registry_lib
 
 
 class _CodecOps(C.Structure):
@@ -67,8 +96,7 @@ class NativeRegistry:
     _instance = None
 
     def __init__(self):
-        build()
-        self.lib = C.CDLL(os.path.join(BUILD_DIR, "libec_registry.so"))
+        self.lib = registry_lib()
         self.lib.ec_registry_load.argtypes = [C.c_char_p, C.c_char_p,
                                               C.c_char_p, C.c_int]
         self.lib.ec_registry_get.restype = C.POINTER(_CodecOps)
@@ -314,5 +342,5 @@ class BatchQueue:
         self.close()
 
 
-__all__ = ["build", "NativeRegistry", "NativeCodec", "BatchQueue",
-           "BUILD_DIR", "NATIVE_DIR"]
+__all__ = ["build", "registry_lib", "NativeRegistry", "NativeCodec",
+           "BatchQueue", "BUILD_DIR", "NATIVE_DIR"]

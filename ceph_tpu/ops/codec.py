@@ -67,7 +67,7 @@ class RSCodec:
             out = self.encode(np.swapaxes(data, 0, 1).reshape(k, b * n))
             return np.swapaxes(out.reshape(self.m, b, n), 0, 1)
         if self.device == "numpy":
-            return gfref.encode(self.parity_mat, data)
+            return gfref.apply_matrix_fast(self.parity_mat, data)
         if self._parity_dev is None:
             self._parity_dev = jnp.asarray(self.parity_mat)
         out = rs_kernels.gf_apply(self._parity_dev, data, self.variant)
@@ -109,7 +109,7 @@ class RSCodec:
         D, src = self.decode_matrix(erasures, available=list(chunks))
         stack = np.stack([np.asarray(chunks[i], dtype=np.uint8) for i in src])
         if self.device == "numpy":
-            rec = gfref.apply_matrix(D, stack)
+            rec = gfref.apply_matrix_fast(D, stack)
         else:
             rec = np.asarray(jax.device_get(
                 rs_kernels.gf_apply(jnp.asarray(D), stack, self.variant)))
@@ -131,7 +131,7 @@ class RSCodec:
         folded = np.ascontiguousarray(
             np.swapaxes(stack, 0, 1).reshape(k, b * n), dtype=np.uint8)
         if self.device == "numpy":
-            rec = gfref.apply_matrix(D, folded)
+            rec = gfref.apply_matrix_fast(D, folded)
         else:
             rec = np.asarray(jax.device_get(
                 rs_kernels.gf_apply(jnp.asarray(D), folded, self.variant)))

@@ -189,6 +189,17 @@ bool decode_matrix(const Matrix &parity, int k, int m,
 
 void apply_matrix(const uint8_t *coef, int nout, int nin,
                   const uint8_t *in, uint8_t *out, size_t chunk_size) {
+    if (simd_level() > 0 && nout <= 32 && chunk_size >= 64) {
+        const uint8_t *inp[32];
+        uint8_t *outp[32];
+        for (int j = 0; j < nin && j < 32; j++)
+            inp[j] = in + (size_t)j * chunk_size;
+        for (int r = 0; r < nout; r++)
+            outp[r] = out + (size_t)r * chunk_size;
+        if (nin <= 32 &&
+            simd_apply_matrix_ptrs(coef, nout, nin, inp, outp, chunk_size))
+            return;
+    }
     for (int r = 0; r < nout; r++) {
         uint8_t *dst = out + (size_t)r * chunk_size;
         std::memset(dst, 0, chunk_size);
@@ -209,6 +220,9 @@ void apply_matrix(const uint8_t *coef, int nout, int nin,
 void apply_matrix_ptrs(const uint8_t *coef, int nout, int nin,
                        const uint8_t *const *in, uint8_t *const *out,
                        size_t chunk_size) {
+    if (chunk_size >= 64 &&
+        simd_apply_matrix_ptrs(coef, nout, nin, in, out, chunk_size))
+        return;
     for (int r = 0; r < nout; r++) {
         uint8_t *dst = out[r];
         std::memset(dst, 0, chunk_size);

@@ -58,6 +58,14 @@ void apply_matrix_ptrs(const uint8_t *coef, int nout, int nin,
                        const uint8_t *const *in, uint8_t *const *out,
                        size_t chunk_size);
 
+/* SIMD acceleration (gf8_simd.cc): 0 = scalar only, 1 = AVX2 pshufb,
+ * 2 = GFNI+AVX2 affine, 3 = GFNI+AVX-512 affine.  apply_matrix*
+ * dispatch to the best verified level automatically. */
+int simd_level();
+bool simd_apply_matrix_ptrs(const uint8_t *coef, int nout, int nin,
+                            const uint8_t *const *in, uint8_t *const *out,
+                            size_t chunk_size);
+
 }  // namespace gf8
 
 #endif
