@@ -35,26 +35,47 @@ from .extent import ExtentSet
 from .extent_cache import ExtentCache
 from .memstore import GObject, MemStore, Transaction
 from .messages import (ECSubRead, ECSubReadReply, ECSubWrite, ECSubWriteReply,
-                       MessageBus, PushOp, PushReply)
+                       MessageBus, PGLogInfo, PGLogQuery, PGLogUpdate,
+                       PGScan, PGScanReply, PushOp, PushReply)
 from .transaction import PGTransaction, WritePlan, get_write_plan
+from ..osd.pg_log import OP_DELETE, OP_MODIFY, PGLog
 
 
 class OSDShard:
     """One shard OSD: a MemStore plus the server side of the EC sub-ops
     (handle_sub_write ECBackend.cc:910-983, handle_sub_read :985-1031,
-    recovery push :511-563)."""
+    recovery push :511-563) and a per-shard PG log that advances with
+    every applied sub-write (the reference logs entries in
+    handle_sub_write before queueing the transaction, ECBackend.cc:956)."""
 
     def __init__(self, shard: int, bus: MessageBus):
         self.shard = shard
         self.store = MemStore()
         self.bus = bus
+        self.pg_log = PGLog()
         bus.register(shard, self)
 
     def handle_message(self, msg) -> None:
         if isinstance(msg, ECSubWrite):
+            for e in msg.log_entries:
+                if e.version > self.pg_log.head:
+                    self.pg_log.record(e)
+            if msg.trim_to:
+                self.pg_log.trim(msg.trim_to)
             self.store.queue_transaction(msg.t)
             self.bus.send(msg.from_shard,
                           ECSubWriteReply(self.shard, msg.tid))
+        elif isinstance(msg, PGLogQuery):
+            self.bus.send(msg.from_shard, PGLogInfo(
+                self.shard, self.pg_log.head, self.pg_log.tail,
+                entries=self.pg_log.entries_after(msg.since) or []))
+        elif isinstance(msg, PGScan):
+            self.bus.send(msg.from_shard, PGScanReply(
+                self.shard, oids=sorted({g.oid for g in self.store.objects
+                                         if g.shard == self.shard})))
+        elif isinstance(msg, PGLogUpdate):
+            self.pg_log.merge_authoritative(
+                msg.entries, msg.last_update, msg.rewind_to, msg.trim_to)
         elif isinstance(msg, ECSubRead):
             reply = ECSubReadReply(self.shard, msg.tid)
             for oid, extents in msg.to_read.items():
@@ -126,6 +147,30 @@ class RecoveryOp:
     on_complete: object = None
 
 
+class RepairState(Enum):
+    QUERY = "QUERY"               # waiting for the shard's PGLogInfo
+    SCAN = "SCAN"                 # backfill: waiting for the object list
+    RECOVERING = "RECOVERING"     # pushes/deletes in flight
+    COMPLETE = "COMPLETE"
+    FAILED = "FAILED"
+
+
+@dataclass
+class ShardRepairOp:
+    """Catch one stale/revived shard up, cheapest plan first: log equality
+    (free) -> log replay (O(missed writes), PGLog.cc semantics) -> full
+    backfill (O(objects), only past the log horizon)."""
+    shard: int
+    chunk: int
+    state: RepairState = RepairState.QUERY
+    plan: str = ""                # "clean" | "log" | "backfill"
+    rewind_to: int = 0
+    pending: set = field(default_factory=set)   # ("recover"|"delete", oid)
+    objects_repaired: int = 0
+    failed: bool = False
+    on_complete: object = None
+
+
 @dataclass
 class Op:
     """In-flight client write (ECBackend::Op, ECBackend.h:390-440)."""
@@ -188,6 +233,15 @@ class ECBackend:
         self._recovery_read_tids: dict[int, RecoveryOp] = {}
         self.hinfo_cache: dict[str, HashInfo] = {}
         self._stalled_recoveries: list[RecoveryOp] = []
+        # The authority log advances at fan-out; the local shard's own log
+        # advances only when its self-delivered sub-write APPLIES.  Keeping
+        # them separate is what lets a revived primary detect its own
+        # staleness (writes committed by the other shards while it was
+        # down) and repair itself through the same query/replay machinery.
+        self.pg_log = PGLog()
+        self.shard_repairs: dict[int, "ShardRepairOp"] = {}
+        self._repair_write_tids: dict[int, tuple["ShardRepairOp", str]] = {}
+        self._scan_waiters: dict[int, "ShardRepairOp"] = {}
         bus.down_listeners.append(self.on_shard_down)
         bus.up_listeners.append(self.on_shard_up)
         # observability (SURVEY.md §5): counters + op tracking + admin cmds
@@ -206,6 +260,15 @@ class ECBackend:
             .add_u64_counter("read_bytes", "logical bytes returned")
             .add_u64_counter("recoveries", "recovery ops completed")
             .add_u64_counter("recovery_failures", "recovery ops failed")
+            .add_u64_counter("log_repairs_clean",
+                             "shard repairs satisfied by log equality alone")
+            .add_u64_counter("log_repairs", "log-based shard catch-ups")
+            .add_u64_counter("log_repair_objects",
+                             "objects replayed by log catch-up")
+            .add_u64_counter("shard_backfills",
+                             "repairs past the log horizon (full backfill)")
+            .add_u64_counter("backfill_objects",
+                             "objects moved by shard backfill")
             .add_time_avg("encode_time", "batched encode wall time")
             .add_time_avg("decode_time", "batched decode wall time")
             .add_u64("pipeline_depth", "ops across the three wait lists")
@@ -255,6 +318,10 @@ class ECBackend:
             self.handle_sub_read_reply(msg)
         elif isinstance(msg, PushReply):
             self.handle_push_reply(msg)
+        elif isinstance(msg, PGLogInfo):
+            self.handle_pg_log_info(msg)
+        elif isinstance(msg, PGScanReply):
+            self.handle_pg_scan_reply(msg)
         else:
             self.local_shard.handle_message(msg)
 
@@ -335,6 +402,16 @@ class ECBackend:
                 rop.failed = True
                 if not rop.pending_pushes and rop.state == RecoveryState.WRITING:
                     self._finish_recovery_op(rop, failed=True)
+        # a shard under repair that dies again: the repair fails (its
+        # revival restarts it via the boot path)
+        srop = self.shard_repairs.get(shard)
+        if srop is not None:
+            srop.failed = True
+            self._repair_write_tids = {
+                tid: v for tid, v in self._repair_write_tids.items()
+                if v[0] is not srop}
+            srop.pending.clear()
+            self._finish_shard_repair(srop)
         self.try_finish_rmw()
         self.check_ops()
 
@@ -465,10 +542,17 @@ class ECBackend:
 
         n = self.ec_impl.get_chunk_count()
         shard_txns = {shard: Transaction() for shard in self.acting}
+        log_entries = []
         for oid, will_write in op.plan.will_write.items():
             objop = op.plan.t.ops[oid]
             hinfo = op.plan.hash_infos[oid]
             hinfo.version += 1      # down shards miss this bump -> stale
+            # one pg_log entry per touched object (pg_log_entry_t); a pure
+            # delete logs DELETE, anything that leaves data logs MODIFY
+            is_delete = (objop.delete_first and not objop.buffer_updates
+                         and objop.truncate is None)
+            log_entries.append(self.pg_log.append(
+                oid, OP_DELETE if is_delete else OP_MODIFY))
             if objop.delete_first:
                 for chunk, shard in enumerate(self.acting):
                     shard_txns[shard].remove(GObject(oid, shard))
@@ -544,10 +628,14 @@ class ECBackend:
         # instead shrink the acting set)
         up = self.up_shards()
         op.pending_commit_shards = set(up)
+        trim_to = self.pg_log.trim_target()
         for shard in self.acting:
             if shard in up:
-                self.bus.send(shard,
-                              ECSubWrite(self.whoami, op.tid, shard_txns[shard]))
+                self.bus.send(shard, ECSubWrite(
+                    self.whoami, op.tid, shard_txns[shard],
+                    at_version=self.pg_log.head, trim_to=trim_to,
+                    log_entries=list(log_entries)))
+        self.pg_log.maybe_trim()
         return True
 
     def _assemble_extent(self, op: Op, oid: str, objop, off: int,
@@ -581,6 +669,12 @@ class ECBackend:
 
     def handle_sub_write_reply(self, reply: ECSubWriteReply) -> None:
         """(ECBackend.cc:1120-1152) -> try_finish_rmw (:2089)."""
+        rep = self._repair_write_tids.pop(reply.tid, None)
+        if rep is not None:                 # a shard-repair delete acked
+            rop, oid = rep
+            rop.pending.discard(("delete", oid))
+            self._maybe_finish_shard_repair(rop)
+            return
         op = self.tid_to_op.get(reply.tid)
         if op is None:
             return
@@ -850,6 +944,158 @@ class ECBackend:
         self.recovery_ops.pop(rop.oid, None)
         self._recovery_read_tids.pop(rop.read_tid, None)
         self.perf.inc("recovery_failures" if failed else "recoveries")
+        if rop.on_complete:
+            rop.on_complete(rop)
+
+    # -- shard repair: log catch-up or backfill ----------------------------
+    # (the role PGLog::merge_log + log-based recovery + backfill play in the
+    # reference, src/osd/PGLog.cc; replaces the old O(all objects) deep
+    # scrub on every boot)
+
+    def start_shard_repair(self, shard: int, on_complete=None
+                           ) -> ShardRepairOp:
+        """Bring a revived/stale shard current.  Queries its log; replays
+        exactly the missed entries when they are within the horizon, falls
+        back to a scan+push backfill when not.  COMPLETE means the shard's
+        data AND log match the authority's.  Works for the primary's own
+        shard too: its local log lags the authority log by exactly the
+        writes that committed while it was down, and the recovery pushes
+        self-deliver over the bus."""
+        chunk = self.acting.index(shard)
+        rop = ShardRepairOp(shard=shard, chunk=chunk,
+                            on_complete=on_complete)
+        self.shard_repairs[shard] = rop
+        self.bus.send(shard, PGLogQuery(self.whoami,
+                                        since=self.pg_log.tail))
+        return rop
+
+    def handle_pg_log_info(self, info: PGLogInfo) -> None:
+        rop = self.shard_repairs.get(info.from_shard)
+        if rop is None or rop.state != RepairState.QUERY:
+            return
+        divergent, div_rewind = self.pg_log.divergent_oids(info.entries)
+        plan, entries = self.pg_log.catch_up_plan(info.last_update)
+        # the rewind point: last shard version consistent with our log
+        rop.rewind_to = min(info.last_update, self.pg_log.head, div_rewind)
+        if plan == "backfill":
+            rop.plan = "backfill"
+            rop.state = RepairState.SCAN
+            self.perf.inc("shard_backfills")
+            self._start_scan(rop)
+            return
+        rop.plan = plan
+        todo: dict[str, str] = {}          # oid -> op
+        for e in entries:
+            todo[e.oid] = e.op
+        for oid in divergent:
+            # authority wins: re-push our state, or delete what we lack
+            todo[oid] = OP_MODIFY if self._object_exists(oid) else OP_DELETE
+        if not todo:
+            self.perf.inc("log_repairs_clean")
+            self._finish_shard_repair(rop)
+            return
+        self.perf.inc("log_repairs")
+        rop.state = RepairState.RECOVERING
+        for oid, op in sorted(todo.items()):
+            self._repair_one(rop, oid, op)
+        self._maybe_finish_shard_repair(rop)
+
+    def _start_scan(self, rop: ShardRepairOp) -> None:
+        """Backfill needs the authoritative object list.  Repairing a
+        replica: the primary's own store is the authority, scan the stale
+        target for extras.  Repairing the primary itself: any other up
+        (hence current) shard supplies the authority list, and the stale
+        local store supplies the extras."""
+        target = rop.shard
+        if rop.shard == self.whoami:
+            others = [s for s in self.acting
+                      if s != self.whoami and s in self.up_shards()]
+            if not others:
+                rop.failed = True
+                self._finish_shard_repair(rop)
+                return
+            target = others[0]
+        self._scan_waiters[target] = rop
+        self.bus.send(target, PGScan(self.whoami))
+
+    def handle_pg_scan_reply(self, reply: PGScanReply) -> None:
+        rop = self._scan_waiters.pop(reply.from_shard, None)
+        if rop is None or rop.state != RepairState.SCAN:
+            return
+        if rop.shard == self.whoami:
+            authority = set(reply.oids)        # a current replica's list
+            target_list = self._local_oids()   # the stale local store
+        else:
+            authority = self._local_oids()
+            target_list = set(reply.oids)
+        rop.state = RepairState.RECOVERING
+        for oid in sorted(authority):
+            self._repair_one(rop, oid, OP_MODIFY)
+        for oid in sorted(target_list - authority):
+            self._repair_one(rop, oid, OP_DELETE)
+        self._maybe_finish_shard_repair(rop)
+
+    def _local_oids(self) -> set[str]:
+        return {g.oid for g in self.local_shard.store.objects
+                if g.shard == self.whoami}
+
+    def _object_exists(self, oid: str) -> bool:
+        return GObject(oid, self.whoami) in self.local_shard.store.objects
+
+    def _repair_one(self, rop: ShardRepairOp, oid: str, op: str) -> None:
+        rop.objects_repaired += 1
+        if op == OP_DELETE:
+            self.next_tid += 1
+            tid = self.next_tid
+            rop.pending.add(("delete", oid))
+            self._repair_write_tids[tid] = (rop, oid)
+            t = Transaction().remove(GObject(oid, rop.shard))
+            self.bus.send(rop.shard, ECSubWrite(self.whoami, tid, t))
+        else:
+            rop.pending.add(("recover", oid))
+
+            def done(rec, _rop=rop, _oid=oid):
+                _rop.pending.discard(("recover", _oid))
+                if rec.state != RecoveryState.COMPLETE:
+                    _rop.failed = True
+                self._maybe_finish_shard_repair(_rop)
+
+            existing = self.recovery_ops.get(oid)
+            if existing is not None:
+                # one RecoveryOp per object at a time: chain behind it
+                prev = existing.on_complete
+
+                def chained(rec, _prev=prev, _oid=oid, _rop=rop,
+                            _done=done):
+                    if _prev:
+                        _prev(rec)
+                    self.recover_object(_oid, {_rop.chunk},
+                                        on_complete=_done)
+                existing.on_complete = chained
+            else:
+                self.recover_object(oid, {rop.chunk}, on_complete=done)
+
+    def _maybe_finish_shard_repair(self, rop: ShardRepairOp) -> None:
+        if rop.state != RepairState.RECOVERING or rop.pending:
+            return
+        self._finish_shard_repair(rop)
+
+    def _finish_shard_repair(self, rop: ShardRepairOp) -> None:
+        self.shard_repairs.pop(rop.shard, None)
+        if rop.failed:
+            rop.state = RepairState.FAILED
+        else:
+            # data is current: ship the authoritative log segment so the
+            # shard's next repair takes the clean fast path
+            self.bus.send(rop.shard, PGLogUpdate(
+                self.whoami,
+                entries=self.pg_log.entries_after(rop.rewind_to) or [],
+                last_update=self.pg_log.head,
+                rewind_to=rop.rewind_to,
+                trim_to=self.pg_log.tail))
+            rop.state = RepairState.COMPLETE
+            self.perf.inc("log_repair_objects" if rop.plan != "backfill"
+                          else "backfill_objects", rop.objects_repaired)
         if rop.on_complete:
             rop.on_complete(rop)
 

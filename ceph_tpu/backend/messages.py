@@ -19,12 +19,18 @@ from .memstore import Transaction
 
 @dataclass
 class ECSubWrite:
-    """Primary -> shard: apply this shard-local transaction (ECMsgTypes.h:23-38)."""
+    """Primary -> shard: apply this shard-local transaction (ECMsgTypes.h:23-38).
+
+    ``log_entries`` ride along exactly like the reference's (ECSubWrite
+    carries the op's pg_log entries so every shard's log advances with the
+    write); ``at_version``/``trim_to`` are the eversion bump and the
+    piggybacked trim point."""
     from_shard: int
     tid: int
     t: Transaction
     at_version: int = 0
     trim_to: int = 0
+    log_entries: list = field(default_factory=list)
     backfill_or_async_recovery: bool = False
 
 
@@ -76,6 +82,50 @@ class PushOp:
 class PushReply:
     from_shard: int
     oid: str
+
+
+@dataclass
+class PGLogQuery:
+    """Primary -> shard: report your log state (the pg_query_t/pg_info_t
+    exchange peering opens with, reference: src/osd/PeeringState.cc
+    GetInfo; ``since`` bounds the entry payload of the reply)."""
+    from_shard: int
+    since: int = 0
+
+
+@dataclass
+class PGLogInfo:
+    """Shard -> primary: last_update + entries after ``since`` (pg_info_t
+    plus the log segment merge_log would examine)."""
+    from_shard: int
+    last_update: int
+    tail: int
+    entries: list = field(default_factory=list)
+
+
+@dataclass
+class PGScan:
+    """Primary -> shard: list your objects (the backfill scan,
+    reference: MOSDPGScan / PrimaryLogPG::do_scan)."""
+    from_shard: int
+
+
+@dataclass
+class PGScanReply:
+    from_shard: int
+    oids: list = field(default_factory=list)
+
+
+@dataclass
+class PGLogUpdate:
+    """Primary -> shard: adopt this authoritative log segment (the rewind/
+    catch-up half of merge_log).  Entries replace everything the shard has
+    past ``rewind_to``; last_update becomes ``last_update``."""
+    from_shard: int
+    entries: list = field(default_factory=list)
+    last_update: int = 0
+    rewind_to: int = 0
+    trim_to: int = 0
 
 
 class MessageBus:

@@ -185,22 +185,21 @@ class MiniCluster:
                 if self.pools[pool_id]["pgs"][self.object_pg(pool_id, oid)]
                 is g]
 
-    def _repair_after_boot(self, pool_id: int, g: PGGroup) -> None:
-        """Bring a rebooted shard current BEFORE it serves reads: deep-scrub
-        every object and recover stale/missing chunks (the role peering +
-        log-based recovery play in the reference — a revived OSD never
-        serves until caught up)."""
-        from .backend.ec_backend import RecoveryState
-        for oid in self._pg_objects(pool_id, g):
-            report = g.backend.be_deep_scrub(oid)
-            missing = {c for c, clean in report.items() if not clean}
-            if missing:
-                rop = g.backend.recover_object(oid, missing)
-                g.bus.deliver_all()
-                if rop.state != RecoveryState.COMPLETE:
-                    raise IOError(
-                        f"repair of {oid} chunks {missing} after boot "
-                        f"failed: {rop.state}")
+    def _repair_after_boot(self, pool_id: int, g: PGGroup,
+                           shard: int) -> None:
+        """Bring a rebooted shard current BEFORE it serves reads, via the
+        PG log: equality is free, missed writes replay in O(missed
+        entries), and only a shard past the log horizon pays a full
+        backfill (PGLog.cc semantics — replaces the old O(all objects)
+        deep scrub on every boot).  A revived primary repairs its own
+        store the same way: its local shard log lags the authority log
+        by exactly the writes that committed without it."""
+        from .backend.ec_backend import RepairState
+        rop = g.backend.start_shard_repair(shard)
+        g.bus.deliver_all()
+        if rop.state != RepairState.COMPLETE:
+            raise IOError(
+                f"repair of shard {shard} after boot failed: {rop.state}")
 
     def _backfill_pg(self, pool_id: int, ps: int, new_acting: list[int],
                      ec) -> None:
@@ -252,7 +251,7 @@ class MiniCluster:
                             g.bus.mark_down(o)
                         else:
                             g.bus.mark_up(o)
-                            self._repair_after_boot(pid, g)
+                            self._repair_after_boot(pid, g, o)
             if inc.new_weight:
                 # CRUSH remapping: re-place every PG, backfill the changed
                 for pid, pool in self.pools.items():

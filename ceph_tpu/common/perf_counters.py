@@ -59,6 +59,11 @@ class PerfCounters:
         with self._lock:
             self._metrics[key].value = value
 
+    def get(self, key: str) -> float:
+        """Current value of a plain counter/gauge."""
+        with self._lock:
+            return self._metrics[key].value
+
     def tinc(self, key: str, seconds: float) -> None:
         """Add one timed sample (the reference's utime_t tinc)."""
         with self._lock:

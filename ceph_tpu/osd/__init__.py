@@ -1,0 +1,9 @@
+"""OSD-side subsystems: PG log, peering, scheduling.
+
+The distributed-systems spine around the EC backend — the analog of the
+reference's src/osd/ beyond the EC slice (PGLog.cc, PeeringState.cc,
+mClock queues).
+"""
+from .pg_log import PGLog, PGLogEntry
+
+__all__ = ["PGLog", "PGLogEntry"]

@@ -1,0 +1,384 @@
+"""PG log + log-based shard recovery.
+
+Mirrors the reference's PGLog semantics (reference: src/osd/PGLog.{h,cc};
+EC log-entry flow described in
+doc/dev/osd_internals/erasure_coding/ecbackend.rst:8-26): bounded per-PG
+entry window, divergence detection, catch-up of a stale shard by
+replaying exactly its missed entries, and backfill only past the log
+horizon.  The cost assertions (push counts, zero deep scrubs) are the
+point: boot repair must be O(missed writes), not O(all objects).
+"""
+import numpy as np
+import pytest
+
+from ceph_tpu.backend import MessageBus, PGTransaction, make_cluster
+from ceph_tpu.backend.ec_backend import RepairState
+from ceph_tpu.backend.messages import ECSubWrite, PushOp
+from ceph_tpu.osd.pg_log import (OP_DELETE, OP_MODIFY, PGLog, PGLogEntry,
+                                 dedup_latest)
+from ceph_tpu.plugins.registry import ErasureCodePluginRegistry
+
+K, M = 4, 2
+CHUNK = 128
+STRIPE = K * CHUNK
+
+
+@pytest.fixture(scope="module")
+def ec_impl():
+    return ErasureCodePluginRegistry.instance().factory(
+        "jax_rs", "", {"k": str(K), "m": str(M), "device": "numpy",
+                       "technique": "reed_sol_van"})
+
+
+@pytest.fixture()
+def cluster(ec_impl):
+    return make_cluster(ec_impl, chunk_size=CHUNK)
+
+
+def payload(n, seed=0):
+    return np.random.default_rng(seed).integers(
+        0, 256, size=n, dtype=np.uint8).tobytes()
+
+
+def _write(backend, bus, oid, data):
+    backend.submit_transaction(PGTransaction().write(oid, 0, data))
+    bus.deliver_all()
+
+
+def _read(backend, bus, oid, length):
+    out = {}
+    backend.objects_read_and_reconstruct(
+        {oid: [(0, length)]},
+        lambda result, errors: out.update(result=result, errors=errors))
+    bus.deliver_all()
+    assert not out.get("errors"), out["errors"]
+    return out["result"][oid][0][2][:length]
+
+
+class CountingBus(MessageBus):
+    """Counts messages by type so tests can assert I/O proportionality."""
+
+    def __init__(self):
+        super().__init__()
+        self.sent: dict[type, int] = {}
+
+    def send(self, to_shard, msg):
+        self.sent[type(msg)] = self.sent.get(type(msg), 0) + 1
+        super().send(to_shard, msg)
+
+
+# -- unit: the log structure -------------------------------------------------
+
+class TestPGLogUnit:
+    def test_append_monotonic_and_prior(self):
+        log = PGLog()
+        a = log.append("a")
+        b = log.append("b")
+        a2 = log.append("a")
+        assert (a.version, b.version, a2.version) == (1, 2, 3)
+        assert a.prior_version == 0 and a2.prior_version == 1
+        assert log.head == 3 and log.tail == 0
+
+    def test_trim_moves_tail_and_horizon(self):
+        log = PGLog(max_entries=3)
+        for i in range(6):
+            log.append(f"o{i}")
+        log.maybe_trim()
+        assert log.tail == 3 and log.head == 6
+        assert [e.version for e in log.entries] == [4, 5, 6]
+        assert log.entries_after(3) is not None
+        assert log.entries_after(2) is None          # past the horizon
+
+    def test_catch_up_plans(self):
+        log = PGLog(max_entries=10)
+        for i in range(5):
+            log.append(f"o{i % 2}")                  # o0,o1 alternating
+        assert log.catch_up_plan(5) == ("clean", [])
+        plan, entries = log.catch_up_plan(2)
+        assert plan == "log"
+        # versions 3,4,5 touch o0(3), o1(4), o0(5): dedup keeps 4 and 5
+        assert [(e.version, e.oid) for e in entries] == [(4, "o1"),
+                                                         (5, "o0")]
+        log.trim(3)
+        assert log.catch_up_plan(2) == ("backfill", [])
+
+    def test_dedup_latest_keeps_final_state(self):
+        es = [PGLogEntry(1, "a", OP_MODIFY), PGLogEntry(2, "a", OP_DELETE),
+              PGLogEntry(3, "b", OP_MODIFY)]
+        assert [(e.oid, e.op) for e in dedup_latest(es)] == [
+            ("a", OP_DELETE), ("b", OP_MODIFY)]
+
+    def test_divergent_oids(self):
+        log = PGLog()
+        for o in ("a", "b"):
+            log.append(o)
+        # follower entry beyond head, and one disagreeing at version 2
+        div, rewind = log.divergent_oids([PGLogEntry(2, "x"),
+                                          PGLogEntry(3, "c")])
+        assert div == {"x", "c"}
+        assert rewind == 1          # last consistent shared version
+        div, rewind = log.divergent_oids(list(log.entries))
+        assert div == set() and rewind == log.head
+
+
+# -- integration: log rides the write path -----------------------------------
+
+class TestLogOnWritePath:
+    def test_entries_reach_every_shard(self, cluster):
+        backend, bus = cluster
+        _write(backend, bus, "obj", payload(STRIPE))
+        _write(backend, bus, "obj2", payload(STRIPE, 1))
+        assert backend.pg_log.head == 2
+        for shard in backend.acting:
+            h = bus.handlers[shard]
+            # the primary's LOCAL shard log is separate from the authority
+            # log and advances via self-delivery like any replica's
+            log = h.pg_log if shard != backend.whoami else \
+                backend.local_shard.pg_log
+            assert log.head == 2, f"shard {shard} log behind"
+            assert [e.oid for e in log.entries] == ["obj", "obj2"]
+
+    def test_delete_logs_delete(self, cluster):
+        backend, bus = cluster
+        _write(backend, bus, "obj", payload(STRIPE))
+        backend.submit_transaction(PGTransaction().delete("obj"))
+        bus.deliver_all()
+        assert backend.pg_log.entries[-1].op == OP_DELETE
+
+    def test_down_shard_log_stays_behind(self, cluster):
+        backend, bus = cluster
+        _write(backend, bus, "obj", payload(STRIPE))
+        bus.mark_down(3)
+        _write(backend, bus, "obj2", payload(STRIPE, 1))
+        assert backend.pg_log.head == 2
+        assert bus.handlers[3].pg_log.head == 1
+
+
+# -- integration: log-based repair -------------------------------------------
+
+def make_counting_cluster(ec_impl):
+    from ceph_tpu.backend.ec_backend import ECBackend, OSDShard
+    from ceph_tpu.backend import StripeInfo
+    bus = CountingBus()
+    backend = ECBackend(ec_impl, StripeInfo(K, CHUNK), bus,
+                        acting=list(range(K + M)), whoami=0)
+    for s in range(1, K + M):
+        OSDShard(s, bus)
+    return backend, bus
+
+
+class TestLogRepair:
+    def test_clean_shard_repair_is_free(self, ec_impl):
+        backend, bus = make_counting_cluster(ec_impl)
+        for i in range(5):
+            _write(backend, bus, f"o{i}", payload(STRIPE, i))
+        before = bus.sent.get(PushOp, 0)
+        rop = backend.start_shard_repair(3)
+        bus.deliver_all()
+        assert rop.state == RepairState.COMPLETE
+        assert rop.plan == "clean"
+        assert bus.sent.get(PushOp, 0) == before         # zero data moved
+        assert backend.perf.get("log_repairs_clean") == 1
+
+    def test_missed_n_writes_replays_exactly_n(self, ec_impl):
+        """The VERDICT's acceptance test: a shard missing N writes
+        recovers by replaying exactly N entries — push count == N, and
+        untouched objects see no I/O."""
+        backend, bus = make_counting_cluster(ec_impl)
+        for i in range(10):
+            _write(backend, bus, f"base{i}", payload(STRIPE, i))
+        bus.mark_down(4)
+        n_missed = 3
+        for i in range(n_missed):
+            _write(backend, bus, f"missed{i}", payload(STRIPE, 100 + i))
+        bus.mark_up(4)
+        pushes_before = bus.sent.get(PushOp, 0)
+        reads_before = bus.sent.get(ECSubWrite, 0)
+        rop = backend.start_shard_repair(4)
+        bus.deliver_all()
+        assert rop.state == RepairState.COMPLETE
+        assert rop.plan == "log"
+        assert rop.objects_repaired == n_missed
+        # exactly one push per missed object, all to the stale shard
+        assert bus.sent.get(PushOp, 0) - pushes_before == n_missed
+        # no client-write traffic was generated (no deletes needed)
+        assert bus.sent.get(ECSubWrite, 0) == reads_before
+        assert backend.perf.get("log_repair_objects") == n_missed
+        # the shard's chunk content is now current
+        for i in range(n_missed):
+            from ceph_tpu.backend import GObject
+            data = bus.handlers[4].store.read(GObject(f"missed{i}", 4))
+            assert len(data) == CHUNK
+        # and its log matches the primary's
+        assert bus.handlers[4].pg_log.head == backend.pg_log.head
+
+    def test_repeated_same_object_writes_replay_once(self, ec_impl):
+        backend, bus = make_counting_cluster(ec_impl)
+        _write(backend, bus, "obj", payload(STRIPE))
+        bus.mark_down(4)
+        for i in range(5):                       # 5 writes, ONE object
+            _write(backend, bus, "obj", payload(STRIPE, i + 1))
+        bus.mark_up(4)
+        before = bus.sent.get(PushOp, 0)
+        rop = backend.start_shard_repair(4)
+        bus.deliver_all()
+        assert rop.state == RepairState.COMPLETE
+        assert rop.objects_repaired == 1
+        assert bus.sent.get(PushOp, 0) - before == 1
+
+    def test_missed_delete_replays_delete(self, ec_impl):
+        from ceph_tpu.backend import GObject
+        backend, bus = make_counting_cluster(ec_impl)
+        _write(backend, bus, "obj", payload(STRIPE))
+        bus.mark_down(4)
+        backend.submit_transaction(PGTransaction().delete("obj"))
+        bus.deliver_all()
+        bus.mark_up(4)
+        assert GObject("obj", 4) in bus.handlers[4].store.objects
+        rop = backend.start_shard_repair(4)
+        bus.deliver_all()
+        assert rop.state == RepairState.COMPLETE
+        assert GObject("obj", 4) not in bus.handlers[4].store.objects
+
+    def test_past_horizon_falls_back_to_backfill(self, ec_impl):
+        backend, bus = make_counting_cluster(ec_impl)
+        backend.pg_log.max_entries = 4
+        for i in range(3):
+            _write(backend, bus, f"keep{i}", payload(STRIPE, i))
+        bus.mark_down(4)
+        for i in range(8):                       # trims past shard's head
+            _write(backend, bus, f"new{i}", payload(STRIPE, 50 + i))
+        assert backend.pg_log.tail > bus.handlers[4].pg_log.head
+        bus.mark_up(4)
+        rop = backend.start_shard_repair(4)
+        bus.deliver_all()
+        assert rop.state == RepairState.COMPLETE
+        assert rop.plan == "backfill"
+        assert backend.perf.get("shard_backfills") == 1
+        # backfill touches every object the primary has (3 + 8 = 11)
+        assert rop.objects_repaired == 11
+        assert bus.handlers[4].pg_log.head == backend.pg_log.head
+        # a second repair is now clean
+        rop2 = backend.start_shard_repair(4)
+        bus.deliver_all()
+        assert rop2.plan == "clean"
+
+    def test_divergent_shard_rewound_to_authority(self, ec_impl):
+        from ceph_tpu.backend import GObject
+        backend, bus = make_counting_cluster(ec_impl)
+        _write(backend, bus, "obj", payload(STRIPE))
+        shard = bus.handlers[4]
+        # fabricate a write the primary never committed: entry past head
+        # plus garbage chunk content (the divergent-op aftermath)
+        shard.pg_log.record(PGLogEntry(99, "ghost", OP_MODIFY))
+        from ceph_tpu.backend import Transaction
+        shard.store.queue_transaction(
+            Transaction().write(GObject("ghost", 4), 0, b"x" * CHUNK))
+        rop = backend.start_shard_repair(4)
+        bus.deliver_all()
+        assert rop.state == RepairState.COMPLETE
+        # the ghost object is gone, the log matches the authority
+        assert GObject("ghost", 4) not in shard.store.objects
+        assert shard.pg_log.head == backend.pg_log.head
+        assert [e.oid for e in shard.pg_log.entries] == \
+               [e.oid for e in backend.pg_log.entries]
+
+    def test_revived_primary_repairs_its_own_store(self, ec_impl):
+        """The primary's local shard goes stale while it is down (writes
+        commit on the other shards); its local log lags the authority log,
+        and start_shard_repair(whoami) replays the misses onto itself."""
+        from ceph_tpu.backend import GObject
+        backend, bus = make_counting_cluster(ec_impl)
+        _write(backend, bus, "pre", payload(STRIPE))
+        bus.mark_down(0)                     # the primary's own shard
+        _write(backend, bus, "missed", payload(STRIPE, 7))
+        assert backend.local_shard.pg_log.head == 1 < backend.pg_log.head
+        assert GObject("missed", 0) not in backend.local_shard.store.objects
+        bus.mark_up(0)
+        rop = backend.start_shard_repair(0)
+        bus.deliver_all()
+        assert rop.state == RepairState.COMPLETE
+        assert rop.plan == "log" and rop.objects_repaired == 1
+        assert GObject("missed", 0) in backend.local_shard.store.objects
+        assert backend.local_shard.pg_log.head == backend.pg_log.head
+        # healthy-path read now uses the repaired primary chunk
+        assert _read(backend, bus, "missed", STRIPE) == payload(STRIPE, 7)
+
+    def test_revived_primary_backfills_past_horizon(self, ec_impl):
+        from ceph_tpu.backend import GObject
+        backend, bus = make_counting_cluster(ec_impl)
+        backend.pg_log.max_entries = 3
+        _write(backend, bus, "pre", payload(STRIPE))
+        bus.mark_down(0)
+        for i in range(6):
+            _write(backend, bus, f"n{i}", payload(STRIPE, i))
+        bus.mark_up(0)
+        rop = backend.start_shard_repair(0)
+        bus.deliver_all()
+        assert rop.state == RepairState.COMPLETE
+        assert rop.plan == "backfill"
+        for i in range(6):
+            assert GObject(f"n{i}", 0) in backend.local_shard.store.objects
+
+    def test_repair_survives_interleaved_writes(self, ec_impl):
+        """Writes landing between query and completion do not corrupt the
+        repair; a follow-up repair converges."""
+        backend, bus = make_counting_cluster(ec_impl)
+        for i in range(4):
+            _write(backend, bus, f"o{i}", payload(STRIPE, i))
+        bus.mark_down(4)
+        _write(backend, bus, "missed", payload(STRIPE, 9))
+        bus.mark_up(4)
+        rop = backend.start_shard_repair(4)
+        # new write while the repair query is still queued
+        backend.submit_transaction(
+            PGTransaction().write("concurrent", 0, payload(STRIPE, 10)))
+        bus.deliver_all()
+        assert rop.state == RepairState.COMPLETE
+        rop2 = backend.start_shard_repair(4)
+        bus.deliver_all()
+        assert rop2.state == RepairState.COMPLETE
+        assert bus.handlers[4].pg_log.head == backend.pg_log.head
+
+
+# -- cluster-level: boot repair is log-driven --------------------------------
+
+class TestClusterBootRepair:
+    def test_boot_repair_cost_is_o_missed_writes(self):
+        """MiniCluster revival path: objects written while a shard was
+        down are repaired by log replay; the prior objects see no
+        recovery I/O and no deep scrubs."""
+        from ceph_tpu.cluster import MiniCluster
+        mc = MiniCluster(n_osds=12, osds_per_host=3, chunk_size=CHUNK)
+        pid = mc.create_ec_pool("p", {"plugin": "jax_rs", "k": "4",
+                                      "m": "2", "device": "numpy"},
+                                pg_num=1)
+        mon = mc.attach_monitor()
+        for i in range(6):
+            mc.put(pid, f"pre{i}", payload(2 * STRIPE, i))
+        g = mc.pools[pid]["pgs"][0]
+        victim = next(s for s in g.acting if s != g.backend.whoami)
+        # quorum of reporters ages past grace -> down-mark commits
+        reporters = [o for o in range(12) if o != victim][:4]
+        for r in reporters:
+            mon.prepare_failure(victim, r, failed_since=0.0, now=30.0)
+        assert mon.propose_pending(30.0) is not None
+        assert not mc.osdmap.is_up(victim)
+        missed = ["pre0", "pre1"]
+        for o in missed:
+            mc.put(pid, o, payload(2 * STRIPE, 42))
+        scrubs = 0
+        orig = g.backend.be_deep_scrub
+
+        def counting_scrub(oid):
+            nonlocal scrubs
+            scrubs += 1
+            return orig(oid)
+        g.backend.be_deep_scrub = counting_scrub
+        mon.osd_boot(victim)
+        assert mon.propose_pending(31.0) is not None
+        assert g.backend.perf.get("log_repair_objects") == len(missed)
+        assert scrubs == 0, "boot repair fell back to deep scrubbing"
+        for i in range(6):
+            want = payload(2 * STRIPE, 42 if f"pre{i}" in missed else i)
+            assert mc.get(pid, f"pre{i}", 2 * STRIPE) == want

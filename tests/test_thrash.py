@@ -8,12 +8,12 @@ ceph_test_rados (src/test/osd/RadosModel.cc) validates every read against
 a model of expected object contents).  Here the model is a plain dict;
 kills are bounded to m concurrent so every PG stays available (the suites
 bound thrashing with min_in the same way); revived shards are repaired via
-deep-scrub + recover_object before the next kill.
+log-based shard repair (PG log catch-up) before the next kill.
 """
 import numpy as np
 import pytest
 
-from ceph_tpu.backend.ec_backend import RecoveryState
+from ceph_tpu.backend.ec_backend import RepairState
 from ceph_tpu.cluster import MiniCluster
 
 K, M = 4, 2
@@ -50,19 +50,13 @@ def thrashed():
         down.discard(osd)
         for g in pg_buses_for(osd):
             g.bus.mark_up(osd)
-        # repair: deep-scrub every object in the PGs this osd serves and
-        # recover chunks that went stale while it was down
+        # repair via the PG log: replay exactly the writes the shard
+        # missed (O(missed), not O(all objects) — PGLog.cc semantics)
         for g in pg_buses_for(osd):
-            for oid in sorted(model):
-                if cluster.pg_group(pid, oid) is not g:
-                    continue
-                report = g.backend.be_deep_scrub(oid)
-                missing = {c for c, clean in report.items() if not clean}
-                if missing:
-                    rop = g.backend.recover_object(oid, missing)
-                    g.bus.deliver_all()
-                    assert rop.state == RecoveryState.COMPLETE, (
-                        f"recovery of {oid} chunks {missing}: {rop.state}")
+            rop = g.backend.start_shard_repair(osd)
+            g.bus.deliver_all()
+            assert rop.state == RepairState.COMPLETE, (
+                f"log repair of osd.{osd} in {g.pgid}: {rop.state}")
         log.append(f"revive osd.{osd}")
 
     def do_write():
