@@ -139,6 +139,11 @@ class RecoveryOp:
     missing_shards: set[int]
     state: RecoveryState = RecoveryState.IDLE
     read_tid: int | None = None
+    # pg_log version of the object when the recovery read was issued; a
+    # bump while the read was in flight means a write landed and the
+    # reconstructed bytes are stale — re-read instead of pushing them
+    # (the reference serializes this with per-object recovery locks)
+    at_version: int = 0
     pending_pushes: set[int] = field(default_factory=set)
     # sticky: a push target died before acking; even if the remaining
     # pushes ack, the op must finish FAILED (reference _failed_push fails
@@ -883,6 +888,7 @@ class ECBackend:
             minimum = self.ec_impl.minimum_to_decode(rop.missing_shards, avail)
             self.next_tid += 1
             rop.read_tid = self.next_tid
+            rop.at_version = self.pg_log.last_version_of(rop.oid)
             hinfo = self._hinfo(rop.oid)
             c_len = hinfo.get_total_chunk_size()
             per_shard = {}
@@ -912,6 +918,13 @@ class ECBackend:
         if rop._pending:
             return
         self._recovery_read_tids.pop(rop.read_tid, None)
+        if self.pg_log.last_version_of(rop.oid) != rop.at_version:
+            # a write to this oid committed between the recovery read and
+            # now: the reconstructed bytes predate it.  Re-read (the new
+            # chunks are on the survivors) instead of pushing stale data.
+            rop.state = RecoveryState.IDLE
+            self.continue_recovery_op(rop)
+            return
         # READING -> WRITING: reconstruct the missing chunks, push them.
         # chunk_size tells sub-chunk codes (clay) the helpers are fractional
         available = {c: np.frombuffer(v, dtype=np.uint8)

@@ -83,6 +83,12 @@ class PGLog:
 
     # -- queries -----------------------------------------------------------
 
+    def last_version_of(self, oid: str) -> int:
+        """Version of the newest in-window entry touching ``oid`` (0 when
+        none): the recovery-vs-write race check compares this before and
+        after a recovery read to detect an interleaved write."""
+        return self._last_by_oid.get(oid, 0)
+
     def entries_after(self, v: int) -> list[PGLogEntry] | None:
         """Entries with version > v, or None when v predates the tail
         (past the horizon: log cannot catch this follower up)."""

@@ -181,6 +181,8 @@ bool gfni_verified() {
     /* one-time self-check of the affine bit convention against the
      * scalar tables; falls back to pshufb if the layout ever mismatches */
     static bool ok = [] {
+        gf8::init_tables();  /* the check compares against MUL; an empty
+                              * table would vacuously pass and pin GFNI on */
         if (simd_level() < 2) return false;
         alignas(32) uint8_t src[32], dst[32];
         for (int i = 0; i < 32; i++) src[i] = (uint8_t)(i * 7 + 3);
