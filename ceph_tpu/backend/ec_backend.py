@@ -36,9 +36,10 @@ from .extent_cache import ExtentCache
 from .memstore import GObject, MemStore, Transaction
 from .messages import (ECSubRead, ECSubReadReply, ECSubWrite, ECSubWriteReply,
                        MessageBus, PGLogInfo, PGLogQuery, PGLogUpdate,
-                       PGScan, PGScanReply, PushOp, PushReply)
+                       PGScan, PGScanReply, PushOp, PushReply,
+                       RollForward, Rollback)
 from .transaction import PGTransaction, WritePlan, get_write_plan
-from ..osd.pg_log import OP_DELETE, OP_MODIFY, PGLog
+from ..osd.pg_log import OP_DELETE, OP_MODIFY, PGLog, dedup_latest
 
 
 class OSDShard:
@@ -53,18 +54,68 @@ class OSDShard:
         self.store = MemStore()
         self.bus = bus
         self.pg_log = PGLog()
+        # at_version -> inverse transaction restoring the pre-write state:
+        # the rollback info the reference's log entries carry until the
+        # write is rolled forward (ecbackend.rst:149-174)
+        self.pending_rollbacks: dict[int, Transaction] = {}
         bus.register(shard, self)
+
+    def _capture_rollback(self, t: Transaction) -> Transaction:
+        """Inverse transaction: snapshot every touched object's prior state
+        (chunk-sized objects make whole-object capture cheap)."""
+        touched = {op[1] for op in t.ops}
+        touched |= {op[2] for op in t.ops if op[0] == "clone"}
+        inv = Transaction()
+        for obj in sorted(touched, key=lambda g: (g.oid, g.shard)):
+            o = self.store.objects.get(obj)
+            inv.remove(obj)
+            if o is not None:
+                inv.write(obj, 0, bytes(o.data))
+                for name, value in o.xattrs.items():
+                    inv.setattr(obj, name, value)
+                if o.omap:
+                    inv.omap_setkeys(obj, dict(o.omap))
+        return inv
+
+    def _roll_forward(self, to: int) -> None:
+        for v in [v for v in self.pending_rollbacks if v <= to]:
+            del self.pending_rollbacks[v]
+
+    def _rollback(self, to: int) -> None:
+        """Undo logged-but-not-rolled-forward entries past ``to``, newest
+        first, and rewind the log."""
+        for v in sorted((v for v in self.pending_rollbacks if v > to),
+                        reverse=True):
+            self.store.queue_transaction(self.pending_rollbacks.pop(v))
+        self.pg_log.rewind(to)
 
     def handle_message(self, msg) -> None:
         if isinstance(msg, ECSubWrite):
+            if msg.log_entries and msg.at_version <= self.pg_log.head:
+                # duplicate delivery of an already-applied write: re-ack
+                # without re-applying (reqid dedup in the reference)
+                self.bus.send(msg.from_shard,
+                              ECSubWriteReply(self.shard, msg.tid,
+                                              gen=msg.gen))
+                return
+            if msg.roll_forward_to:
+                self._roll_forward(msg.roll_forward_to)
+            if msg.log_entries:
+                self.pending_rollbacks[msg.at_version] = \
+                    self._capture_rollback(msg.t)
             for e in msg.log_entries:
                 if e.version > self.pg_log.head:
                     self.pg_log.record(e)
             if msg.trim_to:
                 self.pg_log.trim(msg.trim_to)
+                self._roll_forward(msg.trim_to)
             self.store.queue_transaction(msg.t)
             self.bus.send(msg.from_shard,
-                          ECSubWriteReply(self.shard, msg.tid))
+                          ECSubWriteReply(self.shard, msg.tid, gen=msg.gen))
+        elif isinstance(msg, RollForward):
+            self._roll_forward(msg.to)
+        elif isinstance(msg, Rollback):
+            self._rollback(msg.to)
         elif isinstance(msg, PGLogQuery):
             self.bus.send(msg.from_shard, PGLogInfo(
                 self.shard, self.pg_log.head, self.pg_log.tail,
@@ -74,6 +125,10 @@ class OSDShard:
                 self.shard, oids=sorted({g.oid for g in self.store.objects
                                          if g.shard == self.shard})))
         elif isinstance(msg, PGLogUpdate):
+            # divergent entries past the rewind point were superseded by the
+            # repair's pushes: drop their rollback data without applying it
+            for v in [v for v in self.pending_rollbacks if v > msg.rewind_to]:
+                del self.pending_rollbacks[v]
             self.pg_log.merge_authoritative(
                 msg.entries, msg.last_update, msg.rewind_to, msg.trim_to)
         elif isinstance(msg, ECSubRead):
@@ -170,6 +225,10 @@ class ShardRepairOp:
     state: RepairState = RepairState.QUERY
     plan: str = ""                # "clean" | "log" | "backfill"
     rewind_to: int = 0
+    # authority log head when the repair's todo set was computed; writes
+    # committing past it mid-repair skipped the stale target and must be
+    # caught up before the shard is declared current
+    caught_up_to: int = 0
     pending: set = field(default_factory=set)   # ("recover"|"delete", oid)
     objects_repaired: int = 0
     failed: bool = False
@@ -180,12 +239,23 @@ class ShardRepairOp:
 class Op:
     """In-flight client write (ECBackend::Op, ECBackend.h:390-440)."""
     tid: int
-    plan: WritePlan
+    t: PGTransaction
     on_commit: object
+    # computed at pipeline admission (try_state_to_reads) so a rolled-back
+    # op re-plans against the restored object state when re-admitted
+    plan: WritePlan | None = None
     pending_read_shards: set[int] = field(default_factory=set)
     remote_reads: dict[str, dict[int, bytes]] = field(default_factory=dict)  # oid -> {logical off: stripe data}
     pending_commit_shards: set[int] = field(default_factory=set)
+    acked_shards: set[int] = field(default_factory=set)
     cache_claims: list[tuple[str, int]] = field(default_factory=list)
+    # version span (first_version, at_version] of this op's log entries,
+    # recorded at fan-out; rollback rewinds to first_version - 1
+    first_version: int = 0
+    at_version: int = 0
+    # dispatch generation: bumped each fan-out so stale acks from a
+    # rolled-back dispatch are ignored
+    gen: int = 0
     # reads unrecoverable with current up set; re-driven by on_shard_up
     _rmw_stalled: bool = False
     tracked: object = None      # OpTracker request (mark_event timeline)
@@ -212,7 +282,7 @@ class ECBackend:
 
     def __init__(self, ec_impl, sinfo: StripeInfo, bus: MessageBus,
                  acting: list[int], whoami: int = 0, cct=None,
-                 name: str = ""):
+                 name: str = "", min_size: int = 0):
         # `name` disambiguates observability registrations when several
         # backends (e.g. one per PG) share a Context and a primary OSD id
         n = ec_impl.get_chunk_count()
@@ -222,6 +292,12 @@ class ECBackend:
         self.bus = bus
         self.acting = list(acting)
         self.whoami = whoami
+        # write availability floor: a write is never acked with fewer than
+        # min_size current shards holding it (the pool min_size the
+        # reference's PeeringState enforces by going inactive; VERDICT r3
+        # item 1).  Floored at k: an ack on fewer than k shards would be
+        # unreadable data, which is exactly the loss the gate prevents.
+        self.min_size = max(min_size or 0, ec_impl.get_data_chunk_count())
         self.local_shard = OSDShard(whoami, bus)
         bus.handlers[whoami] = self  # primary intercepts its own queue
         self.next_tid = 0
@@ -230,6 +306,10 @@ class ECBackend:
         self.waiting_reads: deque[Op] = deque()
         self.waiting_commit: deque[Op] = deque()
         self.tid_to_op: dict[int, Op] = {}
+        # RMW pipeline reads get a fresh tid per dispatch so replies from a
+        # superseded dispatch (shard death re-issue, rollback re-queue)
+        # find no mapping and drop instead of polluting the op's buffers
+        self._rmw_read_tids: dict[int, Op] = {}
         self.extent_cache = ExtentCache()
         # read path
         self.in_progress_reads: dict[int, ReadOp] = {}
@@ -244,6 +324,17 @@ class ECBackend:
         # staleness (writes committed by the other shards while it was
         # down) and repair itself through the same query/replay machinery.
         self.pg_log = PGLog()
+        # two-phase commit bookkeeping: committed_to = newest version acked
+        # by >= min_size shards (the roll-forward point); _rolled_forward_to
+        # = the point already announced to the shards
+        self.committed_to = 0
+        self._rolled_forward_to = 0
+        self._rollback_pending = 0
+        # shards that revived but have not been repaired yet: excluded from
+        # reads AND from write fan-out until a shard repair completes (the
+        # reference keeps stale shards out of the acting set until
+        # recovery/backfill, PeeringState.cc)
+        self.stale: set[int] = set()
         self.shard_repairs: dict[int, "ShardRepairOp"] = {}
         self._repair_write_tids: dict[int, tuple["ShardRepairOp", str]] = {}
         self._scan_waiters: dict[int, "ShardRepairOp"] = {}
@@ -256,6 +347,8 @@ class ECBackend:
         self.perf = (
             PerfCountersBuilder(f"ec_backend.{self.instance_name}")
             .add_u64_counter("writes", "client writes committed")
+            .add_u64_counter("write_rollbacks",
+                             "in-flight writes rolled back (min_size)")
             .add_u64_counter("reads", "client reads completed")
             .add_u64_counter("read_errors", "per-object read failures (EIO)")
             .add_u64_counter("write_bytes", "client bytes written")
@@ -295,6 +388,19 @@ class ECBackend:
     def up_shards(self) -> set[int]:
         return {s for s in self.acting if s not in self.bus.down}
 
+    def current_shards(self) -> set[int]:
+        """Up AND repaired: the shards that may serve reads and receive
+        write fan-out (the reference's acting set after peering; stale
+        revived shards rejoin once their shard repair completes)."""
+        return {s for s in self.acting
+                if s not in self.bus.down and s not in self.stale}
+
+    def is_active(self) -> bool:
+        """Writes proceed only while >= min_size current shards exist (the
+        PG-active gate of PeeringState; below it client writes park in
+        waiting_state until shards return — never acked, never lost)."""
+        return len(self.current_shards()) >= self.min_size
+
     def _hinfo(self, oid: str) -> HashInfo:
         if oid not in self.hinfo_cache:
             n = self.ec_impl.get_chunk_count()
@@ -327,6 +433,14 @@ class ECBackend:
             self.handle_pg_log_info(msg)
         elif isinstance(msg, PGScanReply):
             self.handle_pg_scan_reply(msg)
+        elif isinstance(msg, Rollback):
+            # primary's own shard rolls back; the authority-side hinfo cache
+            # reflects the rolled-back write and must be re-read from the
+            # restored xattrs before re-queued ops re-plan
+            self.local_shard.handle_message(msg)
+            self.hinfo_cache.clear()
+            self._rollback_pending = max(0, self._rollback_pending - 1)
+            self.check_ops()
         else:
             self.local_shard.handle_message(msg)
 
@@ -421,8 +535,23 @@ class ECBackend:
         self.check_ops()
 
     def on_shard_up(self, shard: int) -> None:
-        """Re-drive work parked by unrecoverable shard loss once a shard
-        returns (the reference re-peers on the osdmap epoch bump)."""
+        """A revived shard is stale — it missed every write since it died —
+        so it is kept out of reads and write fan-out and a shard repair
+        starts automatically (the reference re-peers on the osdmap epoch
+        bump, which drives log-based recovery the same way).  Parked work
+        re-drives now and again when the repair completes."""
+        if shard in self.acting:
+            # stale until repair completes: serving reads could return old
+            # bytes; receiving new writes would make its log head current
+            # while mid-history entries are missing, defeating log catch-up
+            self.stale.add(shard)
+            if shard not in self.shard_repairs:
+                self.start_shard_repair(shard)
+        self._redrive_parked()
+
+    def _redrive_parked(self) -> None:
+        """Re-drive ops parked by unrecoverable shard loss (called on shard
+        revival and on repair completion, when current_shards() grows)."""
         for op in list(self.waiting_reads):
             if getattr(op, "_rmw_stalled", False):
                 op.pending_read_shards.clear()
@@ -438,16 +567,25 @@ class ECBackend:
                 self.continue_recovery_op(rop)
             except IOError:
                 self._stalled_recoveries.append(rop)
+        # a stale shard whose repair FAILED (a peer died mid-repair) gets a
+        # fresh repair on the next cluster event — the role re-peering on
+        # a map change plays in the reference
+        for shard in sorted(self.stale & self.up_shards()):
+            if shard not in self.shard_repairs:
+                self.start_shard_repair(shard)
         self.check_ops()
 
     # -- write pipeline ----------------------------------------------------
 
     def submit_transaction(self, t: PGTransaction, on_commit=None) -> int:
-        """Client entry point (ECBackend.cc:1477 -> start_rmw :1830)."""
+        """Client entry point (ECBackend.cc:1477 -> start_rmw :1830).
+
+        While the PG is inactive (< min_size current shards) the op parks
+        in waiting_state — queued, unacked, unapplied — and is re-driven
+        when shards return (the reference blocks I/O on an inactive PG)."""
         self.next_tid += 1
         tid = self.next_tid
-        plan = get_write_plan(self.sinfo, t, self._hinfo)
-        op = Op(tid=tid, plan=plan, on_commit=on_commit)
+        op = Op(tid=tid, t=t, on_commit=on_commit)
         op.tracked = self.op_tracker.create_request(
             f"osd_op(write tid={tid} objects={sorted(t.ops)})")
         op.tracked.mark_event("queued_for_pg")
@@ -466,7 +604,11 @@ class ECBackend:
         """Advance each pipeline stage's head as far as possible
         (ECBackend.cc:2137-2145).  Re-loops because an op reaching the
         commit stage pins its result in the extent cache, which can unblock
-        a stalled overlapping op behind it."""
+        a stalled overlapping op behind it.  Gated on the PG being active
+        (min_size current shards) and on no rollback being mid-flight (a
+        re-queued op must re-plan against the restored state)."""
+        if not self.is_active() or self._rollback_pending:
+            return
         progress = True
         while progress:
             progress = False
@@ -495,6 +637,8 @@ class ECBackend:
         """(ECBackend.cc:1856-1928): satisfy RMW reads from the extent cache
         where pinned; issue remote shard reads for the rest."""
         op = self.waiting_state[0]
+        if op.plan is None:
+            op.plan = get_write_plan(self.sinfo, op.t, self._hinfo)
         if self._blocked_on_inflight_write(op):
             return False
         need_remote: dict[str, ExtentSet] = {}
@@ -516,9 +660,9 @@ class ECBackend:
         whole stripes, so the k data chunks suffice when healthy; degraded
         objects fall back to the reconstructing read path)."""
         k = self.ec_impl.get_data_chunk_count()
-        up = self.up_shards()
+        cur = self.current_shards()
         want = {self.ec_impl.chunk_index(i) for i in range(k)}
-        avail = {i for i, s in enumerate(self.acting) if s in up}
+        avail = {i for i, s in enumerate(self.acting) if s in cur}
         minimum = self.ec_impl.minimum_to_decode(want, avail)
         per_shard: dict[int, dict[str, list[tuple]]] = {}
         for oid, es in need.items():
@@ -532,9 +676,14 @@ class ECBackend:
         op._rmw_chunks = {c: self.acting[c] for c in minimum}
         op._rmw_need = need
         op._rmw_buf: dict[str, dict[int, dict[int, bytes]]] = {}
+        self._rmw_read_tids.pop(getattr(op, "_rmw_read_tid", None), None)
+        self.next_tid += 1
+        op._rmw_read_tid = self.next_tid
+        self._rmw_read_tids[op._rmw_read_tid] = op
         for shard, to_read in per_shard.items():
             op.pending_read_shards.add(shard)
-            self.bus.send(shard, ECSubRead(self.whoami, op.tid, to_read))
+            self.bus.send(shard, ECSubRead(self.whoami, op._rmw_read_tid,
+                                           to_read))
 
     def try_reads_to_commit(self) -> bool:
         """(ECBackend.cc:1930-2087): encode the will-write extents in one
@@ -548,6 +697,7 @@ class ECBackend:
         n = self.ec_impl.get_chunk_count()
         shard_txns = {shard: Transaction() for shard in self.acting}
         log_entries = []
+        op.first_version = self.pg_log.head + 1
         for oid, will_write in op.plan.will_write.items():
             objop = op.plan.t.ops[oid]
             hinfo = op.plan.hash_infos[oid]
@@ -628,18 +778,24 @@ class ECBackend:
                     hinfo.projected_total_chunk_size)
             self._persist_hinfo(oid, hinfo, shard_txns)
 
-        # fan out ECSubWrite to every up shard (down shards miss the write
-        # and are repaired later by recovery — the reference's peering would
-        # instead shrink the acting set)
-        up = self.up_shards()
-        op.pending_commit_shards = set(up)
+        # fan out ECSubWrite to every current shard (down/stale shards miss
+        # the write and are repaired later by the log — the reference's
+        # peering likewise keeps them out of the acting set)
+        cur = self.current_shards()
+        op.at_version = self.pg_log.head
+        op.gen += 1
+        op.acked_shards = set()
+        op.pending_commit_shards = set(cur)
         trim_to = self.pg_log.trim_target()
         for shard in self.acting:
-            if shard in up:
+            if shard in cur:
                 self.bus.send(shard, ECSubWrite(
                     self.whoami, op.tid, shard_txns[shard],
-                    at_version=self.pg_log.head, trim_to=trim_to,
-                    log_entries=list(log_entries)))
+                    at_version=op.at_version, trim_to=trim_to,
+                    log_entries=list(log_entries),
+                    roll_forward_to=self.committed_to, gen=op.gen))
+        self._rolled_forward_to = max(self._rolled_forward_to,
+                                      self.committed_to)
         self.pg_log.maybe_trim()
         return True
 
@@ -681,8 +837,9 @@ class ECBackend:
             self._maybe_finish_shard_repair(rop)
             return
         op = self.tid_to_op.get(reply.tid)
-        if op is None:
-            return
+        if op is None or reply.gen != op.gen:
+            return                      # stale ack from a rolled-back dispatch
+        op.acked_shards.add(reply.from_shard)
         op.pending_commit_shards.discard(reply.from_shard)
         self.try_finish_rmw()
 
@@ -693,13 +850,24 @@ class ECBackend:
             op.pending_commit_shards &= self.up_shards()
             if op.pending_commit_shards:
                 return
+            # write-availability gate (ecbackend.rst:149-174): the write is
+            # durable only if >= min_size shards hold it.  Shards that died
+            # after acking still hold it on disk but can't serve; count
+            # only live acks.  Below the floor the write — and every later
+            # in-flight write — rolls back; nothing was ever acked to the
+            # client, so nothing is lost.
+            live_acked = op.acked_shards & self.up_shards()
+            if len(live_acked) < self.min_size:
+                self._rollback_incomplete()
+                return
             self.waiting_commit.popleft()
+            self.committed_to = max(self.committed_to, op.at_version)
             for oid, tid in op.cache_claims:
                 self.extent_cache.release(oid, tid)
             del self.tid_to_op[op.tid]
             self.perf.inc("writes")
             self.perf.inc("write_bytes", sum(
-                len(d) for objop in op.plan.t.ops.values()
+                len(d) for objop in op.t.ops.values()
                 for _, d in objop.buffer_updates))
             self._update_pipeline_depth()
             if op.tracked:
@@ -707,6 +875,63 @@ class ECBackend:
                 op.tracked.finish()
             if op.on_commit:
                 op.on_commit(op.tid)
+        # pipeline drained with an unannounced roll-forward point: kick it
+        # to the shards so they drop rollback data (the reference's dummy
+        # transaction, ECBackend.cc:2106-2120)
+        if self.committed_to > self._rolled_forward_to:
+            self._rolled_forward_to = self.committed_to
+            for shard in sorted(self.current_shards()):
+                self.bus.send(shard, RollForward(self.whoami,
+                                                 self.committed_to))
+
+    def _rollback_incomplete(self) -> None:
+        """Undo every in-flight commit-stage write (head first failed; all
+        later ones have higher versions and must unwind with it), rewind
+        the authority log, and re-queue the ops at the pipeline head to
+        re-plan and re-execute once the PG is active again.
+
+        Ops still in waiting_reads / waiting_state are reset too: their
+        plans and RMW reads were computed against HashInfo state and
+        extent-cache bytes of the writes being rolled back."""
+        ops = list(self.waiting_commit)
+        self.waiting_commit.clear()
+        to = ops[0].first_version - 1
+        self.perf.inc("write_rollbacks", len(ops))
+        read_ops = list(self.waiting_reads)
+        self.waiting_reads.clear()
+        state_ops = list(self.waiting_state)
+        self.waiting_state.clear()
+        ops = ops + read_ops + state_ops    # original pipeline order
+        for shard in sorted(self.up_shards()):
+            # FIFO per-shard queues order the Rollback after any still-
+            # undelivered sub-writes of these ops, so every shard unwinds
+            # exactly what it applied
+            if shard == self.whoami:
+                self._rollback_pending += 1
+            self.bus.send(shard, Rollback(self.whoami, to))
+        if self.whoami not in self.up_shards():
+            # local shard marked down: its queue was cleared, so no sub-
+            # write can race a synchronous local unwind
+            self.local_shard._rollback(to)
+            self.hinfo_cache.clear()
+        self.pg_log.rewind(to)
+        self.committed_to = min(self.committed_to, to)
+        for op in ops:
+            for oid, tid in op.cache_claims:
+                self.extent_cache.release(oid, tid)
+            op.cache_claims.clear()
+            op.plan = None
+            op.pending_read_shards.clear()
+            op.remote_reads.clear()
+            op.pending_commit_shards.clear()
+            op.acked_shards.clear()
+            self._rmw_read_tids.pop(getattr(op, "_rmw_read_tid", None), None)
+            op._rmw_buf = {}
+            op._rmw_stalled = False
+            if op.tracked:
+                op.tracked.mark_event("rolled_back")
+        self.waiting_state.extend(ops)
+        self._update_pipeline_depth()
 
     # -- read path ---------------------------------------------------------
 
@@ -718,8 +943,8 @@ class ECBackend:
         tid = self.next_tid
         rop = ReadOp(tid=tid, to_read=reads, on_complete=on_complete)
         k = self.ec_impl.get_data_chunk_count()
-        up = self.up_shards()
-        avail = {i for i, s in enumerate(self.acting) if s in up}
+        cur = self.current_shards()
+        avail = {i for i, s in enumerate(self.acting) if s in cur}
         want = {self.ec_impl.chunk_index(i) for i in range(k)}
         per_shard: dict[int, dict[str, list[tuple]]] = {}
         for oid, extents in reads.items():
@@ -758,7 +983,7 @@ class ECBackend:
             self.handle_recovery_read_reply(rop_rec, reply)
             return
         # RMW pipeline reads
-        op = self.tid_to_op.get(reply.tid)
+        op = self._rmw_read_tids.get(reply.tid)
         if op is not None:
             self._handle_rmw_read_reply(op, reply)
             return
@@ -784,7 +1009,7 @@ class ECBackend:
     def _retry_remaining_shards(self, rop: ReadOp, oid: str) -> None:
         """Incremental recovery from shard read errors (ECBackend.cc:1627-1671)."""
         k = self.ec_impl.get_data_chunk_count()
-        up = self.up_shards()
+        up = self.current_shards()
         avail = {c for c, s in enumerate(self.acting)
                  if s in up and c not in rop.errors.get(oid, set())}
         untried = avail - rop.tried_shards[oid]
@@ -815,6 +1040,7 @@ class ECBackend:
             for c_off, data in bufs:
                 store.setdefault(c_off, {})[chunk] = data
         if not op.pending_read_shards:
+            self._rmw_read_tids.pop(getattr(op, "_rmw_read_tid", None), None)
             self._finish_rmw_reads(op)
             self.check_ops()
 
@@ -866,7 +1092,7 @@ class ECBackend:
     def is_recoverable(self, oid: str, missing: set[int]) -> bool:
         """ECRecPred analog (ECBackend.h:581-607)."""
         avail = {c for c, s in enumerate(self.acting)
-                 if s in self.up_shards() and c not in missing}
+                 if s in self.current_shards() and c not in missing}
         try:
             self.ec_impl.minimum_to_decode(set(missing), avail)
             return True
@@ -878,13 +1104,20 @@ class ECBackend:
         rop = RecoveryOp(oid=oid, missing_shards=set(missing_chunks),
                          on_complete=on_complete)
         self.recovery_ops[oid] = rop
-        self.continue_recovery_op(rop)
+        try:
+            self.continue_recovery_op(rop)
+        except IOError:
+            # too few current shards right now: park; re-driven when a
+            # shard returns (the reference defers recovery the same way
+            # when sources are missing)
+            self._stalled_recoveries.append(rop)
         return rop
 
     def continue_recovery_op(self, rop: RecoveryOp) -> None:
         if rop.state == RecoveryState.IDLE:
             avail = {c for c, s in enumerate(self.acting)
-                     if s in self.up_shards() and c not in rop.missing_shards}
+                     if s in self.current_shards()
+                     and c not in rop.missing_shards}
             minimum = self.ec_impl.minimum_to_decode(rop.missing_shards, avail)
             self.next_tid += 1
             rop.read_tid = self.next_tid
@@ -934,12 +1167,21 @@ class ECBackend:
                             rop.missing_shards,
                             chunk_size=hinfo.get_total_chunk_size())
         rop.state = RecoveryState.WRITING
+        up = self.up_shards()
         for chunk in rop.missing_shards:
             shard = self.acting[chunk]
+            if shard not in up:
+                # target died while the reads were in flight: a push would
+                # drop silently and never ack — fail now exactly as
+                # on_shard_down fails an already-sent push (_failed_push)
+                rop.failed = True
+                continue
             rop.pending_pushes.add(shard)
             self.bus.send(shard, PushOp(
                 self.whoami, rop.oid, bytes(rec[chunk]),
                 attrs={HINFO_KEY: hinfo.to_dict()}))
+        if not rop.pending_pushes:
+            self._finish_recovery_op(rop, failed=rop.failed)
 
     def handle_push_reply(self, reply: PushReply) -> None:
         rop = self.recovery_ops.get(reply.oid)
@@ -974,6 +1216,19 @@ class ECBackend:
         shard too: its local log lags the authority log by exactly the
         writes that committed while it was down, and the recovery pushes
         self-deliver over the bus."""
+        existing = self.shard_repairs.get(shard)
+        if existing is not None:
+            # one repair per shard at a time: revival auto-starts one, an
+            # explicit caller joins it
+            if on_complete is not None:
+                prev = existing.on_complete
+
+                def chained(r, _prev=prev, _cb=on_complete):
+                    if _prev:
+                        _prev(r)
+                    _cb(r)
+                existing.on_complete = chained
+            return existing
         chunk = self.acting.index(shard)
         rop = ShardRepairOp(shard=shard, chunk=chunk,
                             on_complete=on_complete)
@@ -990,6 +1245,7 @@ class ECBackend:
         plan, entries = self.pg_log.catch_up_plan(info.last_update)
         # the rewind point: last shard version consistent with our log
         rop.rewind_to = min(info.last_update, self.pg_log.head, div_rewind)
+        rop.caught_up_to = self.pg_log.head
         if plan == "backfill":
             rop.plan = "backfill"
             rop.state = RepairState.SCAN
@@ -1022,7 +1278,7 @@ class ECBackend:
         target = rop.shard
         if rop.shard == self.whoami:
             others = [s for s in self.acting
-                      if s != self.whoami and s in self.up_shards()]
+                      if s != self.whoami and s in self.current_shards()]
             if not others:
                 rop.failed = True
                 self._finish_shard_repair(rop)
@@ -1041,6 +1297,9 @@ class ECBackend:
         else:
             authority = self._local_oids()
             target_list = set(reply.oids)
+        # the object lists reflect this moment: writes after it are the
+        # delta _maybe_finish_shard_repair catches up
+        rop.caught_up_to = self.pg_log.head
         rop.state = RepairState.RECOVERING
         for oid in sorted(authority):
             self._repair_one(rop, oid, OP_MODIFY)
@@ -1091,6 +1350,18 @@ class ECBackend:
     def _maybe_finish_shard_repair(self, rop: ShardRepairOp) -> None:
         if rop.state != RepairState.RECOVERING or rop.pending:
             return
+        # writes that committed while the repair was in flight skipped the
+        # stale target (it is out of the fan-out): repair the delta before
+        # declaring it current, else its log would claim writes whose data
+        # it never received
+        if not rop.failed and self.pg_log.head > rop.caught_up_to:
+            delta = dedup_latest([e for e in self.pg_log.entries
+                                  if e.version > rop.caught_up_to])
+            rop.caught_up_to = self.pg_log.head
+            for e in delta:
+                self._repair_one(rop, e.oid, e.op)
+            if rop.pending:
+                return
         self._finish_shard_repair(rop)
 
     def _finish_shard_repair(self, rop: ShardRepairOp) -> None:
@@ -1098,6 +1369,9 @@ class ECBackend:
         if rop.failed:
             rop.state = RepairState.FAILED
         else:
+            # repaired: the shard is current again — it rejoins reads and
+            # write fan-out, and its return may reactivate a parked PG
+            self.stale.discard(rop.shard)
             # data is current: ship the authoritative log segment so the
             # shard's next repair takes the clean fast path
             self.bus.send(rop.shard, PGLogUpdate(
@@ -1111,6 +1385,8 @@ class ECBackend:
                           else "backfill_objects", rop.objects_repaired)
         if rop.on_complete:
             rop.on_complete(rop)
+        if not rop.failed:
+            self._redrive_parked()
 
     # -- deep scrub (ECBackend.cc:2461-2546) -------------------------------
 

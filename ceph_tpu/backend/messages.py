@@ -32,6 +32,14 @@ class ECSubWrite:
     trim_to: int = 0
     log_entries: list = field(default_factory=list)
     backfill_or_async_recovery: bool = False
+    # two-phase commit: entries <= this are stable cluster-wide, the shard
+    # may drop their rollback data (the reference piggybacks
+    # roll_forward_to on every sub-write, ECMsgTypes.h:23-38)
+    roll_forward_to: int = 0
+    # dispatch generation: a rolled-back-and-reissued op bumps this so the
+    # primary can tell fresh acks from stale ones (the role op reqids and
+    # the osdmap epoch stamp play in the reference)
+    gen: int = 0
 
 
 @dataclass
@@ -41,6 +49,26 @@ class ECSubWriteReply:
     tid: int
     committed: bool = True
     applied: bool = True
+    gen: int = 0
+
+
+@dataclass
+class RollForward:
+    """Primary -> shard: entries <= ``to`` are committed on min_size shards;
+    drop their rollback data.  The standalone kick the reference sends as a
+    dummy transaction when the pipeline drains (ECBackend.cc:2106-2120)."""
+    from_shard: int
+    to: int
+
+
+@dataclass
+class Rollback:
+    """Primary -> shard: undo every logged entry with version > ``to`` using
+    the rollback info captured at apply time, and rewind your log.  The
+    divergent-entry rollback of the reference's peering
+    (doc/dev/osd_internals/erasure_coding/ecbackend.rst:149-174)."""
+    from_shard: int
+    to: int
 
 
 @dataclass

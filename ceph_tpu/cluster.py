@@ -37,11 +37,17 @@ NONE_ID = 0x7FFFFFFF          # CRUSH_ITEM_NONE
 _cluster_ids = itertools.count(1)
 
 
+class BlockedWriteError(IOError):
+    """A write parked on an inactive PG (< min_size current shards): it is
+    queued — neither acked nor lost — and commits when shards return."""
+
+
 class PGGroup:
     """One placement group: primary backend + shard OSDs on its own bus."""
 
     def __init__(self, pgid: PG, acting: list[int], ec_impl,
-                 chunk_size: int, cct, name_prefix: str):
+                 chunk_size: int, cct, name_prefix: str,
+                 min_size: int = 0):
         self.pgid = pgid
         self.acting = acting
         self.bus = MessageBus()
@@ -52,7 +58,7 @@ class PGGroup:
         self.backend = ECBackend(
             ec_impl, StripeInfo(k, chunk_size), self.bus,
             acting=list(acting), whoami=primary, cct=cct,
-            name=f"{name_prefix}.pg{pgid}")
+            name=f"{name_prefix}.pg{pgid}", min_size=min_size)
         for osd in acting:
             if osd != primary:
                 OSDShard(osd, self.bus)
@@ -126,7 +132,8 @@ class MiniCluster:
                     f"pg {pgid} not fully mapped (acting={acting}); "
                     f"add OSDs or shrink k+m")
             pgs[ps] = PGGroup(pgid, acting, ec, self.chunk_size, self.cct,
-                              name_prefix=f"c{self.cluster_id}")
+                              name_prefix=f"c{self.cluster_id}",
+                              min_size=pool.min_size)
         self.pools[pool_id] = {"pool": pool, "pgs": pgs, "ec": ec}
         self.pool_ids[name] = pool_id
         return pool_id
@@ -144,15 +151,33 @@ class MiniCluster:
     # -- client I/O --------------------------------------------------------
 
     def put(self, pool_id: int, oid: str, data: bytes,
-            deliver: bool = True) -> PGGroup:
+            deliver: bool = True, wait: bool = True,
+            on_commit=None) -> PGGroup:
+        """Write ``oid``.  With ``wait`` (default), raises BlockedWriteError
+        if the PG is inactive (< min_size current shards) — the op stays
+        queued and commits when shards return, exactly like a blocked
+        client op on an inactive reference PG.  ``on_commit`` fires when
+        (possibly much later) the write is durable on min_size shards."""
         g = self.pg_group(pool_id, oid)
         sw = g.backend.sinfo.stripe_width
         pad = (-len(data)) % sw
+        done: list[int] = []
+
+        def _committed(tid):
+            done.append(tid)
+            if on_commit:
+                on_commit(tid)
         g.backend.submit_transaction(
-            PGTransaction().write(oid, 0, bytes(data) + b"\0" * pad))
+            PGTransaction().write(oid, 0, bytes(data) + b"\0" * pad),
+            on_commit=_committed)
+        self.objects.setdefault(pool_id, set()).add(oid)
         if deliver:
             g.bus.deliver_all()
-        self.objects.setdefault(pool_id, set()).add(oid)
+            if wait and not done:
+                raise BlockedWriteError(
+                    f"write of {oid} blocked: PG {g.pgid} inactive "
+                    f"({len(g.backend.current_shards())} current shards < "
+                    f"min_size {g.backend.min_size})")
         return g
 
     def get(self, pool_id: int, oid: str, length: int) -> bytes:

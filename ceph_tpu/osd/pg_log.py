@@ -72,6 +72,19 @@ class PGLog:
         self.tail = max(self.tail, to)
         return n
 
+    def rewind(self, to: int) -> list[PGLogEntry]:
+        """Drop entries with version > ``to`` (the rollback half of the
+        reference's two-phase EC write: entries past the roll-forward point
+        are undone when a write fails to reach min_size — the divergent-
+        entry rewind of PGLog::merge_log applied locally).  Returns the
+        dropped entries, newest first."""
+        dropped: list[PGLogEntry] = []
+        while self.entries and self.entries[-1].version > to:
+            dropped.append(self.entries.pop())
+        self.head = max(min(self.head, to), self.tail)
+        self._last_by_oid = {e.oid: e.version for e in self.entries}
+        return dropped
+
     def trim_target(self) -> int:
         """Version the followers should trim to (primary piggybacks this on
         sub-writes the way the reference ships ``trim_to``)."""
@@ -135,12 +148,7 @@ class PGLog:
         """Adopt an authority's segment (the follower half of merge_log):
         drop everything past ``rewind_to``, append the shipped entries,
         advance head to ``last_update``."""
-        while self.entries and self.entries[-1].version > rewind_to:
-            e = self.entries.pop()
-            if self._last_by_oid.get(e.oid) == e.version:
-                del self._last_by_oid[e.oid]
-        self.head = max(min(self.head, rewind_to), self.tail)
-        self._last_by_oid = {e.oid: e.version for e in self.entries}
+        self.rewind(rewind_to)
         for e in entries:
             if e.version > self.head:
                 self.record(e)
