@@ -23,6 +23,7 @@ IDLE->READING->WRITING->COMPLETE machine (ECBackend.h:249-293).
 """
 from __future__ import annotations
 
+import pickle
 from collections import deque
 from dataclasses import dataclass, field
 from enum import Enum
@@ -42,29 +43,79 @@ from .transaction import PGTransaction, WritePlan, get_write_plan
 from ..osd.pg_log import OP_DELETE, OP_MODIFY, PGLog, dedup_latest
 
 
+PG_META = "_pgmeta_"          # the reference's pgmeta object: PG log +
+                              # rollback info live in its omap so they
+                              # commit atomically with the data they cover
+
+
+def _log_key(version: int) -> str:
+    return f"log.{version:016d}"
+
+
+def _rb_key(version: int) -> str:
+    return f"rb.{version:016d}"
+
+
 class OSDShard:
-    """One shard OSD: a MemStore plus the server side of the EC sub-ops
+    """One shard OSD: an ObjectStore plus the server side of the EC sub-ops
     (handle_sub_write ECBackend.cc:910-983, handle_sub_read :985-1031,
     recovery push :511-563) and a per-shard PG log that advances with
     every applied sub-write (the reference logs entries in
-    handle_sub_write before queueing the transaction, ECBackend.cc:956)."""
+    handle_sub_write before queueing the transaction, ECBackend.cc:956).
 
-    def __init__(self, shard: int, bus: MessageBus):
+    The PG log, its (head, tail) and per-write rollback info persist in
+    the ``_pgmeta_`` object's omap INSIDE the same transaction as the data
+    they describe — the reference stores the PG log in the pgmeta omap the
+    same way — so a durable store (FileStore) survives restart with log
+    and rollback state intact and boots via ``_load_pg_state``."""
+
+    def __init__(self, shard: int, bus: MessageBus, store=None):
         self.shard = shard
-        self.store = MemStore()
+        self.store = store if store is not None else MemStore()
         self.bus = bus
         self.pg_log = PGLog()
         # at_version -> inverse transaction restoring the pre-write state:
         # the rollback info the reference's log entries carry until the
         # write is rolled forward (ecbackend.rst:149-174)
         self.pending_rollbacks: dict[int, Transaction] = {}
+        self._load_pg_state()
         bus.register(shard, self)
+
+    def _meta(self) -> GObject:
+        return GObject(PG_META, self.shard)
+
+    def _load_pg_state(self) -> None:
+        """Boot: rebuild the in-RAM log + rollback map from the pgmeta
+        omap (the OSD::init superblock/PG-load path, OSD.cc:2719)."""
+        if not self.store.exists(self._meta()):
+            return
+        omap = self.store.get_omap(self._meta())
+        head, tail = pickle.loads(omap["vi"]) if "vi" in omap else (0, 0)
+        self.pg_log.tail = tail
+        self.pg_log.head = tail
+        for key in sorted(k for k in omap if k.startswith("log.")):
+            e = pickle.loads(omap[key])
+            if e.version > self.pg_log.head:
+                self.pg_log.record(e)
+        self.pg_log.head = max(self.pg_log.head, head)
+        for key in (k for k in omap if k.startswith("rb.")):
+            inv = Transaction()
+            inv.ops = pickle.loads(omap[key])
+            self.pending_rollbacks[int(key[3:])] = inv
+
+    def _persist_vi(self, t: Transaction) -> None:
+        t.omap_setkeys(self._meta(), {"vi": pickle.dumps(
+            (self.pg_log.head, self.pg_log.tail))})
 
     def _capture_rollback(self, t: Transaction) -> Transaction:
         """Inverse transaction: snapshot every touched object's prior state
-        (chunk-sized objects make whole-object capture cheap)."""
+        (chunk-sized objects make whole-object capture cheap).  The pgmeta
+        object is never captured — its log/rb keys are unwound explicitly
+        by _rollback, and snapshotting it would embed every prior rb blob
+        in each new one."""
         touched = {op[1] for op in t.ops}
         touched |= {op[2] for op in t.ops if op[0] == "clone"}
+        touched = {obj for obj in touched if obj.oid != PG_META}
         inv = Transaction()
         for obj in sorted(touched, key=lambda g: (g.oid, g.shard)):
             o = self.store.objects.get(obj)
@@ -77,17 +128,36 @@ class OSDShard:
                     inv.omap_setkeys(obj, dict(o.omap))
         return inv
 
-    def _roll_forward(self, to: int) -> None:
-        for v in [v for v in self.pending_rollbacks if v <= to]:
+    def _roll_forward(self, to: int, txn: Transaction | None = None) -> None:
+        """Drop rollback data for entries <= ``to``; the key removals ride
+        ``txn`` when given (piggybacked roll-forward) or commit on their
+        own (the standalone kick)."""
+        dropped = [v for v in self.pending_rollbacks if v <= to]
+        if not dropped:
+            return
+        for v in dropped:
             del self.pending_rollbacks[v]
+        t = txn if txn is not None else Transaction()
+        t.omap_rmkeys(self._meta(), [_rb_key(v) for v in dropped])
+        if txn is None:
+            self.store.queue_transaction(t)
 
     def _rollback(self, to: int) -> None:
         """Undo logged-but-not-rolled-forward entries past ``to``, newest
-        first, and rewind the log."""
-        for v in sorted((v for v in self.pending_rollbacks if v > to),
-                        reverse=True):
-            self.store.queue_transaction(self.pending_rollbacks.pop(v))
-        self.pg_log.rewind(to)
+        first, and rewind the log — one atomic transaction."""
+        t = Transaction()
+        rb = sorted((v for v in self.pending_rollbacks if v > to),
+                    reverse=True)
+        for v in rb:
+            t.append(self.pending_rollbacks.pop(v))
+        dropped = self.pg_log.rewind(to)
+        if not rb and not dropped:
+            return
+        t.omap_rmkeys(self._meta(),
+                      [_rb_key(v) for v in rb] +
+                      [_log_key(e.version) for e in dropped])
+        self._persist_vi(t)
+        self.store.queue_transaction(t)
 
     def handle_message(self, msg) -> None:
         if isinstance(msg, ECSubWrite):
@@ -98,18 +168,35 @@ class OSDShard:
                               ECSubWriteReply(self.shard, msg.tid,
                                               gen=msg.gen))
                 return
-            if msg.roll_forward_to:
-                self._roll_forward(msg.roll_forward_to)
+            t = msg.t
             if msg.log_entries:
-                self.pending_rollbacks[msg.at_version] = \
-                    self._capture_rollback(msg.t)
-            for e in msg.log_entries:
-                if e.version > self.pg_log.head:
-                    self.pg_log.record(e)
+                # capture rollback info FIRST — before roll-forward/meta
+                # ops are appended to t — so the inverse covers only the
+                # data objects; log keys are unwound explicitly by
+                # _rollback
+                inv = self._capture_rollback(t)
+                self.pending_rollbacks[msg.at_version] = inv
+                kvs = {_rb_key(msg.at_version):
+                       pickle.dumps(inv.ops,
+                                    protocol=pickle.HIGHEST_PROTOCOL)}
+                for e in msg.log_entries:
+                    if e.version > self.pg_log.head:
+                        self.pg_log.record(e)
+                    kvs[_log_key(e.version)] = pickle.dumps(
+                        e, protocol=pickle.HIGHEST_PROTOCOL)
+                t.omap_setkeys(self._meta(), kvs)
+            if msg.roll_forward_to:
+                self._roll_forward(msg.roll_forward_to, txn=t)
             if msg.trim_to:
-                self.pg_log.trim(msg.trim_to)
-                self._roll_forward(msg.trim_to)
-            self.store.queue_transaction(msg.t)
+                old_tail = self.pg_log.tail
+                if self.pg_log.trim(msg.trim_to):
+                    t.omap_rmkeys(self._meta(), [
+                        _log_key(v)
+                        for v in range(old_tail + 1, msg.trim_to + 1)])
+                self._roll_forward(msg.trim_to, txn=t)
+            if msg.log_entries or msg.trim_to:
+                self._persist_vi(t)
+            self.store.queue_transaction(t)
             self.bus.send(msg.from_shard,
                           ECSubWriteReply(self.shard, msg.tid, gen=msg.gen))
         elif isinstance(msg, RollForward):
@@ -123,14 +210,33 @@ class OSDShard:
         elif isinstance(msg, PGScan):
             self.bus.send(msg.from_shard, PGScanReply(
                 self.shard, oids=sorted({g.oid for g in self.store.objects
-                                         if g.shard == self.shard})))
+                                         if g.shard == self.shard
+                                         and g.oid != PG_META})))
         elif isinstance(msg, PGLogUpdate):
             # divergent entries past the rewind point were superseded by the
             # repair's pushes: drop their rollback data without applying it
-            for v in [v for v in self.pending_rollbacks if v > msg.rewind_to]:
+            dropped_rb = [v for v in self.pending_rollbacks
+                          if v > msg.rewind_to]
+            for v in dropped_rb:
                 del self.pending_rollbacks[v]
+            pre = {_log_key(e.version) for e in self.pg_log.entries}
             self.pg_log.merge_authoritative(
                 msg.entries, msg.last_update, msg.rewind_to, msg.trim_to)
+            post = {e.version: e for e in self.pg_log.entries}
+            t = Transaction()
+            gone = sorted(pre - {_log_key(v) for v in post}) + \
+                [_rb_key(v) for v in dropped_rb]
+            if gone:
+                t.omap_rmkeys(self._meta(), gone)
+            # only the shipped segment can contain new/changed entries;
+            # surviving pre-merge keys are already on disk
+            new_kvs = {_log_key(e.version): pickle.dumps(
+                           e, protocol=pickle.HIGHEST_PROTOCOL)
+                       for e in msg.entries if post.get(e.version) == e}
+            if new_kvs:
+                t.omap_setkeys(self._meta(), new_kvs)
+            self._persist_vi(t)
+            self.store.queue_transaction(t)
         elif isinstance(msg, ECSubRead):
             reply = ECSubReadReply(self.shard, msg.tid)
             for oid, extents in msg.to_read.items():
@@ -282,7 +388,7 @@ class ECBackend:
 
     def __init__(self, ec_impl, sinfo: StripeInfo, bus: MessageBus,
                  acting: list[int], whoami: int = 0, cct=None,
-                 name: str = "", min_size: int = 0):
+                 name: str = "", min_size: int = 0, store=None):
         # `name` disambiguates observability registrations when several
         # backends (e.g. one per PG) share a Context and a primary OSD id
         n = ec_impl.get_chunk_count()
@@ -298,7 +404,7 @@ class ECBackend:
         # item 1).  Floored at k: an ack on fewer than k shards would be
         # unreadable data, which is exactly the loss the gate prevents.
         self.min_size = max(min_size or 0, ec_impl.get_data_chunk_count())
-        self.local_shard = OSDShard(whoami, bus)
+        self.local_shard = OSDShard(whoami, bus, store=store)
         bus.handlers[whoami] = self  # primary intercepts its own queue
         self.next_tid = 0
         # write pipeline (ECBackend.h:562-564)
@@ -323,18 +429,31 @@ class ECBackend:
         # them separate is what lets a revived primary detect its own
         # staleness (writes committed by the other shards while it was
         # down) and repair itself through the same query/replay machinery.
+        # On boot from a durable store, the local shard's persisted log IS
+        # the authority (the reference elects the authoritative log during
+        # peering; the primary's own is the single-primary analog) — half-
+        # applied writes it logged roll FORWARD by repairing the peers.
         self.pg_log = PGLog()
+        self.pg_log.tail = self.local_shard.pg_log.tail
+        self.pg_log.head = self.local_shard.pg_log.tail
+        for e in self.local_shard.pg_log.entries:
+            self.pg_log.record(e)
+        self.pg_log.head = max(self.pg_log.head,
+                               self.local_shard.pg_log.head)
         # two-phase commit bookkeeping: committed_to = newest version acked
         # by >= min_size shards (the roll-forward point); _rolled_forward_to
         # = the point already announced to the shards
-        self.committed_to = 0
-        self._rolled_forward_to = 0
+        self.committed_to = self.pg_log.head
+        self._rolled_forward_to = self.pg_log.head
         self._rollback_pending = 0
         # shards that revived but have not been repaired yet: excluded from
         # reads AND from write fan-out until a shard repair completes (the
         # reference keeps stale shards out of the acting set until
         # recovery/backfill, PeeringState.cc)
         self.stale: set[int] = set()
+        # boot peering (crash recovery): shard -> PGLogInfo while collecting
+        self._boot_peering: dict[int, PGLogInfo] | None = None
+        self._boot_peering_expect: set[int] = set()
         self.shard_repairs: dict[int, "ShardRepairOp"] = {}
         self._repair_write_tids: dict[int, tuple["ShardRepairOp", str]] = {}
         self._scan_waiters: dict[int, "ShardRepairOp"] = {}
@@ -444,7 +563,7 @@ class ECBackend:
         else:
             self.local_shard.handle_message(msg)
 
-    def shutdown(self) -> None:
+    def shutdown(self, checkpoint_store: bool = True) -> None:
         """Unhook from the shared Context and bus so a discarded backend is
         collectable (registration without teardown pins the backend — and
         its trackers/stores — for the context's lifetime)."""
@@ -461,6 +580,8 @@ class ECBackend:
         # no longer references this backend
         if self.bus.handlers.get(self.whoami) is self:
             self.bus.handlers[self.whoami] = self.local_shard
+        if hasattr(self.local_shard.store, "close"):
+            self.local_shard.store.close(checkpoint=checkpoint_store)
 
     # -- failure handling --------------------------------------------------
 
@@ -1237,7 +1358,96 @@ class ECBackend:
                                         since=self.pg_log.tail))
         return rop
 
+    # -- boot peering (crash recovery) -------------------------------------
+
+    def start_boot_peering(self) -> None:
+        """After a restart from durable stores, decide what survived BEFORE
+        serving: query every up peer's persisted log, adopt the best
+        (furthest-ahead witnessed) log as the authority, and roll back any
+        entry persisted on fewer than min_size shards — such a write was
+        never acked, and repairing peers toward it would mix chunk
+        versions into garbage.  This is the single-primary analog of the
+        reference's peering (PeeringState GetInfo/GetLog; authoritative-
+        log election + divergent-entry rollback)."""
+        peers = {s for s in self.acting
+                 if s != self.whoami and s not in self.bus.down}
+        if not peers:
+            return
+        self._boot_peering = {}
+        self._boot_peering_expect = peers
+        for shard in sorted(peers):
+            self.bus.send(shard, PGLogQuery(self.whoami, since=0))
+
+    def _finish_boot_peering(self) -> None:
+        infos = self._boot_peering
+        self._boot_peering = None
+        self._boot_peering_expect = set()
+        # adopt the furthest-ahead log: the primary may itself have been
+        # down while peers committed (its RAM authority died with it)
+        local = self.local_shard.pg_log
+        best_shard, best_head = self.whoami, self.pg_log.head
+        for shard, info in infos.items():
+            if info.last_update > best_head:
+                best_shard, best_head = shard, info.last_update
+        if best_shard != self.whoami:
+            binfo = infos[best_shard]
+            if binfo.tail > self.pg_log.head:
+                # our persisted log is beyond the best peer's horizon:
+                # adopt its log wholesale (the data repairs via backfill)
+                self.pg_log = PGLog()
+                self.pg_log.tail = self.pg_log.head = binfo.tail
+            for e in sorted(binfo.entries, key=lambda e: e.version):
+                if e.version > self.pg_log.head:
+                    self.pg_log.record(e)
+            self.pg_log.head = max(self.pg_log.head, binfo.last_update)
+        # witness count per version: a shard witnesses v if its log
+        # provably contains the authority's entry at v
+        auth = {e.version: e for e in self.pg_log.entries}
+        shard_logs = {self.whoami: (local.head, local.tail,
+                                    {e.version: e for e in local.entries})}
+        for shard, info in infos.items():
+            shard_logs[shard] = (info.last_update, info.tail,
+                                 {e.version: e for e in info.entries})
+
+        def witnesses(v: int) -> int:
+            n = 0
+            for head, tail, by_v in shard_logs.values():
+                if head < v:
+                    continue
+                if v > tail and by_v.get(v) != auth.get(v):
+                    continue
+                n += 1
+            return n
+
+        boundary = self.pg_log.head
+        if len(shard_logs) >= self.min_size:
+            while boundary > self.pg_log.tail and \
+                    witnesses(boundary) < self.min_size:
+                boundary -= 1
+        # roll back everything past the boundary, everywhere (FIFO-safe:
+        # nothing else is in flight during boot), then roll the kept
+        # prefix forward so stale rollback data drops
+        if boundary < self.pg_log.head:
+            for shard in sorted(self.up_shards()):
+                if shard == self.whoami:
+                    self._rollback_pending += 1
+                self.bus.send(shard, Rollback(self.whoami, boundary))
+            if self.whoami not in self.up_shards():
+                self.local_shard._rollback(boundary)
+            self.pg_log.rewind(boundary)
+            self.hinfo_cache.clear()
+        self.committed_to = boundary
+        self._rolled_forward_to = boundary
+        for shard in sorted(self.up_shards()):
+            self.bus.send(shard, RollForward(self.whoami, boundary))
+
     def handle_pg_log_info(self, info: PGLogInfo) -> None:
+        if self._boot_peering is not None and \
+                info.from_shard in self._boot_peering_expect:
+            self._boot_peering[info.from_shard] = info
+            if set(self._boot_peering) == self._boot_peering_expect:
+                self._finish_boot_peering()
+            return
         rop = self.shard_repairs.get(info.from_shard)
         if rop is None or rop.state != RepairState.QUERY:
             return
@@ -1309,7 +1519,7 @@ class ECBackend:
 
     def _local_oids(self) -> set[str]:
         return {g.oid for g in self.local_shard.store.objects
-                if g.shard == self.whoami}
+                if g.shard == self.whoami and g.oid != PG_META}
 
     def _object_exists(self, oid: str) -> bool:
         return GObject(oid, self.whoami) in self.local_shard.store.objects

@@ -71,6 +71,10 @@ class Transaction:
         self.ops.append(("omap_setkeys", obj, dict(kvs)))
         return self
 
+    def omap_rmkeys(self, obj: GObject, keys) -> "Transaction":
+        self.ops.append(("omap_rmkeys", obj, list(keys)))
+        return self
+
     def append(self, other: "Transaction") -> "Transaction":
         self.ops.extend(other.ops)
         return self
@@ -154,6 +158,11 @@ class MemStore:
         elif kind == "omap_setkeys":
             _, obj, kvs = op
             objs.setdefault(obj, _Object()).omap.update(kvs)
+        elif kind == "omap_rmkeys":
+            _, obj, keys = op
+            o = objs.setdefault(obj, _Object())
+            for key in keys:
+                o.omap.pop(key, None)
         else:
             raise ValueError(f"unknown op {kind}")
 
@@ -181,6 +190,12 @@ class MemStore:
         if o is None:
             raise FileNotFoundError(obj)
         return o.xattrs[name]
+
+    def get_omap(self, obj: GObject) -> dict[str, bytes]:
+        o = self.objects.get(obj)
+        if o is None:
+            raise FileNotFoundError(obj)
+        return dict(o.omap)
 
     def list_objects(self) -> list[GObject]:
         return sorted(self.objects, key=lambda g: (g.oid, g.shard))

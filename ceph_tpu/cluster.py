@@ -47,28 +47,47 @@ class PGGroup:
 
     def __init__(self, pgid: PG, acting: list[int], ec_impl,
                  chunk_size: int, cct, name_prefix: str,
-                 min_size: int = 0):
+                 min_size: int = 0, store_factory=None):
         self.pgid = pgid
         self.acting = acting
         self.bus = MessageBus()
         k = ec_impl.get_data_chunk_count()
         primary = acting[0]
+        mk = store_factory if store_factory is not None else lambda osd: None
         # name is unique across PGs sharing a primary AND across clusters
         # sharing a Context (salted with the cluster id)
         self.backend = ECBackend(
             ec_impl, StripeInfo(k, chunk_size), self.bus,
             acting=list(acting), whoami=primary, cct=cct,
-            name=f"{name_prefix}.pg{pgid}", min_size=min_size)
+            name=f"{name_prefix}.pg{pgid}", min_size=min_size,
+            store=mk(primary))
         for osd in acting:
             if osd != primary:
-                OSDShard(osd, self.bus)
+                OSDShard(osd, self.bus, store=mk(osd))
+
+    def shutdown(self, discard_stores: bool = False) -> None:
+        # closes the primary's store too; discard skips the final
+        # checkpoint when the directories are about to be deleted
+        self.backend.shutdown(checkpoint_store=not discard_stores)
+        for h in self.bus.handlers.values():
+            if isinstance(h, OSDShard) and h is not self.backend.local_shard \
+                    and hasattr(h.store, "close"):
+                h.store.close(checkpoint=not discard_stores)
 
 
 class MiniCluster:
     def __init__(self, n_osds: int = 12, osds_per_host: int = 3,
-                 chunk_size: int = 4096, cct: Context | None = None):
+                 chunk_size: int = 4096, cct: Context | None = None,
+                 data_dir=None):
         self.cct = cct if cct is not None else default_context()
         self.chunk_size = chunk_size
+        self.n_osds = n_osds
+        self.osds_per_host = osds_per_host
+        # durable mode: every shard store is a FileStore under
+        # data_dir/osd.<id>/pg.<pool>.<ps>/ and cluster metadata persists
+        # to cluster_meta.pkl — MiniCluster.load() reopens the whole thing
+        from pathlib import Path
+        self.data_dir = Path(data_dir) if data_dir is not None else None
         self.cluster_id = next(_cluster_ids)
         cmap = CrushMap()
         cmap.set_type_name(1, "host")
@@ -133,10 +152,79 @@ class MiniCluster:
                     f"add OSDs or shrink k+m")
             pgs[ps] = PGGroup(pgid, acting, ec, self.chunk_size, self.cct,
                               name_prefix=f"c{self.cluster_id}",
-                              min_size=pool.min_size)
+                              min_size=pool.min_size,
+                              store_factory=self._store_factory(pool_id, ps))
         self.pools[pool_id] = {"pool": pool, "pgs": pgs, "ec": ec}
         self.pool_ids[name] = pool_id
+        self._save_meta()
         return pool_id
+
+    # -- durability (data_dir mode) ----------------------------------------
+
+    def _store_factory(self, pool_id: int, ps: int):
+        if self.data_dir is None:
+            return None
+        from .backend.filestore import FileStore
+
+        def factory(osd, _pid=pool_id, _ps=ps):
+            return FileStore(self.data_dir / f"osd.{osd}" / f"pg.{_pid}.{_ps}")
+        return factory
+
+    def _save_meta(self) -> None:
+        """Persist what cannot be rebuilt from the shard stores: the pool
+        definitions (the mon's role; object bookkeeping is rediscovered
+        from the primaries' stores at load)."""
+        if self.data_dir is None:
+            return
+        import os
+        import pickle
+        self.data_dir.mkdir(parents=True, exist_ok=True)
+        meta = {
+            "n_osds": self.n_osds,
+            "osds_per_host": self.osds_per_host,
+            "chunk_size": self.chunk_size,
+            "pools": [(p["pool"].name, dict(p["pool"].params),
+                       p["pool"].pg_num)
+                      for _, p in sorted(self.pools.items())],
+        }
+        tmp = self.data_dir / "cluster_meta.pkl.tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(meta, f, protocol=pickle.HIGHEST_PROTOCOL)
+        os.replace(tmp, self.data_dir / "cluster_meta.pkl")
+
+    @classmethod
+    def load(cls, data_dir, cct: Context | None = None) -> "MiniCluster":
+        """Reopen a durable cluster: rebuild the maps from the persisted
+        pool definitions (deterministic CRUSH -> identical placements),
+        reopen every shard's FileStore, replay PG logs (OSDShard boot),
+        and run a boot-time repair pass so any shard that restarted stale
+        catches up through the ordinary log path before serving."""
+        import pickle
+        from pathlib import Path
+        with open(Path(data_dir) / "cluster_meta.pkl", "rb") as f:
+            meta = pickle.load(f)
+        c = cls(n_osds=meta["n_osds"], osds_per_host=meta["osds_per_host"],
+                chunk_size=meta["chunk_size"], cct=cct, data_dir=data_dir)
+        for name, params, pg_num in meta["pools"]:
+            c.create_ec_pool(name, params, pg_num)
+        for pid, pool in c.pools.items():
+            for g in pool["pgs"].values():
+                # crash recovery first: elect the authoritative log and
+                # roll back any write persisted on < min_size shards (it
+                # was never acked); only then repair stale shards
+                g.backend.start_boot_peering()
+                g.bus.deliver_all()
+                c.objects.setdefault(pid, set()).update(
+                    g.backend._local_oids())
+                for osd in g.acting:
+                    if osd != g.backend.whoami:
+                        g.backend.start_shard_repair(osd)
+                # the primary itself may have restarted stale (peering
+                # adopted a peer's log): repair its own shard too
+                if g.backend.local_shard.pg_log.head < g.backend.pg_log.head:
+                    g.backend.start_shard_repair(g.backend.whoami)
+                g.bus.deliver_all()
+        return c
 
     # -- object placement (librados object_locator -> pg) ------------------
 
@@ -198,10 +286,11 @@ class MiniCluster:
 
     def shutdown(self) -> None:
         """Unhook every PG backend from the (possibly shared) Context so a
-        discarded cluster is collectable and does not shadow later ones."""
+        discarded cluster is collectable and does not shadow later ones;
+        durable stores checkpoint and close."""
         for p in self.pools.values():
             for g in p["pgs"].values():
-                g.backend.shutdown()
+                g.shutdown()
 
     # -- control plane -----------------------------------------------------
 
@@ -233,9 +322,10 @@ class MiniCluster:
         reads reconstruct), re-encode into a fresh group (the reference's
         backfill)."""
         old = self.pools[pool_id]["pgs"][ps]
-        new = PGGroup(PG(pool_id, ps), new_acting, ec, self.chunk_size,
-                      self.cct, name_prefix=f"c{self.cluster_id}e"
-                                            f"{self.osdmap.epoch}")
+        # read everything out of the old layout FIRST: in durable mode the
+        # new group reopens the same per-(osd, pg) directories, so the old
+        # stores must be drained and closed before the new ones open
+        contents: dict[str, bytes] = {}
         for oid in self._pg_objects(pool_id, old):
             size = old.backend.object_size(oid)
             out = {}
@@ -246,10 +336,22 @@ class MiniCluster:
             old.bus.deliver_all()
             if out.get("errors"):
                 raise IOError(f"backfill read of {oid}: {out['errors']}")
-            data = out["result"][oid][0][2]
+            contents[oid] = out["result"][oid][0][2]
+        old.shutdown(discard_stores=self.data_dir is not None)
+        if self.data_dir is not None:
+            import shutil
+            for osd in old.acting:
+                shutil.rmtree(
+                    self.data_dir / f"osd.{osd}" / f"pg.{pool_id}.{ps}",
+                    ignore_errors=True)
+        new = PGGroup(PG(pool_id, ps), new_acting, ec, self.chunk_size,
+                      self.cct, name_prefix=f"c{self.cluster_id}e"
+                                            f"{self.osdmap.epoch}",
+                      min_size=self.pools[pool_id]["pool"].min_size,
+                      store_factory=self._store_factory(pool_id, ps))
+        for oid, data in contents.items():
             new.backend.submit_transaction(PGTransaction().write(oid, 0, data))
             new.bus.deliver_all()
-        old.backend.shutdown()
         self.pools[pool_id]["pgs"][ps] = new
 
     def attach_monitor(self):
