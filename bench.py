@@ -78,16 +78,18 @@ def main() -> int:
     n = stripe_bytes // k                      # 128 KiB chunks
     batch = 64                                 # stripes per dispatch
     rng = np.random.default_rng(0)
-    data = rng.integers(0, 256, size=(k, batch * n), dtype=np.uint8)
+    # device-native VERTICAL batch layout: stripe s = rows [s*k, (s+1)*k)
+    # (tall blocks feed full MXU tiles; see rs_kernels.gf_apply_stripes)
+    data = rng.integers(0, 256, size=(batch * k, n), dtype=np.uint8)
 
     codec = RSCodec(k, m, technique="cauchy", device="jax")
     dev = jax.device_put(jnp.asarray(data))
     pmat = jax.device_put(jnp.asarray(codec.parity_mat))
 
     def apply_auto(M, D):
-        return rs_kernels.gf_apply(M, D, "auto")
+        return rs_kernels.gf_apply_stripes(M, D, batch)
 
-    # encode: [k, B*N] -> [m, B*N]
+    # encode: [B*k, N] -> [B*m, N]
     enc_t = per_op_seconds(apply_auto, pmat, dev)
     enc_mibs = batch * (stripe_bytes / 2**20) / enc_t
 
@@ -105,7 +107,7 @@ def main() -> int:
     # CPU baseline: the native SIMD codec (GFNI/AVX-512 or AVX2 pshufb),
     # same 1 MiB stripe through the plugin path like the reference's
     # ceph_erasure_code_benchmark measures its isa/jerasure plugins
-    cdata = np.ascontiguousarray(data[:, :n])
+    cdata = np.ascontiguousarray(data[:k, :n])
     cpu_kind = "numpy"
     try:
         from ceph_tpu.native import NativeRegistry

@@ -8,7 +8,10 @@ encode/decode is one batched device call across all stripes of an op.
 from .ecutil import HINFO_KEY, HashInfo, StripeInfo, crc32c, decode, decode_shards, encode
 from .extent import ExtentSet
 from .extent_cache import ExtentCache
-from .ec_backend import ECBackend, OSDShard, RecoveryState, make_cluster
+from .ec_backend import ECBackend, make_cluster
+from .pg_backend import OSDShard, PGBackend, RecoveryState
+from .replicated import ReplicatedBackend, make_replicated_cluster
+from .filestore import FileStore
 from .memstore import GObject, MemStore, Transaction
 from .messages import (ECSubRead, ECSubReadReply, ECSubWrite, ECSubWriteReply,
                        MessageBus, PushOp, PushReply)
@@ -16,7 +19,8 @@ from .transaction import ObjectOperation, PGTransaction, WritePlan, get_write_pl
 
 __all__ = [
     "HINFO_KEY", "HashInfo", "StripeInfo", "crc32c", "decode", "decode_shards",
-    "encode", "ExtentSet", "ExtentCache", "ECBackend", "OSDShard",
+    "encode", "ExtentSet", "ExtentCache", "ECBackend", "PGBackend",
+    "ReplicatedBackend", "make_replicated_cluster", "FileStore", "OSDShard",
     "RecoveryState", "make_cluster", "GObject", "MemStore", "Transaction",
     "ECSubRead", "ECSubReadReply", "ECSubWrite", "ECSubWriteReply",
     "MessageBus", "PushOp", "PushReply", "ObjectOperation", "PGTransaction",

@@ -1,0 +1,237 @@
+"""ReplicatedBackend: full-copy pools behind the PGBackend abstraction.
+
+Analog of the reference's ``ReplicatedBackend`` (reference:
+src/osd/ReplicatedBackend.cc, 2392 LoC; the other concrete PGBackend next
+to ECBackend, src/osd/PGBackend.h:628).  Semantics mirrored:
+
+- the primary applies each client transaction to its own full copy and
+  fans the SAME transaction to every replica (``issue_op`` /
+  ``submit_transaction`` — each replica holds identical whole objects);
+- writes ack only after min_size copies are durable — inherited from
+  :class:`~ceph_tpu.backend.pg_backend.PGBackend`'s gate, with the
+  replicated default min_size = floor(size/2)+1;
+- reads are served from the primary's copy (the reference reads locally on
+  the primary, PrimaryLogPG::do_op); a non-current primary pulls from a
+  current replica instead;
+- recovery pushes whole-object copies from any current source
+  (``prep_push``/``handle_pull`` shape);
+- deep scrub compares each replica's bytes against the primary's
+  (be_deep_scrub comparing object digests across replicas).
+
+A per-object ``version`` xattr (the role object_info_t::version plays,
+reference: src/osd/osd_types.h object_info_t) travels with every write and
+push so scrub can tell a stale copy from a clean one even when sizes match.
+"""
+from __future__ import annotations
+
+from .memstore import GObject, Transaction
+from .messages import ECSubRead, ECSubReadReply, MessageBus
+from .pg_backend import Op, OSDShard, PGBackend, RecoveryOp
+from ..osd.pg_log import OP_DELETE, OP_MODIFY
+
+VERSION_KEY = "_version"      # object_info_t::version analog
+
+
+class ReplicatedBackend(PGBackend):
+    """Primary-side replicated backend over full-copy shard OSDs."""
+
+    def __init__(self, size: int, bus: MessageBus, acting: list[int],
+                 whoami: int = 0, cct=None, name: str = "",
+                 min_size: int = 0, store=None):
+        assert len(acting) == size, f"acting set must have {size} shards"
+        self.size = size
+        super().__init__(bus, acting, whoami=whoami, cct=cct, name=name,
+                         min_size=min_size or size // 2 + 1,
+                         min_size_floor=1, store=store,
+                         perf_prefix="replicated_backend")
+        # remote whole-object reads in flight (non-current primary)
+        self._remote_read_tids: dict[int, dict] = {}
+
+    # -- metadata ------------------------------------------------------------
+
+    def object_size(self, oid: str) -> int:
+        try:
+            return self.local_shard.store.stat(GObject(oid, self.whoami))
+        except FileNotFoundError:
+            return 0
+
+    def _object_version(self, oid: str) -> int:
+        try:
+            return self.local_shard.store.getattr(
+                GObject(oid, self.whoami), VERSION_KEY)
+        except (FileNotFoundError, KeyError):
+            return 0
+
+    # -- write pipeline hooks ------------------------------------------------
+
+    def _generate_transactions(self, op: Op):
+        """Each acting shard gets the same whole-object mutation — the
+        replica transactions ReplicatedBackend::issue_op ships."""
+        shard_txns = {shard: Transaction() for shard in self.acting}
+        log_entries = []
+        for oid, objop in op.t.ops.items():
+            is_delete = (objop.delete_first and not objop.buffer_updates
+                         and objop.truncate is None)
+            entry = self.pg_log.append(
+                oid, OP_DELETE if is_delete else OP_MODIFY)
+            log_entries.append(entry)
+            for shard in self.acting:
+                obj = GObject(oid, shard)
+                t = shard_txns[shard]
+                if objop.delete_first:
+                    t.remove(obj)
+                if objop.truncate is not None:
+                    t.truncate(obj, objop.truncate[0])
+                for w_off, data in objop.buffer_updates:
+                    t.write(obj, w_off, data)
+                if not is_delete:
+                    t.setattr(obj, VERSION_KEY, entry.version)
+            self.perf.inc("stripe_bytes_encoded", sum(
+                len(d) for _, d in objop.buffer_updates))
+        return shard_txns, log_entries
+
+    # -- read path -----------------------------------------------------------
+
+    def objects_read_and_reconstruct(self, reads, on_complete,
+                                     fast_read: bool = False) -> int:
+        """Read extents per object.  The primary serves from its own full
+        copy when current (the reference's primary-local read path); a
+        stale/down primary pulls from a current replica.  Same signature
+        as the EC backend so callers are pool-type agnostic."""
+        self.next_tid += 1
+        tid = self.next_tid
+        if self.whoami in self.current_shards():
+            result, errors = self._read_local(reads)
+            on_complete(result, errors)
+            return tid
+        sources = sorted(self.current_shards())
+        if not sources:
+            on_complete({}, {oid: -5 for oid in reads})   # EIO: inactive
+            return tid
+        src = sources[0]
+        self._remote_read_tids[tid] = {"reads": dict(reads),
+                                       "on_complete": on_complete,
+                                       "source": src}
+        self.bus.send(src, ECSubRead(
+            self.whoami, tid,
+            {oid: [(0, None)] for oid in reads}))
+        return tid
+
+    def _read_local(self, reads):
+        result: dict[str, list[tuple[int, int, bytes]]] = {}
+        errors: dict[str, int] = {}
+        store = self.local_shard.store
+        for oid, extents in reads.items():
+            obj = GObject(oid, self.whoami)
+            try:
+                out = []
+                for off, length in extents:
+                    out.append((off, length, store.read(obj, off, length)))
+                result[oid] = out
+            except FileNotFoundError:
+                errors[oid] = -2      # ENOENT
+        if result:
+            self.perf.inc("reads")
+        if errors:
+            self.perf.inc("read_errors", len(errors))
+        self.perf.inc("read_bytes", sum(
+            len(seg) for segs in result.values() for _, _, seg in segs))
+        return result, errors
+
+    def _handle_other_read_reply(self, reply: ECSubReadReply) -> None:
+        ctx = self._remote_read_tids.pop(reply.tid, None)
+        if ctx is None:
+            return
+        result: dict[str, list[tuple[int, int, bytes]]] = {}
+        errors: dict[str, int] = dict(reply.errors)
+        for oid, extents in ctx["reads"].items():
+            if oid in errors:
+                continue
+            bufs = reply.buffers_read.get(oid)
+            if bufs is None:
+                errors[oid] = -5
+                continue
+            whole = b"".join(b for _, b in bufs)
+            result[oid] = [(off, length,
+                            whole[off:off + length if length is not None
+                                  else None])
+                           for off, length in extents]
+        if result:
+            self.perf.inc("reads")
+        if errors:
+            self.perf.inc("read_errors", len(errors))
+        self.perf.inc("read_bytes", sum(
+            len(seg) for segs in result.values() for _, _, seg in segs))
+        ctx["on_complete"](result, errors)
+
+    def _on_shard_down_reads(self, shard: int, chunk: int) -> None:
+        # remote reads addressed to a dying source: retry elsewhere
+        for tid, ctx in list(self._remote_read_tids.items()):
+            if ctx["source"] == shard:
+                del self._remote_read_tids[tid]
+                self.objects_read_and_reconstruct(ctx["reads"],
+                                                  ctx["on_complete"])
+
+    # -- recovery hooks ------------------------------------------------------
+
+    def is_recoverable(self, oid: str, missing: set[int]) -> bool:
+        """Recoverable iff any current shard outside the missing set can
+        supply a full copy (MissingLoc::readable_with_acting shape)."""
+        return any(c not in missing
+                   for c, s in enumerate(self.acting)
+                   if s in self.current_shards())
+
+    def _recovery_issue_reads(self, rop: RecoveryOp) -> None:
+        sources = [c for c, s in enumerate(self.acting)
+                   if s in self.current_shards()
+                   and c not in rop.missing_shards]
+        if not sources:
+            raise IOError("no current source for replicated recovery")
+        src_shard = self.acting[sources[0]]
+        rop._pending = {src_shard}
+        self.bus.send(src_shard, ECSubRead(
+            self.whoami, rop.read_tid,
+            {rop.oid: [(0, None)]}, attrs_to_read={VERSION_KEY}))
+
+    def _recovery_push_payloads(self, rop: RecoveryOp):
+        (data,) = rop._read_results.values()
+        attrs = next(iter(rop._read_attrs.values()), {}) or {}
+        return {chunk: (data, dict(attrs)) for chunk in rop.missing_shards}
+
+    # -- deep scrub ----------------------------------------------------------
+
+    def be_deep_scrub(self, oid: str) -> dict[int, bool]:
+        """Compare every up replica's bytes and version against the
+        primary's copy (the authority); True = clean."""
+        try:
+            want = self.local_shard.store.read(GObject(oid, self.whoami))
+            want_v = self._object_version(oid)
+        except FileNotFoundError:
+            want, want_v = None, None
+        out: dict[int, bool] = {}
+        for chunk, shard in enumerate(self.acting):
+            if shard in self.bus.down:
+                continue
+            handler = self.bus.handlers[shard]
+            store = handler.store if isinstance(handler, OSDShard) else \
+                handler.local_shard.store
+            obj = GObject(oid, shard)
+            try:
+                data = store.read(obj)
+                version = store.getattr(obj, VERSION_KEY)
+            except (FileNotFoundError, KeyError):
+                out[chunk] = want is None
+                continue
+            out[chunk] = (want is not None and data == want
+                          and version == want_v)
+        return out
+
+
+def make_replicated_cluster(size: int = 3, cct=None):
+    """Primary + replica OSDs on one bus; returns (backend, bus)."""
+    bus = MessageBus()
+    backend = ReplicatedBackend(size, bus, acting=list(range(size)),
+                                whoami=0, cct=cct)
+    for shard in range(1, size):
+        OSDShard(shard, bus)
+    return backend, bus

@@ -20,12 +20,15 @@ from __future__ import annotations
 
 import numpy as np
 
-from .backend import ECBackend, MessageBus, PGTransaction, StripeInfo
+from .backend import (ECBackend, MessageBus, PGTransaction, ReplicatedBackend,
+                      StripeInfo)
 from .backend.ec_backend import OSDShard
 from .common import Context, default_context
-from .crush import (CRUSH_BUCKET_STRAW2, CRUSH_RULE_CHOOSELEAF_INDEP,
+from .crush import (CRUSH_BUCKET_STRAW2, CRUSH_RULE_CHOOSELEAF_FIRSTN,
+                    CRUSH_RULE_CHOOSELEAF_INDEP,
                     CRUSH_RULE_EMIT, CRUSH_RULE_TAKE, CrushMap)
-from .osdmap import (OSDMap, PG, Pool, POOL_TYPE_ERASURE, ceph_stable_mod)
+from .osdmap import (OSDMap, PG, Pool, POOL_TYPE_ERASURE,
+                     POOL_TYPE_REPLICATED, ceph_stable_mod)
 from .osdmap.str_hash import ceph_str_hash_rjenkins
 from .plugins.registry import ErasureCodePluginRegistry
 
@@ -51,16 +54,22 @@ class PGGroup:
         self.pgid = pgid
         self.acting = acting
         self.bus = MessageBus()
-        k = ec_impl.get_data_chunk_count()
         primary = acting[0]
         mk = store_factory if store_factory is not None else lambda osd: None
         # name is unique across PGs sharing a primary AND across clusters
         # sharing a Context (salted with the cluster id)
-        self.backend = ECBackend(
-            ec_impl, StripeInfo(k, chunk_size), self.bus,
-            acting=list(acting), whoami=primary, cct=cct,
-            name=f"{name_prefix}.pg{pgid}", min_size=min_size,
-            store=mk(primary))
+        if ec_impl is None:       # replicated pool: full copies, no codec
+            self.backend = ReplicatedBackend(
+                len(acting), self.bus, acting=list(acting), whoami=primary,
+                cct=cct, name=f"{name_prefix}.pg{pgid}", min_size=min_size,
+                store=mk(primary))
+        else:
+            k = ec_impl.get_data_chunk_count()
+            self.backend = ECBackend(
+                ec_impl, StripeInfo(k, chunk_size), self.bus,
+                acting=list(acting), whoami=primary, cct=cct,
+                name=f"{name_prefix}.pg{pgid}", min_size=min_size,
+                store=mk(primary))
         for osd in acting:
             if osd != primary:
                 OSDShard(osd, self.bus, store=mk(osd))
@@ -140,24 +149,47 @@ class MiniCluster:
                     erasure_code_profile=" ".join(
                         f"{k}={v}" for k, v in sorted(profile.items())),
                     params=dict(profile))
-        self.osdmap.add_pool(pool)
+        return self._instantiate_pool(pool, name, ec)
 
+    def create_replicated_pool(self, name: str, size: int = 3,
+                               pg_num: int = 8) -> int:
+        """Replicated pool: ``size`` full copies, min_size = size//2 + 1
+        (the mon's defaults for ``osd pool create ... replicated``);
+        CRUSH chooses hosts firstn the way replicated rules do."""
+        root = self.osdmap.crush.item_id("default")
+        n_hosts = sum(1 for b in self.osdmap.crush.buckets.values()
+                      if b.type == 1)
+        ftype = 1 if n_hosts >= size else 0
+        ruleno = self.osdmap.crush.add_rule(
+            [(CRUSH_RULE_TAKE, root, 0),
+             (CRUSH_RULE_CHOOSELEAF_FIRSTN, size, ftype),
+             (CRUSH_RULE_EMIT, 0, 0)])
+        pool_id = self._next_pool
+        self._next_pool += 1
+        pool = Pool(pool_id=pool_id, type=POOL_TYPE_REPLICATED, size=size,
+                    min_size=size // 2 + 1, pg_num=pg_num,
+                    crush_rule=ruleno, name=name, params={"size": str(size)})
+        return self._instantiate_pool(pool, name, None)
+
+    def _instantiate_pool(self, pool: Pool, name: str, ec) -> int:
+        self.osdmap.add_pool(pool)
         pgs = {}
-        for ps in range(pg_num):
-            pgid = PG(pool_id, ps)
+        for ps in range(pool.pg_num):
+            pgid = PG(pool.pool_id, ps)
             up, up_primary, acting, _ = self.osdmap.pg_to_up_acting_osds(pgid)
             if not acting or any(a == 0x7FFFFFFF for a in acting):
                 raise RuntimeError(
                     f"pg {pgid} not fully mapped (acting={acting}); "
-                    f"add OSDs or shrink k+m")
+                    f"add OSDs or shrink the pool size")
             pgs[ps] = PGGroup(pgid, acting, ec, self.chunk_size, self.cct,
                               name_prefix=f"c{self.cluster_id}",
                               min_size=pool.min_size,
-                              store_factory=self._store_factory(pool_id, ps))
-        self.pools[pool_id] = {"pool": pool, "pgs": pgs, "ec": ec}
-        self.pool_ids[name] = pool_id
+                              store_factory=self._store_factory(
+                                  pool.pool_id, ps))
+        self.pools[pool.pool_id] = {"pool": pool, "pgs": pgs, "ec": ec}
+        self.pool_ids[name] = pool.pool_id
         self._save_meta()
-        return pool_id
+        return pool.pool_id
 
     # -- durability (data_dir mode) ----------------------------------------
 
@@ -183,8 +215,11 @@ class MiniCluster:
             "n_osds": self.n_osds,
             "osds_per_host": self.osds_per_host,
             "chunk_size": self.chunk_size,
-            "pools": [(p["pool"].name, dict(p["pool"].params),
-                       p["pool"].pg_num)
+            "pools": [{"name": p["pool"].name,
+                       "type": p["pool"].type,
+                       "size": p["pool"].size,
+                       "params": dict(p["pool"].params),
+                       "pg_num": p["pool"].pg_num}
                       for _, p in sorted(self.pools.items())],
         }
         tmp = self.data_dir / "cluster_meta.pkl.tmp"
@@ -205,8 +240,11 @@ class MiniCluster:
             meta = pickle.load(f)
         c = cls(n_osds=meta["n_osds"], osds_per_host=meta["osds_per_host"],
                 chunk_size=meta["chunk_size"], cct=cct, data_dir=data_dir)
-        for name, params, pg_num in meta["pools"]:
-            c.create_ec_pool(name, params, pg_num)
+        for p in meta["pools"]:
+            if p["type"] == POOL_TYPE_REPLICATED:
+                c.create_replicated_pool(p["name"], p["size"], p["pg_num"])
+            else:
+                c.create_ec_pool(p["name"], p["params"], p["pg_num"])
         for pid, pool in c.pools.items():
             for g in pool["pgs"].values():
                 # crash recovery first: elect the authoritative log and
@@ -247,8 +285,8 @@ class MiniCluster:
         client op on an inactive reference PG.  ``on_commit`` fires when
         (possibly much later) the write is durable on min_size shards."""
         g = self.pg_group(pool_id, oid)
-        sw = g.backend.sinfo.stripe_width
-        pad = (-len(data)) % sw
+        sinfo = getattr(g.backend, "sinfo", None)
+        pad = (-len(data)) % sinfo.stripe_width if sinfo is not None else 0
         done: list[int] = []
 
         def _committed(tid):

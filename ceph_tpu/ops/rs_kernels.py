@@ -117,6 +117,31 @@ def _runs_on_tpu(data) -> bool:
         return False
 
 
+def gf_apply_stripes(mat, data, stripes: int, variant: str = "auto"):
+    """Batched GF apply over the VERTICAL stripe layout: data
+    [stripes*k, Nc] -> [stripes*r, Nc] (stripe s = rows [s*k, (s+1)*k)).
+
+    This is the codec's device-native batch layout (stripes stack as rows,
+    a no-copy append for the IO path) and the fast path on TPU: tall
+    blocks + block-diagonal int8 MXU matmuls (see
+    pallas_kernels.gf_apply_stripes_pallas).  Off-TPU it folds back to the
+    horizontal layout and reuses the XLA paths.
+    """
+    mat = jnp.asarray(mat, dtype=jnp.uint8)
+    data = jnp.asarray(data, dtype=jnp.uint8)
+    r, k = mat.shape
+    rows, n = data.shape
+    assert rows == stripes * k
+    if variant in ("auto", "pallas") and _runs_on_tpu(data) and n >= 1024:
+        from .pallas_kernels import gf_apply_stripes_pallas
+        return gf_apply_stripes_pallas(mat, data, stripes)
+    # fallback: [S*k, N] -> [k, S*N] -> gf_apply -> [S*r, N]
+    folded = data.reshape(stripes, k, n).transpose(1, 0, 2).reshape(k, -1)
+    out = gf_apply(mat, folded, variant)
+    return out.reshape(r, stripes, n).transpose(1, 0, 2).reshape(
+        stripes * r, n)
+
+
 def gf_apply(mat, data, variant: str = "auto"):
     """Apply a GF(2^8) matrix to chunk data on the device.
 

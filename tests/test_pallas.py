@@ -7,7 +7,40 @@ import pytest
 from ceph_tpu.gf import matrix as gfm
 from ceph_tpu.ops import rs_kernels
 from ceph_tpu.ops.pallas_kernels import (expand_bits_plane_major,
-                                         gf_apply_pallas)
+                                         gf_apply_pallas,
+                                         gf_apply_stripes_pallas)
+
+
+@pytest.mark.parametrize("r,k,S,n,groups,tile", [
+    (4, 8, 8, 1024, 4, 512),     # even groups
+    (4, 8, 6, 1024, 4, 512),     # stripe count not a group multiple
+    (2, 4, 3, 700, 4, 256),      # ragged columns + groups > stripes
+    (4, 8, 1, 512, 4, 512),      # single stripe
+])
+def test_stripes_kernel_matches_field_math(r, k, S, n, groups, tile):
+    """Vertical layout: stripe s = rows [s*k, (s+1)*k); parity at
+    [s*r, (s+1)*r).  Bit-exact vs per-stripe host math."""
+    rng = np.random.default_rng(r * 1000 + S)
+    mat = rng.integers(0, 256, size=(r, k), dtype=np.uint8)
+    data = rng.integers(0, 256, size=(S * k, n), dtype=np.uint8)
+    got = np.asarray(gf_apply_stripes_pallas(
+        mat, data, S, groups=groups, tile_n=tile, interpret=True))
+    assert got.shape == (S * r, n)
+    for s in range(S):
+        want = gfm.gf_matmul(mat, data[s * k:(s + 1) * k])
+        assert np.array_equal(got[s * r:(s + 1) * r], want), f"stripe {s}"
+
+
+def test_stripes_dispatch_fallback_matches():
+    """rs_kernels.gf_apply_stripes off-TPU folds to the XLA path and must
+    agree with the interpret-mode pallas kernel."""
+    rng = np.random.default_rng(4)
+    mat = rng.integers(0, 256, size=(4, 8), dtype=np.uint8)
+    data = rng.integers(0, 256, size=(5 * 8, 512), dtype=np.uint8)
+    a = np.asarray(rs_kernels.gf_apply_stripes(mat, data, 5))
+    b = np.asarray(gf_apply_stripes_pallas(mat, data, 5, tile_n=256,
+                                           interpret=True))
+    assert np.array_equal(a, b)
 
 
 @pytest.mark.parametrize("r,k,n,tile", [

@@ -1,0 +1,257 @@
+"""ReplicatedBackend behind the PGBackend abstraction.
+
+Mirrors the reference's ReplicatedBackend semantics (reference:
+src/osd/ReplicatedBackend.cc behind src/osd/PGBackend.h:628): full-copy
+fan-out, min_size = size//2+1 acks, whole-object recovery pushes, replica
+deep scrub against the primary's copy — plus the same availability /
+rollback / stale-shard machinery the EC backend inherits from PGBackend,
+exercised by a replicated thrash campaign with kills past min_size.
+"""
+import numpy as np
+import pytest
+
+from ceph_tpu.backend import GObject, PGTransaction, Transaction
+from ceph_tpu.backend.pg_backend import OSDShard, RecoveryState, RepairState
+from ceph_tpu.backend.replicated import (ReplicatedBackend, VERSION_KEY,
+                                         make_replicated_cluster)
+from ceph_tpu.cluster import MiniCluster
+
+SIZE = 3
+
+
+def payload(n, seed=7):
+    return np.random.default_rng(seed).integers(
+        0, 256, size=n, dtype=np.uint8).tobytes()
+
+
+def store_of(bus, backend, shard):
+    h = bus.handlers[shard]
+    return h.store if isinstance(h, OSDShard) else h.local_shard.store
+
+
+def read_obj(backend, bus, oid, length):
+    out = {}
+    backend.objects_read_and_reconstruct(
+        {oid: [(0, length)]},
+        lambda result, errors: out.update(result=result, errors=errors))
+    bus.deliver_all()
+    if out.get("errors"):
+        raise IOError(out["errors"])
+    return out["result"][oid][0][2]
+
+
+@pytest.fixture()
+def cluster():
+    return make_replicated_cluster(SIZE)
+
+
+class TestReplicatedBasics:
+    def test_write_replicates_to_all(self, cluster):
+        backend, bus = cluster
+        data = payload(1000)
+        done = []
+        backend.submit_transaction(PGTransaction().write("a", 0, data),
+                                   on_commit=done.append)
+        bus.deliver_all()
+        assert done
+        for s in range(SIZE):
+            assert store_of(bus, backend, s).read(GObject("a", s)) == data
+
+    def test_partial_overwrite(self, cluster):
+        backend, bus = cluster
+        backend.submit_transaction(PGTransaction().write("a", 0, b"x" * 100))
+        bus.deliver_all()
+        backend.submit_transaction(PGTransaction().write("a", 10, b"y" * 5))
+        bus.deliver_all()
+        want = b"x" * 10 + b"y" * 5 + b"x" * 85
+        assert read_obj(backend, bus, "a", 100) == want
+        for s in range(SIZE):
+            assert store_of(bus, backend, s).read(GObject("a", s)) == want
+
+    def test_delete_and_truncate(self, cluster):
+        backend, bus = cluster
+        backend.submit_transaction(PGTransaction().write("a", 0, b"z" * 64))
+        backend.submit_transaction(PGTransaction().truncate_to("a", 10))
+        backend.submit_transaction(PGTransaction().write("b", 0, b"w" * 8))
+        backend.submit_transaction(PGTransaction().delete("b"))
+        bus.deliver_all()
+        assert read_obj(backend, bus, "a", 10) == b"z" * 10
+        assert backend.object_size("a") == 10
+        for s in range(SIZE):
+            assert not store_of(bus, backend, s).exists(GObject("b", s))
+
+    def test_version_xattr_tracks_log(self, cluster):
+        backend, bus = cluster
+        backend.submit_transaction(PGTransaction().write("a", 0, b"1"))
+        backend.submit_transaction(PGTransaction().write("a", 0, b"2"))
+        bus.deliver_all()
+        for s in range(SIZE):
+            v = store_of(bus, backend, s).getattr(GObject("a", s),
+                                                  VERSION_KEY)
+            assert v == backend.pg_log.last_version_of("a")
+
+    def test_min_size_gate(self, cluster):
+        backend, bus = cluster           # size 3 -> min_size 2
+        committed = []
+        bus.mark_down(1)
+        bus.mark_down(2)                 # 1 current < 2
+        assert not backend.is_active()
+        backend.submit_transaction(PGTransaction().write("a", 0, b"x" * 16),
+                                   on_commit=committed.append)
+        bus.deliver_all()
+        assert not committed
+        bus.mark_up(1)                   # auto-repair -> active again
+        bus.deliver_all()
+        assert committed
+        assert read_obj(backend, bus, "a", 16) == b"x" * 16
+
+    def test_recovery_pushes_full_copy(self, cluster):
+        backend, bus = cluster
+        data = payload(500)
+        backend.submit_transaction(PGTransaction().write("a", 0, data))
+        bus.deliver_all()
+        lost = GObject("a", 2)
+        store_of(bus, backend, 2).queue_transaction(Transaction().remove(lost))
+        rop = backend.recover_object("a", {2})
+        bus.deliver_all()
+        assert rop.state == RecoveryState.COMPLETE
+        assert store_of(bus, backend, 2).read(lost) == data
+        assert store_of(bus, backend, 2).getattr(lost, VERSION_KEY) == \
+            backend.pg_log.last_version_of("a")
+
+    def test_deep_scrub_detects_bitrot(self, cluster):
+        backend, bus = cluster
+        backend.submit_transaction(PGTransaction().write("a", 0, b"q" * 64))
+        bus.deliver_all()
+        assert all(backend.be_deep_scrub("a").values())
+        bad = store_of(bus, backend, 1)
+        bad.queue_transaction(Transaction().write(GObject("a", 1), 5, b"!"))
+        report = backend.be_deep_scrub("a")
+        assert report[1] is False and report[0] and report[2]
+
+    def test_stale_replica_repairs_via_log(self, cluster):
+        backend, bus = cluster
+        backend.submit_transaction(PGTransaction().write("a", 0, b"1" * 32))
+        bus.deliver_all()
+        bus.mark_down(2)
+        backend.submit_transaction(PGTransaction().write("a", 0, b"2" * 32))
+        backend.submit_transaction(PGTransaction().write("b", 0, b"3" * 32))
+        bus.deliver_all()
+        bus.mark_up(2)                   # auto-repair replays the 2 writes
+        bus.deliver_all()
+        assert 2 not in backend.stale
+        for oid in ("a", "b"):
+            assert all(backend.be_deep_scrub(oid).values()), oid
+
+
+class TestReplicatedCluster:
+    def test_pool_via_crush(self):
+        c = MiniCluster(n_osds=12, chunk_size=256)
+        pid = c.create_replicated_pool("rep", size=3, pg_num=8)
+        data = {f"o{i}": payload(777, seed=i) for i in range(20)}
+        for oid, d in data.items():
+            c.put(pid, oid, d)
+        for oid, d in sorted(data.items()):
+            assert c.get(pid, oid, len(d)) == d
+        # every PG has 3 distinct OSDs from distinct hosts
+        for g in c.pools[pid]["pgs"].values():
+            assert len(set(g.acting)) == 3
+            hosts = {o // 3 for o in g.acting}
+            assert len(hosts) == 3
+
+    def test_ec_and_replicated_pools_coexist(self):
+        c = MiniCluster(n_osds=12, chunk_size=256)
+        rp = c.create_replicated_pool("rep", size=3, pg_num=4)
+        ep = c.create_ec_pool("ec", {"plugin": "jax_rs", "k": "4", "m": "2",
+                                     "device": "numpy"}, pg_num=4)
+        c.put(rp, "same-name", payload(512, seed=1))
+        c.put(ep, "same-name", payload(2048, seed=2))
+        assert c.get(rp, "same-name", 512) == payload(512, seed=1)
+        assert c.get(ep, "same-name", 2048) == payload(2048, seed=2)
+
+    def test_replicated_pool_survives_restart(self, tmp_path):
+        c1 = MiniCluster(n_osds=12, chunk_size=256, data_dir=tmp_path)
+        pid = c1.create_replicated_pool("rep", size=3, pg_num=4)
+        data = {f"o{i}": payload(400, seed=i) for i in range(8)}
+        for oid, d in data.items():
+            c1.put(pid, oid, d)
+        c1.shutdown()
+        c2 = MiniCluster.load(tmp_path)
+        pid2 = c2.pool_ids["rep"]
+        for oid, d in sorted(data.items()):
+            assert c2.get(pid2, oid, len(d)) == d
+
+
+class TestReplicatedThrash:
+    """The replicated half of the thrash matrix (the reference runs the
+    Thrasher over both pool types, qa/suites/rados/thrash*)."""
+
+    def test_thrash_replicated(self):
+        rng = np.random.default_rng(99)
+        cluster = MiniCluster(n_osds=12, chunk_size=256)
+        pid = cluster.create_replicated_pool("thrash", size=3, pg_num=8)
+        model: dict[str, bytes] = {}
+        down: set[int] = set()
+        kills = 0
+
+        def pgs_for(osd):
+            return [g for g in cluster.pools[pid]["pgs"].values()
+                    if osd in g.acting]
+
+        primaries = {g.backend.whoami
+                     for g in cluster.pools[pid]["pgs"].values()}
+        for _ in range(150):
+            action = rng.random()
+            if action < 0.45:
+                oid = f"obj{int(rng.integers(0, 30))}"
+                data = rng.integers(0, 256, int(rng.integers(1, 5)) * 256,
+                                    dtype=np.uint8).tobytes()
+
+                def committed(tid, _oid=oid, _d=data):
+                    old = model.get(_oid, b"")
+                    model[_oid] = _d + old[len(_d):] \
+                        if len(old) > len(_d) else _d
+                cluster.put(pid, oid, data, wait=False, on_commit=committed)
+            elif action < 0.80 and model:
+                oid = sorted(model)[int(rng.integers(0, len(model)))]
+                g = cluster.pg_group(pid, oid)
+                if g.backend.whoami in g.backend.current_shards() or \
+                        g.backend.current_shards():
+                    got = cluster.get(pid, oid, len(model[oid]))
+                    assert got == model[oid], f"{oid} diverged"
+            elif action < 0.92 and len(down) < 2:
+                candidates = [o for o in range(12)
+                              if o not in down and o not in primaries]
+                if candidates:
+                    osd = int(rng.choice(candidates))
+                    down.add(osd)
+                    kills += 1
+                    for g in pgs_for(osd):
+                        g.bus.mark_down(osd)
+            elif down:
+                osd = int(rng.choice(sorted(down)))
+                down.discard(osd)
+                for g in pgs_for(osd):
+                    g.bus.mark_up(osd)
+                    g.bus.deliver_all()
+
+        for osd in sorted(down):
+            down.discard(osd)
+            for g in pgs_for(osd):
+                g.bus.mark_up(osd)
+                g.bus.deliver_all()
+        for _ in range(10):
+            busy = False
+            for g in cluster.pools[pid]["pgs"].values():
+                g.bus.deliver_all()
+                if g.backend.stale or g.backend.shard_repairs:
+                    busy = True
+            if not busy:
+                break
+        assert kills >= 3
+        for oid, want in sorted(model.items()):
+            assert cluster.get(pid, oid, len(want)) == want, \
+                f"{oid} lost acked data"
+            g = cluster.pg_group(pid, oid)
+            report = g.backend.be_deep_scrub(oid)
+            assert all(report.values()), f"{oid}: dirty replicas {report}"

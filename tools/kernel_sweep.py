@@ -1,0 +1,213 @@
+"""Sweep RS GF-apply kernel variants on the attached TPU.
+
+Explores the roofline levers from VERDICT r3 item 2: int8 MXU accumulation
+(2x bf16 peak on v5e), block-diagonal coefficient stacking (lifts the
+[32, 64] degenerate matmul to full [128, 256] MXU tiles), tile width, and
+a pure-stream copy kernel as the bandwidth ceiling reference.
+
+Usage: python tools/kernel_sweep.py [--quick]
+"""
+from __future__ import annotations
+
+import argparse
+import functools
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, ".")
+from ceph_tpu.ops.pallas_kernels import expand_bits_plane_major  # noqa: E402
+from ceph_tpu.ops import rs_kernels  # noqa: E402
+from ceph_tpu.gf.matrix import cauchy1  # noqa: E402
+
+from jax.experimental import pallas as pl
+
+
+def chain_time(apply_fn, mat, data, reps=18, rounds=4):
+    @jax.jit
+    def run(M, D):
+        def body(i, carry):
+            out = apply_fn(M, carry)
+            head = jax.lax.dynamic_slice(carry, (0, 0), out.shape)
+            return jax.lax.dynamic_update_slice(
+                carry, jax.lax.bitwise_xor(head, out), (0, 0))
+        return jax.lax.fori_loop(0, reps, body, D).astype(jnp.int32).sum()
+    _ = int(run(mat, data))
+    best = 1e9
+    for _ in range(rounds):
+        t0 = time.perf_counter()
+        _ = int(run(mat, data))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def per_op(apply_fn, mat, data, reps=18):
+    t2 = chain_time(apply_fn, mat, data, 2)
+    tb = chain_time(apply_fn, mat, data, reps)
+    return max((tb - t2) / (reps - 2), 1e-9)
+
+
+# -- variant kernels ---------------------------------------------------------
+
+def _kernel_v1(bmat_ref, data_ref, out_ref, *, r, k, acc_dtype):
+    """Current shape: one [8r, 8k] x [8k, T] dot."""
+    d = data_ref[:].astype(jnp.int32)
+    planes = [((d >> b) & 1) for b in range(8)]
+    if acc_dtype == "bf16":
+        bits = jnp.concatenate(planes, axis=0).astype(jnp.bfloat16)
+        acc = jax.lax.dot_general(bmat_ref[:], bits, (((1,), (0,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+        acc = acc.astype(jnp.int32) & 1
+    else:
+        bits = jnp.concatenate(planes, axis=0).astype(jnp.int8)
+        acc = jax.lax.dot_general(bmat_ref[:], bits, (((1,), (0,)), ((), ())),
+                                  preferred_element_type=jnp.int32)
+        acc = acc & 1
+    out = acc[0:r]
+    for b in range(1, 8):
+        out = out | (acc[b * r:(b + 1) * r] << b)
+    out_ref[:] = out.astype(jnp.uint8)
+
+
+def make_v1(mat, tile_n, acc_dtype):
+    r, k = mat.shape
+    bexp = expand_bits_plane_major(mat)
+    bmat = jnp.asarray(bexp, dtype=jnp.bfloat16 if acc_dtype == "bf16"
+                       else jnp.int8)
+
+    def apply_fn(_m, data):
+        n = data.shape[1]
+        n_tiles = n // tile_n
+        return pl.pallas_call(
+            functools.partial(_kernel_v1, r=r, k=k, acc_dtype=acc_dtype),
+            out_shape=jax.ShapeDtypeStruct((r, n), jnp.uint8),
+            grid=(n_tiles,),
+            in_specs=[pl.BlockSpec((8 * r, 8 * k), lambda i: (0, 0)),
+                      pl.BlockSpec((k, tile_n), lambda i: (0, i))],
+            out_specs=pl.BlockSpec((r, tile_n), lambda i: (0, i)),
+        )(bmat, data)
+    return apply_fn
+
+
+def _kernel_bd(bmat_ref, d0, d1, d2, d3, o0, o1, o2, o3, *, r, k, acc_dtype,
+               groups):
+    """Block-diagonal: `groups` independent column tiles in one dot."""
+    drefs = [d0, d1, d2, d3][:groups]
+    orefs = [o0, o1, o2, o3][:groups]
+    parts = []
+    for dref in drefs:
+        d = dref[:].astype(jnp.int32)
+        parts.extend(((d >> b) & 1) for b in range(8))
+    if acc_dtype == "bf16":
+        bits = jnp.concatenate(parts, axis=0).astype(jnp.bfloat16)
+        acc = jax.lax.dot_general(bmat_ref[:], bits, (((1,), (0,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+        acc = acc.astype(jnp.int32) & 1
+    else:
+        bits = jnp.concatenate(parts, axis=0).astype(jnp.int8)
+        acc = jax.lax.dot_general(bmat_ref[:], bits, (((1,), (0,)), ((), ())),
+                                  preferred_element_type=jnp.int32)
+        acc = acc & 1
+    for g, oref in enumerate(orefs):
+        base = g * 8 * r
+        out = acc[base:base + r]
+        for b in range(1, 8):
+            out = out | (acc[base + b * r:base + (b + 1) * r] << b)
+        oref[:] = out.astype(jnp.uint8)
+
+
+def make_bd(mat, tile_n, acc_dtype, groups):
+    r, k = mat.shape
+    bexp = np.asarray(expand_bits_plane_major(mat))          # [8r, 8k]
+    bd = np.zeros((groups * 8 * r, groups * 8 * k), dtype=np.uint8)
+    for g in range(groups):
+        bd[g * 8 * r:(g + 1) * 8 * r, g * 8 * k:(g + 1) * 8 * k] = bexp
+    bmat = jnp.asarray(bd, dtype=jnp.bfloat16 if acc_dtype == "bf16"
+                       else jnp.int8)
+
+    def apply_fn(_m, data):
+        n = data.shape[1]
+        n_tiles = n // (tile_n * groups)
+        in_specs = [pl.BlockSpec((groups * 8 * r, groups * 8 * k),
+                                 lambda i: (0, 0))]
+        for g in range(groups):
+            in_specs.append(pl.BlockSpec(
+                (k, tile_n), lambda i, _g=g: (0, i * groups + _g)))
+        out_specs = [pl.BlockSpec((r, tile_n),
+                                  lambda i, _g=g: (0, i * groups + _g))
+                     for g in range(groups)]
+        outs = pl.pallas_call(
+            functools.partial(_kernel_bd, r=r, k=k, acc_dtype=acc_dtype,
+                              groups=groups),
+            out_shape=[jax.ShapeDtypeStruct((r, n), jnp.uint8)] * groups,
+            grid=(n_tiles,),
+            in_specs=in_specs,
+            out_specs=out_specs,
+        )(bmat, *([data] * groups))
+        return outs[0]          # timing only; real impl merges groups
+    return apply_fn
+
+
+def _copy_kernel(d_ref, o_ref, *, r, k):
+    o_ref[:] = d_ref[0:r]
+
+
+def make_copy(mat, tile_n):
+    """Bandwidth ceiling: read [k, T], write [r, T], zero compute."""
+    r, k = mat.shape
+
+    def apply_fn(_m, data):
+        n = data.shape[1]
+        return pl.pallas_call(
+            functools.partial(_copy_kernel, r=r, k=k),
+            out_shape=jax.ShapeDtypeStruct((r, n), jnp.uint8),
+            grid=(n // tile_n,),
+            in_specs=[pl.BlockSpec((k, tile_n), lambda i: (0, i))],
+            out_specs=pl.BlockSpec((r, tile_n), lambda i: (0, i)),
+        )(data)
+    return apply_fn
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    args = ap.parse_args()
+
+    k, m = 8, 4
+    n = 64 * 1024 * 1024 // k                 # 64 MiB total, like bench.py
+    rng = np.random.default_rng(0)
+    data = jax.device_put(jnp.asarray(
+        rng.integers(0, 256, size=(k, n), dtype=np.uint8)))
+    mat = jnp.asarray(cauchy1(k, m), dtype=jnp.uint8)
+    mib = k * n / 2**20
+
+    print(f"device={jax.devices()[0]}  data {k}x{n} = {mib:.0f} MiB")
+
+    def report(name, fn):
+        try:
+            t = per_op(fn, mat, data)
+            print(f"{name:34s} {mib / t:10.0f} MiB/s")
+        except Exception as e:
+            print(f"{name:34s} FAILED: {str(e)[:120]}")
+
+    tiles = [4096, 8192] if args.quick else [2048, 4096, 8192, 16384, 32768]
+    report("copy-ceiling t=8192", make_copy(mat, 8192))
+    report("copy-ceiling t=32768", make_copy(mat, 32768))
+    for t in tiles:
+        report(f"v1 bf16 t={t}", make_v1(mat, t, "bf16"))
+    for t in tiles:
+        report(f"v1 int8 t={t}", make_v1(mat, t, "int8"))
+    for groups in (2, 4):
+        for t in ([4096, 8192] if args.quick else [2048, 4096, 8192]):
+            report(f"bd{groups} int8 t={t}", make_bd(mat, t, "int8", groups))
+    report("bd4 bf16 t=4096", make_bd(mat, 4096, "bf16", 4))
+    # XLA reference paths
+    report("xla bitslice", lambda M, D: rs_kernels.gf_apply_bitslice(M, D))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
