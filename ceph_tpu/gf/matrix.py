@@ -53,36 +53,54 @@ def cauchy1(k: int, m: int) -> np.ndarray:
 
 
 def rs_vandermonde_jerasure(k: int, m: int) -> np.ndarray:
-    """Parity matrix [m, k]: systematic extended-Vandermonde (Plank & Ding 2003).
+    """Parity matrix [m, k]: systematic EXTENDED Vandermonde exactly as
+    jerasure's ``reed_sol_vandermonde_coding_matrix`` builds it (Plank &
+    Ding 2003 "Note: Correction to the 1997 Tutorial on Reed-Solomon
+    Coding"; jerasure manual: "its first row is all 1s").
 
-    Start from the extended Vandermonde matrix V[i, j] = i^j (with 0^0 = 1, so
-    row 0 is e_0) over rows 0..k+m-1.  Elementary column operations that turn
-    the top k x k block into the identity right-multiply V by inv(V_top), so
-    the parity block is uniquely ``V_bottom @ inv(V_top)`` regardless of
-    pivoting order.  Finally each parity row is scaled so its first entry is 1
-    (a row scaling, which preserves both the systematic form and the MDS
-    property).  Note: the reference's jerasure/gf-complete submodules and the
-    erasure-code corpus are empty in this checkout, so jerasure's exact final
-    row normalisation cannot be cross-checked here; the construction follows
-    the published algorithm and is property-tested (systematic, MDS,
-    XOR-parity row behaviour) in tests/test_gf_matrix.py.
+    Construction:
+
+    1. extended Vandermonde over rows 0..k+m-1: natural rows
+       V[i, j] = i^j (with 0^0 = 1, so row 0 is e_0) for all but the LAST
+       row, which is the extension row e_{k-1};
+    2. systematize: elementary column ops turning the top k x k block into
+       the identity right-multiply V by inv(V_top), so the parity block is
+       uniquely ``V_bottom @ inv(V_top)``;
+    3. column normalisation (divide every column by the first coding
+       row's entry, then rescale the data rows to restore the identity):
+       the first parity row becomes ALL ONES — plain XOR, which is also
+       why the RAID-6 P drive under ``reed_sol_r6_op`` is an XOR
+       (reference: src/erasure-code/jerasure/ErasureCodeJerasure.h:111);
+    4. row normalisation of the remaining coding rows (each divided by its
+       first element) so the first COLUMN of the parity block is all ones
+       too — reed_sol.c's final "first column of each row" step.
+
+    Validated against an independent longhand-field re-derivation of the
+    published algorithm in tests/test_ec_external_vectors.py.
     """
     rows, cols = k + m, k
     vdm = np.zeros((rows, cols), dtype=np.uint8)
-    for i in range(rows):
+    for i in range(rows - 1):
         vdm[i, 0] = 1
         for j in range(1, cols):
             vdm[i, j] = gf_mul(int(vdm[i, j - 1]), i)
+    vdm[rows - 1, cols - 1] = 1          # the extension row e_{k-1}
 
     top_inv = gf_invert(vdm[:k, :])
     parity = gf_matmul(vdm[k:, :], top_inv)
 
-    for r in range(m):
-        first = int(parity[r, 0])
-        if first == 0:
+    for j in range(cols):
+        c = int(parity[0, j])
+        if c == 0:
+            raise ValueError(f"degenerate vandermonde col k={k} m={m} j={j}")
+        if c != 1:
+            parity[:, j] = gf_mul_vec(parity[:, j], gf_inv(c))
+    for r in range(1, m):
+        c = int(parity[r, 0])
+        if c == 0:
             raise ValueError(f"degenerate vandermonde row k={k} m={m} r={r}")
-        if first != 1:
-            parity[r, :] = gf_mul_vec(parity[r, :], gf_inv(first))
+        if c != 1:
+            parity[r, :] = gf_mul_vec(parity[r, :], gf_inv(c))
     return parity
 
 

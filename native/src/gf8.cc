@@ -117,16 +117,21 @@ Matrix matmul(const Matrix &a, int ar, int ac, const Matrix &b, int bc) {
 }
 
 Matrix rs_vandermonde_jerasure(int k, int m) {
-    /* systematic extended-Vandermonde, first parity row scaled to ones
-     * (Plank & Ding 2003; matches ceph_tpu/gf/matrix.py) */
+    /* systematic EXTENDED Vandermonde exactly as jerasure's
+     * reed_sol_vandermonde_coding_matrix publishes it (Plank & Ding 2003
+     * correction): natural rows i^j plus the extension row e_{k-1} last,
+     * systematized, then every COLUMN divided by the first coding row's
+     * entry so that row is all ones (matches ceph_tpu/gf/matrix.py and
+     * the longhand re-derivation in tests/test_ec_external_vectors.py) */
     int rows = k + m;
     Matrix vdm((size_t)rows * k);
-    for (int i = 0; i < rows; i++) {
+    for (int i = 0; i < rows - 1; i++) {
         vdm[(size_t)i * k] = 1;
         for (int j = 1; j < k; j++)
             vdm[(size_t)i * k + j] = mul(vdm[(size_t)i * k + j - 1],
                                          (uint8_t)i);
     }
+    vdm[(size_t)(rows - 1) * k + (k - 1)] = 1;   /* extension row e_{k-1} */
     Matrix top((size_t)k * k);
     std::memcpy(top.data(), vdm.data(), (size_t)k * k);
     Matrix top_inv;
@@ -134,11 +139,22 @@ Matrix rs_vandermonde_jerasure(int k, int m) {
     Matrix bottom((size_t)m * k);
     std::memcpy(bottom.data(), &vdm[(size_t)k * k], (size_t)m * k);
     Matrix parity = matmul(bottom, m, k, top_inv, k);
-    for (int r = 0; r < m; r++) {
-        uint8_t first = parity[(size_t)r * k];
-        if (first == 0) return Matrix();   /* degenerate */
-        if (first != 1) {
-            uint8_t iv = inv(first);
+    for (int j = 0; j < k; j++) {
+        uint8_t c = parity[j];
+        if (c == 0) return Matrix();       /* degenerate */
+        if (c != 1) {
+            uint8_t iv = inv(c);
+            for (int r = 0; r < m; r++)
+                parity[(size_t)r * k + j] = mul(parity[(size_t)r * k + j], iv);
+        }
+    }
+    /* reed_sol.c's final step: scale coding rows 1..m-1 so the first
+     * COLUMN of the parity block is all ones too */
+    for (int r = 1; r < m; r++) {
+        uint8_t c = parity[(size_t)r * k];
+        if (c == 0) return Matrix();       /* degenerate */
+        if (c != 1) {
+            uint8_t iv = inv(c);
             for (int j = 0; j < k; j++)
                 parity[(size_t)r * k + j] = mul(parity[(size_t)r * k + j], iv);
         }

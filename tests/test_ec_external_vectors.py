@@ -1,0 +1,261 @@
+"""Externally-derived bit-compat vectors for the RS codec (VERDICT r3 #5).
+
+The EC corpus (tests/test_ec_corpus.py) pins the codec against its own
+earlier output; these tests pin it against sources INDEPENDENT of
+ceph_tpu.gf:
+
+1. the published exp/antilog sequence of GF(2^8) mod 0x11D — the standard
+   table printed in Reed-Solomon tutorials (QR-code RS references) and
+   implied by gf-complete's w=8 default and ISA-L's field choice;
+2. hand-derivable scalar identities (shift-reduce longhand shown inline);
+3. a from-scratch longhand field implementation LOCAL TO THIS FILE
+   (peasant multiplication + brute-force inverse + its own Gauss-Jordan:
+   no import from ceph_tpu.gf), used to re-derive every matrix family
+   from its published construction:
+   - gf_gen_rs_matrix (ISA-L): parity row r col j = (2^r)^j
+     (reference: src/erasure-code/isa/ErasureCodeIsa.cc:384-387);
+   - gf_gen_cauchy1_matrix (ISA-L): absolute row i, col j = inv(i ^ j);
+   - reed_sol_vandermonde_coding_matrix (jerasure / Plank & Ding 2003):
+     systematic extended Vandermonde;
+4. frozen literal encode vectors computed from (3) alone: one per
+   technique, hex-embedded, so a regression in EITHER implementation —
+   tables, matrix build, or kernel — breaks the match.
+
+One wrong constant in gf/tables.py or gf/matrix.py now fails here even if
+the codec stays self-consistent.
+"""
+import numpy as np
+import pytest
+
+from ceph_tpu.gf import matrix as gfm
+from ceph_tpu.gf import ref as gfref
+from ceph_tpu.gf.tables import EXP_TABLE, GF_POLY, MUL_TABLE, gf_inv, gf_mul
+from ceph_tpu.ops.codec import RSCodec
+
+# -- 1. the published antilog sequence ---------------------------------------
+# First 36 powers of the generator alpha=2 in GF(2^8)/0x11D, exactly as
+# printed in published RS-code log/antilog tables.  Each step is
+# "shift left; if bit 8 set, XOR 0x11D" — e.g. 128<<1=0x100 -> ^0x11D = 29.
+PUBLISHED_EXP = [
+    1, 2, 4, 8, 16, 32, 64, 128, 29, 58, 116, 232, 205, 135, 19, 38,
+    76, 152, 45, 90, 180, 117, 234, 201, 143, 3, 6, 12, 24, 48, 96, 192,
+    157, 39, 78, 156,
+]
+
+
+def test_exp_table_matches_published_sequence():
+    assert list(EXP_TABLE[:36]) == PUBLISHED_EXP
+
+
+def test_known_scalar_identities():
+    # 0x8E<<1 = 0x11C; 0x11C ^ 0x11D = 1  =>  2 * 0x8E = 1, inv(2) = 0x8E
+    assert gf_mul(2, 0x8E) == 1
+    assert gf_inv(2) == 0x8E
+    # 0x80<<1 = 0x100; ^0x11D = 0x1D  =>  2 * 0x80 = 0x1D
+    assert gf_mul(2, 0x80) == 0x1D
+    # Fermat: a^255 = 1 for every nonzero a (field order 256)
+    for a in (1, 2, 3, 0x53, 0xCA, 0xFF):
+        p = 1
+        for _ in range(255):
+            p = gf_mul(p, a)
+        assert p == 1, f"{a}^255 != 1"
+
+
+# -- 3. the independent longhand field ----------------------------------------
+
+def longhand_mul(a: int, b: int) -> int:
+    """Peasant multiplication with 0x11D reduction — no tables."""
+    r = 0
+    while b:
+        if b & 1:
+            r ^= a
+        a <<= 1
+        if a & 0x100:
+            a ^= 0x11D
+        b >>= 1
+    return r
+
+
+def longhand_inv(a: int) -> int:
+    for x in range(1, 256):
+        if longhand_mul(a, x) == 1:
+            return x
+    raise ZeroDivisionError(a)
+
+
+def longhand_matmul(A, B):
+    n, k = len(A), len(B[0])
+    out = [[0] * k for _ in range(n)]
+    for i in range(n):
+        for j in range(k):
+            acc = 0
+            for t in range(len(B)):
+                acc ^= longhand_mul(A[i][t], B[t][j])
+            out[i][j] = acc
+    return out
+
+
+def longhand_invert(M):
+    """Gauss-Jordan over the longhand field, independent of gfm.gf_invert."""
+    n = len(M)
+    aug = [list(row) + [1 if i == j else 0 for j in range(n)]
+           for i, row in enumerate(M)]
+    for col in range(n):
+        piv = next(r for r in range(col, n) if aug[r][col])
+        aug[col], aug[piv] = aug[piv], aug[col]
+        inv_p = longhand_inv(aug[col][col])
+        aug[col] = [longhand_mul(v, inv_p) for v in aug[col]]
+        for r in range(n):
+            if r != col and aug[r][col]:
+                f = aug[r][col]
+                aug[r] = [v ^ longhand_mul(f, w)
+                          for v, w in zip(aug[r], aug[col])]
+    return [row[n:] for row in aug]
+
+
+def test_mul_table_vs_longhand():
+    rng = np.random.default_rng(0x11D)
+    pairs = rng.integers(0, 256, size=(2000, 2))
+    for a, b in pairs:
+        assert MUL_TABLE[a, b] == longhand_mul(int(a), int(b))
+    for b in range(256):          # full rows for the generators
+        assert MUL_TABLE[2, b] == longhand_mul(2, b)
+        assert MUL_TABLE[3, b] == longhand_mul(3, b)
+
+
+# -- published matrix constructions, re-derived longhand ----------------------
+
+def longhand_rs_matrix_isa(k: int, m: int):
+    """gf_gen_rs_matrix (ISA-L): parity row r = geometric row of gen=2^r."""
+    parity = []
+    gen = 1
+    for _ in range(m):
+        p, row = 1, []
+        for _ in range(k):
+            row.append(p)
+            p = longhand_mul(p, gen)
+        parity.append(row)
+        gen = longhand_mul(gen, 2)
+    return parity
+
+
+def longhand_cauchy1(k: int, m: int):
+    """gf_gen_cauchy1_matrix (ISA-L): absolute row i, col j = inv(i ^ j)."""
+    return [[longhand_inv((k + i) ^ j) for j in range(k)] for i in range(m)]
+
+
+def longhand_jerasure_vandermonde(k: int, m: int):
+    """Plank & Ding 2003 systematic EXTENDED Vandermonde: natural rows
+    V[i, j] = i^j plus the extension row e_{k-1} last; systematize
+    (parity = V_bottom @ inv(V_top)); then divide every column by the
+    first coding row's entry (and rescale data rows to restore the
+    identity) so the first parity row is all ones — the construction
+    jerasure's reed_sol_vandermonde_coding_matrix publishes."""
+    rows = k + m
+    V = []
+    for i in range(rows - 1):
+        row, p = [], 1
+        for _ in range(k):
+            row.append(p)
+            p = longhand_mul(p, i)
+        V.append(row)
+    V.append([0] * (k - 1) + [1])        # extension row e_{k-1}
+    top_inv = longhand_invert(V[:k])
+    parity = longhand_matmul(V[k:], top_inv)
+    for j in range(k):
+        s = longhand_inv(parity[0][j])
+        for r in range(m):
+            parity[r][j] = longhand_mul(parity[r][j], s)
+    # reed_sol.c's final step: scale coding rows 1..m-1 so the first
+    # column of the parity block is all ones as well
+    for r in range(1, m):
+        s = longhand_inv(parity[r][0])
+        parity[r] = [longhand_mul(v, s) for v in parity[r]]
+    return parity
+
+
+@pytest.mark.parametrize("k,m", [(4, 2), (8, 4), (10, 4), (3, 3)])
+def test_vandermonde_isa_matches_published_construction(k, m):
+    assert gfm.rs_vandermonde_isa(k, m).tolist() == \
+        longhand_rs_matrix_isa(k, m)
+
+
+@pytest.mark.parametrize("k,m", [(4, 2), (8, 4), (6, 3), (12, 4)])
+def test_cauchy1_matches_published_construction(k, m):
+    assert gfm.cauchy1(k, m).tolist() == longhand_cauchy1(k, m)
+
+
+@pytest.mark.parametrize("k,m", [(4, 2), (7, 3), (8, 4)])
+def test_jerasure_vandermonde_matches_published_construction(k, m):
+    assert gfm.rs_vandermonde_jerasure(k, m).tolist() == \
+        longhand_jerasure_vandermonde(k, m)
+
+
+def test_jerasure_first_parity_row_is_xor():
+    """Published jerasure behavior: the first coding row of
+    reed_sol_vandermonde_coding_matrix is all ones (plain XOR parity),
+    and after the final row scaling so is the first COLUMN."""
+    for k, m in ((4, 2), (7, 3), (8, 4), (10, 4)):
+        P = gfm.rs_vandermonde_jerasure(k, m)
+        assert all(v == 1 for v in P[0])
+        assert all(int(row[0]) == 1 for row in P)
+
+
+# -- 4. frozen literal encode vectors -----------------------------------------
+# Input: bytes 0..31 as k=4 chunks of 8 bytes.  Expected parity computed by
+# the longhand field ONLY (verified at generation time), then frozen.
+
+FIXED_INPUT = np.frombuffer(
+    bytes.fromhex("5bb1f83a9c07d2e4416fc9258ad0137e"
+                  "f462b89d03e7541cca2f6b90d8a3e517"),
+    dtype=np.uint8).reshape(4, 8).copy()
+
+FROZEN_PARITY = {
+    # technique: hex of the [m=2, 8] parity block (generated by the
+    # longhand implementation above and frozen; the test re-derives it)
+    "vandermonde": "2493e212cd937091309fd2ca1770c2d0",
+    "cauchy": "390122a8fa53494b5c962a6e77f9bf29",
+    "reed_sol_van": "2493e212cd937091d02ba3f0b4641547",
+}
+
+
+def _longhand_parity(technique):
+    build = {"vandermonde": longhand_rs_matrix_isa,
+             "cauchy": longhand_cauchy1,
+             "reed_sol_van": longhand_jerasure_vandermonde}[technique]
+    P = build(4, 2)
+    return bytes(bytearray(
+        v for row in longhand_matmul(P, FIXED_INPUT.tolist()) for v in row))
+
+
+@pytest.mark.parametrize("technique", ["vandermonde", "cauchy",
+                                       "reed_sol_van"])
+def test_codec_reproduces_frozen_vectors(technique):
+    codec = RSCodec(4, 2, technique=technique, device="numpy")
+    parity = codec.encode(FIXED_INPUT)
+    got = parity.tobytes().hex()
+    assert got == FROZEN_PARITY[technique], \
+        f"{technique}: codec output diverged from the frozen vector"
+    # and the frozen vector itself must match the longhand derivation —
+    # proving it is externally pinned, not a copy of the codec's output
+    assert _longhand_parity(technique).hex() == FROZEN_PARITY[technique]
+
+
+def test_decode_roundtrip_against_longhand():
+    """Erase two chunks; the codec's reconstruction must equal the
+    longhand solve of the same linear system."""
+    codec = RSCodec(4, 2, technique="cauchy", device="numpy")
+    parity = codec.encode(FIXED_INPUT)
+    rec = codec.decode({1: FIXED_INPUT[1], 2: FIXED_INPUT[2],
+                        3: FIXED_INPUT[3], 4: parity[0]}, erasures=[0, 5])
+    # longhand: data0 from rows {1,2,3,parity0} of the generator
+    P = longhand_cauchy1(4, 2)
+    G = [[1 if i == j else 0 for j in range(4)] for i in range(4)] + P
+    sub = [G[i] for i in (1, 2, 3, 4)]
+    inv = longhand_invert(sub)
+    chunks = [FIXED_INPUT[1].tolist(), FIXED_INPUT[2].tolist(),
+              FIXED_INPUT[3].tolist(), list(parity[0])]
+    data0 = longhand_matmul([inv[0]], chunks)[0]
+    assert list(rec[0]) == data0
+    parity1 = longhand_matmul([P[1]], FIXED_INPUT.tolist())[0]
+    assert list(rec[5]) == parity1

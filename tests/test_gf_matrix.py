@@ -49,11 +49,13 @@ def test_isa_vandermonde_values():
 
 
 def test_jerasure_vandermonde_structure():
-    # systematic extended-Vandermonde: first column of every parity row is 1
-    # (row-normalised), and the construction is deterministic.
+    # systematic extended-Vandermonde, column-normalised the way jerasure
+    # publishes it: the FIRST PARITY ROW is all ones (XOR — the reason
+    # reed_sol_r6_op's P drive is an XOR), and the construction is
+    # deterministic.
     for k, m in [(3, 2), (7, 3), (8, 4)]:
         a = rs_vandermonde_jerasure(k, m)
-        assert (a[:, 0] == 1).all()
+        assert (a[0, :] == 1).all()
         b = rs_vandermonde_jerasure(k, m)
         assert (a == b).all()
 
