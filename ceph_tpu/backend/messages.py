@@ -156,6 +156,30 @@ class PGLogUpdate:
     trim_to: int = 0
 
 
+@dataclass
+class FaultConfig:
+    """Message-level fault injection (the messenger half of the Thrasher:
+    the reference's ``ms inject socket failures`` / delivery randomization,
+    qa/tasks/ceph_manager.py).  Faithful to messenger semantics:
+
+    - per-SENDER ordering is always preserved (TCP/ProtocolV2 guarantees
+      in-order delivery per connection; in-process FIFO is load-bearing
+      for rollback ordering too) — ``reorder`` randomizes scheduling
+      ACROSS senders at each destination, which also models arbitrary
+      cross-connection delay;
+    - ``dup_prob`` redelivers a message immediately after the first
+      delivery (connection reset + resend: the reference dedups resent
+      ops by reqid; our shards dedup sub-writes by at_version);
+    - ``drop_prob`` silently discards (a reset with no resend — only for
+      tests that exercise stall handling; real msgr resends, so thrash
+      campaigns should leave this 0).
+    """
+    seed: int = 0
+    reorder: bool = False
+    dup_prob: float = 0.0
+    drop_prob: float = 0.0
+
+
 class MessageBus:
     """Per-shard FIFO queues; handlers registered per shard id."""
 
@@ -164,10 +188,21 @@ class MessageBus:
         self.handlers: dict[int, object] = {}
         self.down: set[int] = set()
         self.delivered = 0
+        self.dropped = 0
+        self.duplicated = 0
         # failure/revival notification fan-out: the reference's analog is the
         # osdmap epoch bump reaching each OSD after heartbeats report it
         self.down_listeners: list = []
         self.up_listeners: list = []
+        self._faults: FaultConfig | None = None
+        self._fault_rng = None
+
+    def inject_faults(self, cfg: FaultConfig | None) -> None:
+        """Enable (or, with None, disable) fault injection."""
+        self._faults = cfg
+        if cfg is not None:
+            import random
+            self._fault_rng = random.Random(cfg.seed)
 
     def register(self, shard: int, handler) -> None:
         self.queues.setdefault(shard, deque())
@@ -190,15 +225,48 @@ class MessageBus:
     def send(self, to_shard: int, msg) -> None:
         if to_shard in self.down:
             return
+        f = self._faults
+        if f is not None and f.drop_prob and \
+                self._fault_rng.random() < f.drop_prob:
+            self.dropped += 1
+            return
         self.queues.setdefault(to_shard, deque()).append(msg)
+
+    def _pick(self, q: deque):
+        """Next message to deliver.  Under reorder injection: the earliest
+        message of a uniformly random sender (per-sender FIFO preserved,
+        cross-sender order randomized)."""
+        f = self._faults
+        if f is None or not f.reorder or len(q) < 2:
+            return q.popleft()
+        senders, seen = [], set()
+        for m in q:
+            s = getattr(m, "from_shard", None)
+            if s not in seen:
+                seen.add(s)
+                senders.append(s)
+        pick = self._fault_rng.choice(senders)
+        for i, m in enumerate(q):
+            if getattr(m, "from_shard", None) == pick:
+                del q[i]
+                return m
+        return q.popleft()        # unreachable
 
     def deliver_one(self, shard: int) -> bool:
         q = self.queues.get(shard)
         if not q or shard in self.down:
             return False
-        msg = q.popleft()
-        self.handlers[shard].handle_message(msg)
+        msg = self._pick(q)
+        handler = self.handlers[shard]
+        handler.handle_message(msg)
         self.delivered += 1
+        f = self._faults
+        if f is not None and f.dup_prob and \
+                self._fault_rng.random() < f.dup_prob and \
+                shard not in self.down:
+            # immediate redelivery: the resend after a connection reset
+            self.duplicated += 1
+            handler.handle_message(msg)
         return True
 
     def deliver_all(self, max_rounds: int = 10000) -> int:

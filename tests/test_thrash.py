@@ -39,6 +39,13 @@ def thrashed():
         "thrash", {"plugin": "jax_rs", "k": str(K), "m": str(M),
                    "device": "numpy", "technique": "reed_sol_van"},
         pg_num=8)
+    # messenger-level fault injection rides along with the kills: every
+    # message may be duplicated and cross-sender delivery order at each
+    # destination is randomized (per-sender FIFO preserved, like TCP)
+    from ceph_tpu.backend.messages import FaultConfig
+    for i, g in enumerate(cluster.pools[pid]["pgs"].values()):
+        g.bus.inject_faults(FaultConfig(seed=i * 7 + 1, reorder=True,
+                                        dup_prob=0.1))
     model: dict[str, bytes] = {}
     down: set[int] = set()
     log = []
