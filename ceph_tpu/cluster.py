@@ -392,15 +392,21 @@ class MiniCluster:
             new.bus.deliver_all()
         self.pools[pool_id]["pgs"][ps] = new
 
-    def attach_monitor(self):
-        """Wire a Monitor over this cluster's OSDMap: committed epochs
-        propagate to the data path the way daemons react to osdmap epoch
-        bumps in the reference — down-marks route around the shard,
+    def attach_monitor(self, n_mons: int = 1):
+        """Wire the control plane over this cluster's OSDMap: committed
+        epochs propagate to the data path the way daemons react to osdmap
+        epoch bumps in the reference — down-marks route around the shard,
         boot-marks repair it before it serves, and weight changes
-        (auto-out) backfill PGs onto their new acting sets."""
-        from .mon import Monitor
+        (auto-out) backfill PGs onto their new acting sets.
+
+        ``n_mons > 1`` runs a real Paxos quorum (MonCluster): map commits
+        then require a monitor majority and survive monitor deaths."""
+        from .mon import MonCluster, Monitor
         from .osdmap import OSD_UP
-        mon = Monitor(self.osdmap, cct=self.cct)
+        if n_mons > 1:
+            mon = MonCluster(self.osdmap, n_mons=n_mons, cct=self.cct)
+        else:
+            mon = Monitor(self.osdmap, cct=self.cct)
 
         def on_map(new_map, inc):
             self.osdmap = new_map

@@ -7,5 +7,7 @@ can_mark_down :2671) over this framework's OSDMap incrementals; heartbeats
 (src/osd/OSD.cc:4547-4996)."""
 from .monitor import Monitor
 from .heartbeat import HeartbeatAgent, VirtualClock
+from .paxos import MonCluster, PaxosMonitor
 
-__all__ = ["Monitor", "HeartbeatAgent", "VirtualClock"]
+__all__ = ["Monitor", "HeartbeatAgent", "VirtualClock", "MonCluster",
+           "PaxosMonitor"]

@@ -42,6 +42,11 @@ class Monitor:
         self.subscribers: list = []             # fn(new_map, inc)
         self.down_stamp: dict[int, float] = {}  # osd -> when marked down
         self.nodown: set[int] = set()
+        # multi-monitor mode: when set, propose_pending hands the pending
+        # Incremental to the Paxos layer instead of applying it directly
+        # (the PaxosService::propose_pending split; single-mon mode keeps
+        # the commit==quorum shortcut)
+        self.submit_fn = None
 
     # -- failure reports (OSDMonitor.cc:2874) ------------------------------
 
@@ -123,6 +128,20 @@ class Monitor:
                 not self.pending.new_pg_upmap_items):
             return None
         inc, self.pending = self.pending, Incremental()
+        if self.submit_fn is not None:
+            # quorum mode: the commit arrives back via apply_committed
+            # once a majority of monitors accepted it.  A refused submit
+            # (no quorum) restores the pending state — it re-proposes on a
+            # later tick rather than being parked as a stale Incremental.
+            if not self.submit_fn(now, inc):
+                self.pending = inc
+            return None
+        return self.apply_committed(now, inc)
+
+    def apply_committed(self, now: float, inc: Incremental) -> OSDMap:
+        """Apply a committed incremental to this monitor's map and notify
+        subscribers — the refresh path every quorum member runs after a
+        Paxos commit (single-mon mode calls it directly)."""
         old = self.osdmap
         self.osdmap = apply_incremental(old, inc)
         for o, st in inc.new_state.items():

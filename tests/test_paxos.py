@@ -1,0 +1,277 @@
+"""Multi-monitor Paxos: elections, quorum commits, leader-death recovery.
+
+Mirrors the reference's monitor consensus (reference: src/mon/Paxos.cc
+collect/begin/accept/commit phases; src/mon/Elector.cc lowest-rank-wins
+elections): map commits require a majority quorum, survive any single
+monitor death — including the leader dying BETWEEN begin and commit — and
+laggard monitors catch up through the collect phase.
+"""
+import numpy as np
+import pytest
+
+from ceph_tpu.crush import CRUSH_BUCKET_STRAW2, CrushMap
+from ceph_tpu.mon import MonCluster
+from ceph_tpu.mon.paxos import Accept, Begin, Commit
+from ceph_tpu.osdmap import Incremental, OSDMap, OSD_UP
+
+
+def make_map(n_osds=9) -> OSDMap:
+    cmap = CrushMap()
+    cmap.set_type_name(1, "host")
+    cmap.set_type_name(2, "root")
+    hosts = []
+    for h0 in range(0, n_osds, 3):
+        items = list(range(h0, h0 + 3))
+        hosts.append(cmap.add_bucket(CRUSH_BUCKET_STRAW2, 1, items,
+                                     [0x10000] * 3))
+    root = cmap.add_bucket(CRUSH_BUCKET_STRAW2, 2, hosts,
+                           [3 * 0x10000] * len(hosts))
+    cmap.set_item_name(root, "default")
+    cmap.finalize()
+    m = OSDMap(crush=cmap)
+    for o in range(n_osds):
+        m.create_osd(o)
+    return m
+
+
+def down_inc(osd: int) -> Incremental:
+    inc = Incremental()
+    inc.new_state[osd] = OSD_UP
+    return inc
+
+
+@pytest.fixture()
+def mc():
+    return MonCluster(make_map(), n_mons=3)
+
+
+class TestElection:
+    def test_lowest_rank_wins(self, mc):
+        ld = mc.leader()
+        assert ld is not None and ld.rank == 0
+        assert mc.quorum_ranks() == {0, 1, 2}
+
+    def test_leader_death_elects_next_rank(self, mc):
+        mc.kill(0)
+        ld = mc.elect()
+        assert ld is not None and ld.rank == 1
+        assert mc.quorum_ranks() == {1, 2}
+
+    def test_no_quorum_without_majority(self, mc):
+        mc.kill(1)
+        mc.kill(2)
+        assert mc.elect() is None         # 1 of 3 cannot form a quorum
+
+    def test_revived_leader_retakes_lead(self, mc):
+        mc.kill(0)
+        assert mc.elect().rank == 1
+        mc.revive(0)
+        assert mc.leader().rank == 0
+        assert mc.quorum_ranks() == {0, 1, 2}
+
+
+class TestQuorumCommit:
+    def test_commit_reaches_every_monitor(self, mc):
+        ld = mc.leader()
+        ld.submit(0.0, down_inc(3))
+        mc.bus.deliver_all()
+        for m in mc.mons:
+            assert m.last_committed == 1
+            assert not m.service.osdmap.is_up(3), f"mon.{m.rank} stale"
+
+    def test_commits_survive_any_single_mon_death(self, mc):
+        for victim in range(3):
+            cluster = MonCluster(make_map(), n_mons=3)
+            cluster.kill(victim)
+            ld = cluster.elect()
+            assert ld is not None
+            ld.submit(0.0, down_inc(4))
+            cluster.bus.deliver_all()
+            for m in cluster.mons:
+                if m.rank == victim:
+                    continue
+                assert not m.service.osdmap.is_up(4), \
+                    f"mon.{m.rank} missed the commit (victim={victim})"
+
+    def test_sequential_commits_ordered(self, mc):
+        ld = mc.leader()
+        for osd in (3, 4, 5):
+            ld.submit(0.0, down_inc(osd))
+        mc.bus.deliver_all()
+        for m in mc.mons:
+            assert m.last_committed == 3
+            assert all(not m.service.osdmap.is_up(o) for o in (3, 4, 5))
+
+    def test_peon_forwards_to_leader(self, mc):
+        peon = mc.mons[2]
+        peon.submit(0.0, down_inc(6))     # MForward analog
+        mc.bus.deliver_all()
+        for m in mc.mons:
+            assert not m.service.osdmap.is_up(6)
+
+    def test_no_commit_without_quorum(self, mc):
+        mc.kill(1)
+        mc.kill(2)
+        assert mc.elect() is None
+        # the service's pending change is refused by paxos (no quorum) and
+        # RETAINED — not parked as a stale value, not lost
+        svc = mc.mons[0].service
+        svc.pending.new_state[3] = OSD_UP
+        svc.propose_pending(0.0)
+        mc.bus.deliver_all()
+        assert mc.mons[0].last_committed == 0
+        assert svc.osdmap.is_up(3)
+        assert svc.pending.new_state.get(3) == OSD_UP, \
+            "pending change lost while quorum-less"
+        # majority returns: the retained change proposes and commits
+        mc.revive(1)
+        svc.propose_pending(1.0)
+        mc.bus.deliver_all()
+        assert mc.mons[0].last_committed == 1
+        assert not svc.osdmap.is_up(3)
+        assert not mc.mons[1].service.osdmap.is_up(3)
+
+    def test_duplicated_forward_commits_once(self, mc):
+        """A duplicated MForward (connection reset + resend) must not
+        commit twice — with XOR incremental semantics a double commit
+        would flip the OSD back up."""
+        from ceph_tpu.backend.messages import FaultConfig
+        mc.bus.inject_faults(FaultConfig(seed=5, dup_prob=1.0))
+        mc.mons[2].submit(0.0, down_inc(5))
+        mc.bus.deliver_all()
+        assert all(m.last_committed == 1 for m in mc.mons)
+        assert not mc.osdmap.is_up(5)
+
+
+class TestLeaderDeathMidProposal:
+    def test_value_accepted_by_peons_survives_leader_death(self, mc):
+        """THE two-phase scenario: the leader sends begin, peons accept
+        and persist the uncommitted value, the leader dies before sending
+        commit.  The new leader's collect phase must find the uncommitted
+        value and re-propose it (Paxos.cc handle_last recovery)."""
+        ld = mc.leader()
+        ld.submit(0.0, down_inc(7))
+        # deliver ONLY the peons' queues: they process Begin and queue
+        # their Accepts back to the leader...
+        while mc.bus.deliver_one(1) or mc.bus.deliver_one(2):
+            pass
+        for r in (1, 2):
+            assert mc.mons[r].uncommitted is not None, "peon missed begin"
+        assert all(m.last_committed == 0 for m in mc.mons), \
+            "nothing committed yet: the accepts are still in flight"
+        # ...but the leader dies with the Accepts undelivered.  kill()
+        # re-elects; the new leader's collect finds the uncommitted value
+        mc.kill(0)
+        new_ld = mc.leader()
+        assert new_ld.rank == 1
+        for m in mc.mons[1:]:
+            assert m.last_committed == 1, "uncommitted value was lost"
+            assert not m.service.osdmap.is_up(7)
+
+    def test_value_only_at_leader_dies_with_it(self, mc):
+        """Converse: the leader dies before ANY peon saw begin — the value
+        was never acked and legitimately vanishes."""
+        ld = mc.leader()
+        ld.submit(0.0, down_inc(8))       # begins queued, not delivered
+        # the leader dies before its begins hit the wire: they are lost
+        # with it (a queued message on a dead host's NIC)
+        mc.bus.down.add(0)                # died...
+        mc.bus.queues[1].clear()          # ...with the begins unsent
+        mc.bus.queues[2].clear()
+        new_ld = mc.elect()
+        assert new_ld.rank == 1
+        assert all(m.last_committed == 0 for m in mc.mons[1:])
+        assert mc.osdmap.is_up(8)
+
+    def test_leader_death_after_partial_commit_broadcast(self, mc):
+        """The leader committed and told one peon but died before telling
+        the other: collect must catch the laggard up."""
+        ld = mc.leader()
+        ld.submit(0.0, down_inc(3))
+        while mc.bus.deliver_one(1) or mc.bus.deliver_one(2):
+            pass                          # peons accept
+        while mc.bus.deliver_one(0):
+            pass                          # leader commits, queues Commit
+        # deliver the commit to peon 1 only, then the leader dies
+        while mc.bus.deliver_one(1):
+            pass
+        mc.bus.queues[2].clear()          # peon 2 never hears the commit
+        assert mc.mons[1].last_committed == 1
+        assert mc.mons[2].last_committed == 0
+        mc.kill(0)                        # mon1 leads; collect shares state
+        assert mc.mons[2].last_committed == 1
+        assert not mc.mons[2].service.osdmap.is_up(3)
+
+
+class TestLaggardCatchUp:
+    def test_revived_monitor_learns_missed_commits(self, mc):
+        mc.kill(2)
+        mc.elect()
+        ld = mc.leader()
+        for osd in (3, 4):
+            ld.submit(0.0, down_inc(osd))
+        mc.bus.deliver_all()
+        assert mc.mons[2].last_committed == 0
+        mc.revive(2)                      # collect ships the missed commits
+        assert mc.mons[2].last_committed == 2
+        assert all(not mc.mons[2].service.osdmap.is_up(o) for o in (3, 4))
+        # and the revived mon participates in new commits
+        mc.leader().submit(0.0, down_inc(5))
+        mc.bus.deliver_all()
+        assert mc.mons[2].last_committed == 3
+
+
+class TestMiniClusterIntegration:
+    def test_attach_quorum_monitor_drives_data_path(self):
+        """attach_monitor(n_mons=3): a failure report committed through
+        the Paxos quorum routes the data path around the dead OSD, and
+        surviving a monitor death changes nothing for the data path."""
+        from ceph_tpu.cluster import MiniCluster
+        cluster = MiniCluster(n_osds=12, chunk_size=256)
+        pid = cluster.create_ec_pool(
+            "q", {"plugin": "jax_rs", "k": "4", "m": "2",
+                  "device": "numpy"}, pg_num=4)
+        data = np.random.default_rng(0).integers(
+            0, 256, 4096, dtype=np.uint8).tobytes()
+        cluster.put(pid, "obj", data)
+        mon = cluster.attach_monitor(n_mons=3)
+        assert mon.leader() is not None
+        mon.kill(2)                       # a monitor dies: quorum holds
+        mon.elect()
+        g = cluster.pg_group(pid, "obj")
+        victim = g.acting[1]
+        grace = cluster.cct.conf.get("osd_heartbeat_grace")
+        mon.prepare_failure(victim, (victim + 1) % 12, 0.0, grace + 1)
+        mon.prepare_failure(victim, (victim + 4) % 12, 0.0, grace + 1)
+        new = mon.propose_pending(grace + 1)
+        assert new is not None and not new.is_up(victim)
+        assert victim in g.bus.down       # subscriber routed the data path
+        assert cluster.get(pid, "obj", len(data)) == data
+
+
+class TestServiceIntegration:
+    def test_failure_reports_commit_through_quorum(self, mc):
+        """The OSDMonitor failure path rides Paxos: reports -> grace ->
+        propose -> quorum commit -> every mon's map shows the OSD down,
+        subscribers fire exactly once."""
+        grace = mc.cct.conf.get("osd_heartbeat_grace")
+        events = []
+        mc.subscribers.append(lambda new_map, inc: events.append(inc))
+        mc.prepare_failure(0, 3, failed_since=0.0, now=grace + 1)
+        mc.prepare_failure(0, 6, failed_since=0.0, now=grace + 1)
+        new = mc.propose_pending(grace + 1)
+        assert new is not None and not new.is_up(0)
+        assert len(events) == 1
+        for m in mc.mons:
+            assert not m.service.osdmap.is_up(0)
+
+    def test_failure_path_survives_leader_loss(self, mc):
+        grace = mc.cct.conf.get("osd_heartbeat_grace")
+        mc.kill(0)
+        mc.elect()
+        mc.prepare_failure(2, 4, failed_since=0.0, now=grace + 1)
+        mc.prepare_failure(2, 7, failed_since=0.0, now=grace + 1)
+        new = mc.propose_pending(grace + 1)
+        assert new is not None and not new.is_up(2)
+        for m in mc.mons[1:]:
+            assert not m.service.osdmap.is_up(2)
