@@ -50,9 +50,13 @@ class PGGroup:
 
     def __init__(self, pgid: PG, acting: list[int], ec_impl,
                  chunk_size: int, cct, name_prefix: str,
-                 min_size: int = 0, store_factory=None):
+                 min_size: int = 0, store_factory=None, epoch: int = 0):
         self.pgid = pgid
         self.acting = acting
+        # map epoch this acting set was established at: ops stamped with
+        # an older epoch by a stale client get rejected (the OSD's
+        # require_same_or_newer_map check, src/osd/OSD.cc)
+        self.epoch = epoch
         self.bus = MessageBus()
         primary = acting[0]
         mk = store_factory if store_factory is not None else lambda osd: None
@@ -185,7 +189,8 @@ class MiniCluster:
                               name_prefix=f"c{self.cluster_id}",
                               min_size=pool.min_size,
                               store_factory=self._store_factory(
-                                  pool.pool_id, ps))
+                                  pool.pool_id, ps),
+                              epoch=self.osdmap.epoch)
         self.pools[pool.pool_id] = {"pool": pool, "pgs": pgs, "ec": ec}
         self.pool_ids[name] = pool.pool_id
         self._save_meta()
@@ -322,6 +327,33 @@ class MiniCluster:
             for g in p["pgs"].values():
                 g.bus.deliver_all()
 
+    # -- RADOS protocol surface (what an Objecter talks to) ----------------
+
+    def osd_submit(self, pool_id: int, ps: int, target_osd: int,
+                   client_epoch: int, oid: str, data: bytes | None,
+                   read_len: int = 0, on_done=None):
+        """One client op arriving at an OSD.  Returns None when accepted
+        (completion via ``on_done``), or ``("stale", current_map)`` when
+        the client's map is too old for this PG — wrong primary, or an
+        epoch predating the PG's current acting set — mirroring the OSD's
+        require_same_or_newer_map + "client has old map" resend dance."""
+        g = self.pools[pool_id]["pgs"][ps]
+        if target_osd != g.backend.whoami or client_epoch < g.epoch:
+            return ("stale", self.osdmap)
+        if data is not None:
+            # wait=False: an inactive PG parks the op, which stays in the
+            # objecter's inflight list until it commits — the reference's
+            # blocked-op behavior, not an error
+            self.put(pool_id, oid, data, wait=False,
+                     on_commit=lambda tid: on_done(len(data))
+                     if on_done else None)
+        else:
+            try:
+                on_done(self.get(pool_id, oid, read_len))
+            except (IOError, KeyError) as e:
+                on_done(e if isinstance(e, IOError) else IOError(str(e)))
+        return None
+
     def shutdown(self) -> None:
         """Unhook every PG backend from the (possibly shared) Context so a
         discarded cluster is collectable and does not shadow later ones;
@@ -386,7 +418,8 @@ class MiniCluster:
                       self.cct, name_prefix=f"c{self.cluster_id}e"
                                             f"{self.osdmap.epoch}",
                       min_size=self.pools[pool_id]["pool"].min_size,
-                      store_factory=self._store_factory(pool_id, ps))
+                      store_factory=self._store_factory(pool_id, ps),
+                      epoch=self.osdmap.epoch)
         for oid, data in contents.items():
             new.backend.submit_transaction(PGTransaction().write(oid, 0, data))
             new.bus.deliver_all()

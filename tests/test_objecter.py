@@ -1,0 +1,155 @@
+"""Objecter: client-side targeting, epoch stamps, resend on map change.
+
+Mirrors the reference's client op lifecycle (reference: src/osdc/
+Objecter.cc op_submit :2257, _calc_target :2786, resend-on-map-change
+_scan_requests): a client holding a stale OSDMap gets its op rejected by
+the OSD side and transparently resends to the new acting set after
+refreshing its map — no manual re-routing by the caller.
+"""
+import numpy as np
+import pytest
+
+from ceph_tpu.client import Objecter
+from ceph_tpu.cluster import MiniCluster
+from ceph_tpu.osdmap import PG
+
+PROFILE = {"plugin": "jax_rs", "k": "4", "m": "2", "device": "numpy",
+           "technique": "reed_sol_van"}
+
+
+def payload(n, seed=7):
+    return np.random.default_rng(seed).integers(
+        0, 256, size=n, dtype=np.uint8).tobytes()
+
+
+@pytest.fixture()
+def cluster():
+    return MiniCluster(n_osds=12, chunk_size=256)
+
+
+def trigger_remap(cluster, pid, oid):
+    """Down + auto-out the primary-adjacent shard of oid's PG so CRUSH
+    remaps it and the cluster backfills to a new acting set.  Returns the
+    (old_acting, new_acting) pair."""
+    mon = cluster.attach_monitor()
+    g = cluster.pg_group(pid, oid)
+    old_acting = list(g.acting)
+    victim = old_acting[1]
+    grace = cluster.cct.conf.get("osd_heartbeat_grace")
+    reporters = [o for o in range(12) if o != victim][:4]
+    for r in reporters:
+        mon.prepare_failure(victim, r, 0.0, grace + 1)
+    mon.propose_pending(grace + 1)
+    out_after = cluster.cct.conf.get("mon_osd_down_out_interval")
+    mon.tick(grace + out_after + 10)          # auto-out -> remap+backfill
+    new_g = cluster.pg_group(pid, oid)
+    assert list(new_g.acting) != old_acting, "remap did not happen"
+    return old_acting, list(new_g.acting)
+
+
+class TestBasics:
+    def test_write_read_roundtrip(self, cluster):
+        pid = cluster.create_ec_pool("p", PROFILE, pg_num=8)
+        oc = Objecter(cluster)
+        data = payload(2048)
+        acked = []
+        oc.write(pid, "obj", data, on_complete=acked.append)
+        assert acked == [2048]
+        assert oc.read(pid, "obj", 2048) == data
+        assert oc.resends == 0 and oc.stale_rejects == 0
+
+    def test_client_targets_match_cluster_placement(self, cluster):
+        pid = cluster.create_ec_pool("p", PROFILE, pg_num=8)
+        oc = Objecter(cluster)
+        for i in range(16):
+            oid = f"o{i}"
+            ps, primary, acting = oc._calc_target(pid, oid)
+            g = cluster.pg_group(pid, oid)
+            assert g.pgid.ps == ps
+            assert list(acting) == list(g.acting)
+            assert primary == g.backend.whoami
+
+    def test_replicated_pool_too(self, cluster):
+        pid = cluster.create_replicated_pool("rep", size=3, pg_num=8)
+        oc = Objecter(cluster)
+        data = payload(512)
+        oc.write(pid, "obj", data)
+        assert oc.read(pid, "obj", 512) == data
+
+
+class TestStaleClientResend:
+    def test_write_during_remap_lands_on_new_acting_set(self, cluster):
+        """THE VERDICT scenario: the client's map predates a backfill
+        remap; its write must land on the new acting set without manual
+        re-routing — stale reject -> map refresh -> resend."""
+        pid = cluster.create_ec_pool("p", PROFILE, pg_num=8)
+        oc = Objecter(cluster)                 # snapshots the current map
+        oc.write(pid, "obj", payload(1024, seed=1))
+        stale_epoch = oc.osdmap.epoch
+
+        old_acting, new_acting = trigger_remap(cluster, pid, "obj")
+        assert oc.osdmap.epoch == stale_epoch  # client did NOT see the maps
+
+        data2 = payload(1024, seed=2)
+        acked = []
+        oc.write(pid, "obj", data2, on_complete=acked.append)
+        assert acked == [1024], "stale-client write never completed"
+        assert oc.stale_rejects >= 1
+        assert oc.osdmap.epoch > stale_epoch   # refreshed by the reject
+        # the write really landed on the NEW group
+        new_g = cluster.pg_group(pid, "obj")
+        assert list(new_g.acting) == new_acting
+        assert oc.read(pid, "obj", 1024) == data2
+        assert cluster.get(pid, "obj", 1024) == data2
+
+    def test_read_with_stale_map_resends(self, cluster):
+        pid = cluster.create_ec_pool("p", PROFILE, pg_num=8)
+        oc = Objecter(cluster)
+        data = payload(1024, seed=3)
+        oc.write(pid, "obj", data)
+        trigger_remap(cluster, pid, "obj")
+        assert oc.read(pid, "obj", 1024) == data
+        assert oc.stale_rejects >= 1
+
+    def test_subscribed_client_never_goes_stale(self, cluster):
+        """An Objecter attached to the monitor adopts each committed map
+        as it lands, so post-remap ops hit the right target first try."""
+        pid = cluster.create_ec_pool("p", PROFILE, pg_num=8)
+        mon = cluster.attach_monitor()
+        oc = Objecter(cluster)
+        oc.attach(mon)
+        oc.write(pid, "obj", payload(1024, seed=1))
+        g = cluster.pg_group(pid, "obj")
+        victim = g.acting[1]
+        grace = cluster.cct.conf.get("osd_heartbeat_grace")
+        for r in [o for o in range(12) if o != victim][:4]:
+            mon.prepare_failure(victim, r, 0.0, grace + 1)
+        mon.propose_pending(grace + 1)
+        out_after = cluster.cct.conf.get("mon_osd_down_out_interval")
+        mon.tick(grace + out_after + 10)
+        assert oc.osdmap.epoch == cluster.osdmap.epoch
+        data2 = payload(1024, seed=4)
+        oc.write(pid, "obj", data2)
+        assert oc.stale_rejects == 0           # first try hit the target
+        assert oc.read(pid, "obj", 1024) == data2
+
+    def test_epoch_gate_rejects_only_remapped_pgs(self, cluster):
+        """Epoch bumps that do not change a PG's interval must not force
+        resends (the same_interval_since semantics): a client one epoch
+        behind still talks to untouched PGs directly."""
+        pid = cluster.create_ec_pool("p", PROFILE, pg_num=8)
+        oc = Objecter(cluster)
+        mon = cluster.attach_monitor()
+        # bump the cluster epoch withOUT remapping anything: mark an OSD
+        # that serves no PG of this object down... simplest: nodown-less
+        # down+up of some osd not in this PG's acting set
+        g = cluster.pg_group(pid, "obj")
+        outsider = next(o for o in range(12) if o not in g.acting)
+        grace = cluster.cct.conf.get("osd_heartbeat_grace")
+        for r in [o for o in range(12) if o != outsider][:4]:
+            mon.prepare_failure(outsider, r, 0.0, grace + 1)
+        mon.propose_pending(grace + 1)         # epoch bump, no remap
+        assert cluster.osdmap.epoch > oc.osdmap.epoch
+        oc.write(pid, "obj", payload(256, seed=5))
+        assert oc.stale_rejects == 0, \
+            "stale client rejected at an untouched PG"
