@@ -139,11 +139,47 @@ class BulkMapper:
                 cache.popitem(last=False)
         self._cache = cache[self._digest]
 
+    # -- choose_args compilation (mapper.c:309-326) --------------------------
+
+    def _compile_choose_args(self, choose_args: dict | None):
+        """Dense tensors for per-position weight-set overrides: ws
+        [P, B, S] (position-major weights; buckets without an override
+        replicate their base weights) and hash-id overrides ids [B, S]
+        (``arg->ids``: alternate ids fed to the straw2 hash while the
+        RETURNED item stays the bucket's own).  These are TRACED kernel
+        inputs (one compilation per P, not per weight-set content — the
+        balancer's crush-compat loop mutates the values every iteration)."""
+        cm = self.cm
+        if not choose_args:
+            return 1, cm.weights[None, :, :], cm.items
+        row_of = {bid: row for row, bid in enumerate(sorted(self.cmap.buckets))}
+        P = max((len(a.get("weight_set") or [()])
+                 for a in choose_args.values()), default=1) or 1
+        ws = np.broadcast_to(cm.weights, (P,) + cm.weights.shape).copy()
+        ids = cm.items.copy()
+        for bid, arg in choose_args.items():
+            row = row_of.get(bid)
+            if row is None:
+                continue
+            size = int(cm.sizes[row])
+            wset = arg.get("weight_set")
+            if wset:
+                for p in range(P):
+                    # positions past the set reuse the LAST entry
+                    # (mapper.c:318 "choose_args_index >= size -> size-1")
+                    wrow = wset[min(p, len(wset) - 1)]
+                    ws[p, row, :size] = np.asarray(wrow[:size],
+                                                   dtype=np.int64)
+            if arg.get("ids"):
+                ids[row, :size] = np.asarray(arg["ids"][:size],
+                                             dtype=np.int32)
+        return P, ws, ids
+
     # -- kernel construction ------------------------------------------------
 
     def _kernel(self, kind: str, root: int, numrep: int, out_size: int,
-                target_type: int, leaf: bool):
-        key = (kind, root, numrep, out_size, target_type, leaf)
+                target_type: int, leaf: bool, n_pos: int):
+        key = (kind, root, numrep, out_size, target_type, leaf, n_pos)
         if key in self._cache:
             return self._cache[key]
         import jax
@@ -162,7 +198,7 @@ class BulkMapper:
 
         cm = self.cm
         items_d = jnp.asarray(cm.items)
-        weights_d = jnp.asarray(cm.weights)
+        last_pos = n_pos - 1
         sizes_d = jnp.asarray(cm.sizes)
         types_d = jnp.asarray(cm.types)
         row_of_id_d = jnp.asarray(cm.row_of_id)
@@ -176,153 +212,170 @@ class BulkMapper:
         max_devices = cm.max_devices
         NONE = jnp.int32(CRUSH_ITEM_NONE)
 
-        def straw2_choose(row, x, r):
-            """mapper.c:361-384 vectorized over one bucket's item slots."""
-            ids = items_d[row]
-            ws = weights_d[row]
-            u = crush_hash32_3_jax(
-                jnp.broadcast_to(x, ids.shape),
-                ids,
-                jnp.broadcast_to(r, ids.shape)) & jnp.uint32(0xFFFF)
-            ln = ln_d[u.astype(jnp.int32)]
-            # trunc((ln - 2^48)/w): numerator <= 0, equals -((2^48-ln)//w)
-            draw = -((LN_BIAS - ln) // jnp.maximum(ws, 1))
-            draw = jnp.where((ws > 0) & (slot < sizes_d[row]), draw, S64_MIN)
-            return ids[jnp.argmax(draw)]
+        def make_one(ws_pos_d, hash_ids_d):
+            """Build the per-x chooser over TRACED choose_args tensors
+            (ws_pos_d [P, B, S] weights, hash_ids_d [B, S] hash ids) so
+            one compilation serves every weight-set content."""
+            def straw2_choose(row, x, r, pos):
+                """mapper.c:361-384 vectorized over one bucket's item slots;
+                ``pos`` selects the choose_args weight-set position (clamped
+                to the last entry, mapper.c:309-326), and the hash runs over
+                the (possibly overridden) ids while the returned item is the
+                bucket's own."""
+                ids = items_d[row]
+                hids = hash_ids_d[row]
+                ws = ws_pos_d[jnp.minimum(pos, last_pos), row]
+                u = crush_hash32_3_jax(
+                    jnp.broadcast_to(x, hids.shape),
+                    hids,
+                    jnp.broadcast_to(r, hids.shape)) & jnp.uint32(0xFFFF)
+                ln = ln_d[u.astype(jnp.int32)]
+                # trunc((ln - 2^48)/w): numerator <= 0, equals -((2^48-ln)//w)
+                draw = -((LN_BIAS - ln) // jnp.maximum(ws, 1))
+                draw = jnp.where((ws > 0) & (slot < sizes_d[row]), draw, S64_MIN)
+                return ids[jnp.argmax(draw)]
 
-        def is_out(reweights, item, x):
-            """mapper.c:424-438"""
-            w = reweights[jnp.clip(item, 0, reweights.shape[0] - 1)]
-            oob = item >= reweights.shape[0]
-            h = crush_hash32_2_jax(x, item.astype(jnp.uint32)) & jnp.uint32(0xFFFF)
-            return oob | (w == 0) | ((w < 0x10000) & (h.astype(jnp.int64) >= w))
+            def is_out(reweights, item, x):
+                """mapper.c:424-438"""
+                w = reweights[jnp.clip(item, 0, reweights.shape[0] - 1)]
+                oob = item >= reweights.shape[0]
+                h = crush_hash32_2_jax(x, item.astype(jnp.uint32)) & jnp.uint32(0xFFFF)
+                return oob | (w == 0) | ((w < 0x10000) & (h.astype(jnp.int64) >= w))
 
-        def descend(row0, x, r, ttype):
-            """Walk intervening buckets until an item of type ttype
-            (mapper.c:547-565 / :787-800).  Returns (item, ok, skip):
-            ok = landed on the target type; skip = structurally bad
-            (device at the wrong level or id >= max_devices -> the
-            reference's skip_rep / CRUSH_ITEM_NONE cases)."""
-            def body(_, carry):
-                row, item, done, skip = carry
-                nxt = straw2_choose(row, x, r)
-                is_bucket = nxt < jnp.int32(0)
-                nrow = jnp.where(is_bucket, row_of_id_d[-1 - nxt], 0)
-                ntype = jnp.where(is_bucket, types_d[nrow], 0)
-                oob_dev = (~is_bucket) & (nxt >= max_devices)
-                hit = (ntype == ttype) & (~oob_dev)
-                bad = oob_dev | ((~hit) & (~is_bucket))
-                new_done = done | hit | bad
-                return (jnp.where(new_done, row, nrow),
-                        jnp.where(done, item, nxt),
-                        new_done,
-                        jnp.where(done, skip, bad))
-            init = (jnp.int32(row0), jnp.int32(0), jnp.bool_(False),
-                    jnp.bool_(False))
-            _, item, done, skip = lax.fori_loop(0, cm.max_depth, body, init)
-            # depth exhaustion without landing: treat as retryable reject
-            return item, done & (~skip), skip
+            def descend(row0, x, r, ttype, pos):
+                """Walk intervening buckets until an item of type ttype
+                (mapper.c:547-565 / :787-800).  Returns (item, ok, skip):
+                ok = landed on the target type; skip = structurally bad
+                (device at the wrong level or id >= max_devices -> the
+                reference's skip_rep / CRUSH_ITEM_NONE cases)."""
+                def body(_, carry):
+                    row, item, done, skip = carry
+                    nxt = straw2_choose(row, x, r, pos)
+                    is_bucket = nxt < jnp.int32(0)
+                    nrow = jnp.where(is_bucket, row_of_id_d[-1 - nxt], 0)
+                    ntype = jnp.where(is_bucket, types_d[nrow], 0)
+                    oob_dev = (~is_bucket) & (nxt >= max_devices)
+                    hit = (ntype == ttype) & (~oob_dev)
+                    bad = oob_dev | ((~hit) & (~is_bucket))
+                    new_done = done | hit | bad
+                    return (jnp.where(new_done, row, nrow),
+                            jnp.where(done, item, nxt),
+                            new_done,
+                            jnp.where(done, skip, bad))
+                init = (jnp.int32(row0), jnp.int32(0), jnp.bool_(False),
+                        jnp.bool_(False))
+                _, item, done, skip = lax.fori_loop(0, cm.max_depth, body, init)
+                # depth exhaustion without landing: treat as retryable reject
+                return item, done & (~skip), skip
 
-        def leaf_from(item, x, r, outpos):
-            """Single-try chooseleaf recursion (recurse_tries=1):
-            r_leaf = (stable ? 0 : outpos) + sub_r (mapper.c:570-596)."""
-            sub_r = (r >> (vary_r - 1)) if vary_r else jnp.int32(0)
-            base = jnp.int32(0) if stable else outpos
-            drow = jnp.where(item < 0, row_of_id_d[-1 - item], 0)
-            return descend(drow, x, base + sub_r, 0)
+            def leaf_from(item, x, r, outpos):
+                """Single-try chooseleaf recursion (recurse_tries=1):
+                r_leaf = (stable ? 0 : outpos) + sub_r (mapper.c:570-596);
+                the recursion's bucket_choose position stays outpos."""
+                sub_r = (r >> (vary_r - 1)) if vary_r else jnp.int32(0)
+                base = jnp.int32(0) if stable else outpos
+                drow = jnp.where(item < 0, row_of_id_d[-1 - item], 0)
+                return descend(drow, x, base + sub_r, 0, outpos)
 
-        def firstn_one(x, reweights):
-            """crush_choose_firstn (mapper.c:460-651), no local retries.
-            Places at most out_size items while scanning numrep reps
-            (the reference's count/out_size vs numrep split)."""
-            out = jnp.full((out_size,), NONE, dtype=jnp.int32)
-            out2 = jnp.full((out_size,), NONE, dtype=jnp.int32)
-            outpos = jnp.int32(0)
+            def firstn_one(x, reweights):
+                """crush_choose_firstn (mapper.c:460-651), no local retries.
+                Places at most out_size items while scanning numrep reps
+                (the reference's count/out_size vs numrep split)."""
+                out = jnp.full((out_size,), NONE, dtype=jnp.int32)
+                out2 = jnp.full((out_size,), NONE, dtype=jnp.int32)
+                outpos = jnp.int32(0)
 
-            for rep in range(numrep):
+                for rep in range(numrep):
+                    def cond(st):
+                        placed, dead, ftotal, _o, _o2, outpos = st
+                        return (~placed) & (~dead) & (ftotal < tries) & \
+                            (outpos < out_size)
+
+                    def body(st):
+                        placed, dead, ftotal, out, out2, outpos = st
+                        r = jnp.int32(rep) + ftotal
+                        item, ok, skip = descend(root_row, x, r, target_type,
+                                                 outpos)
+                        pos_mask = jnp.arange(out_size) < outpos
+                        collide = jnp.any(pos_mask & (out == item))
+                        reject = ~ok
+                        if leaf:
+                            lf, lok, _ = leaf_from(item, x, r, outpos)
+                            lcollide = jnp.any(pos_mask & (out2 == lf))
+                            reject = reject | (~lok) | lcollide | \
+                                is_out(reweights, lf, x)
+                            leaf_item = lf
+                        else:
+                            leaf_item = item
+                            if target_type == 0:
+                                reject = reject | is_out(reweights, item, x)
+                        good = (~skip) & (~reject) & (~collide)
+                        new_out = jnp.where(good, out.at[outpos].set(item), out)
+                        new_out2 = jnp.where(good,
+                                             out2.at[outpos].set(leaf_item), out2)
+                        return (good, skip, ftotal + 1, new_out, new_out2,
+                                jnp.where(good, outpos + 1, outpos))
+
+                    _, _, _, out, out2, outpos = lax.while_loop(
+                        cond, body,
+                        (jnp.bool_(False), jnp.bool_(False), jnp.int32(0),
+                         out, out2, outpos))
+
+                result = out2 if leaf else out
+                keep = jnp.arange(out_size) < outpos
+                return jnp.where(keep, result, NONE), outpos
+
+            def indep_one(x, reweights):
+                """crush_choose_indep (mapper.c:658-847): positionally stable."""
+                out = jnp.full((out_size,), UNDEF, dtype=jnp.int32)
+                out2 = jnp.full((out_size,), UNDEF, dtype=jnp.int32)
+
                 def cond(st):
-                    placed, dead, ftotal, _o, _o2, outpos = st
-                    return (~placed) & (~dead) & (ftotal < tries) & \
-                        (outpos < out_size)
+                    out, out2, ftotal = st
+                    return (ftotal < tries) & jnp.any(out == UNDEF)
 
                 def body(st):
-                    placed, dead, ftotal, out, out2, outpos = st
-                    r = jnp.int32(rep) + ftotal
-                    item, ok, skip = descend(root_row, x, r, target_type)
-                    pos_mask = jnp.arange(out_size) < outpos
-                    collide = jnp.any(pos_mask & (out == item))
-                    reject = ~ok
-                    if leaf:
-                        lf, lok, _ = leaf_from(item, x, r, outpos)
-                        lcollide = jnp.any(pos_mask & (out2 == lf))
-                        reject = reject | (~lok) | lcollide | \
-                            is_out(reweights, lf, x)
-                        leaf_item = lf
-                    else:
-                        leaf_item = item
-                        if target_type == 0:
-                            reject = reject | is_out(reweights, item, x)
-                    good = (~skip) & (~reject) & (~collide)
-                    new_out = jnp.where(good, out.at[outpos].set(item), out)
-                    new_out2 = jnp.where(good,
-                                         out2.at[outpos].set(leaf_item), out2)
-                    return (good, skip, ftotal + 1, new_out, new_out2,
-                            jnp.where(good, outpos + 1, outpos))
+                    out, out2, ftotal = st
+                    for rep in range(out_size):
+                        undef = out[rep] == UNDEF
+                        r = jnp.int32(rep) + jnp.int32(numrep) * ftotal
+                        # top-level indep position = the do_rule outpos (0
+                        # here); the leaf recursion's position = rep
+                        # (crush_choose_indep passes outpos=rep down)
+                        item, ok, skip = descend(root_row, x, r, target_type,
+                                                 jnp.int32(0))
+                        collide = jnp.any(out == item)
+                        reject = (~ok) | collide
+                        if leaf:
+                            # recursion: out2[rep], parent_r = r, one try
+                            drow = jnp.where(item < 0, row_of_id_d[-1 - item], 0)
+                            lf, lok, _ = descend(drow, x, jnp.int32(rep) + r, 0,
+                                                 jnp.int32(rep))
+                            reject = reject | (~lok) | is_out(reweights, lf, x)
+                            leaf_item = lf
+                        else:
+                            leaf_item = item
+                            if target_type == 0:
+                                reject = reject | is_out(reweights, item, x)
+                        # structural badness pins the hole permanently
+                        pin_none = undef & skip
+                        good = undef & (~skip) & (~reject)
+                        out = jnp.where(pin_none, out.at[rep].set(NONE), out)
+                        out2 = jnp.where(pin_none, out2.at[rep].set(NONE), out2)
+                        out = jnp.where(good, out.at[rep].set(item), out)
+                        out2 = jnp.where(good, out2.at[rep].set(leaf_item), out2)
+                    return out, out2, ftotal + 1
 
-                _, _, _, out, out2, outpos = lax.while_loop(
-                    cond, body,
-                    (jnp.bool_(False), jnp.bool_(False), jnp.int32(0),
-                     out, out2, outpos))
+                out, out2, _ = lax.while_loop(cond, body,
+                                              (out, out2, jnp.int32(0)))
+                result = out2 if leaf else out
+                return jnp.where(result == UNDEF, NONE, result), jnp.int32(out_size)
 
-            result = out2 if leaf else out
-            keep = jnp.arange(out_size) < outpos
-            return jnp.where(keep, result, NONE), outpos
-
-        def indep_one(x, reweights):
-            """crush_choose_indep (mapper.c:658-847): positionally stable."""
-            out = jnp.full((out_size,), UNDEF, dtype=jnp.int32)
-            out2 = jnp.full((out_size,), UNDEF, dtype=jnp.int32)
-
-            def cond(st):
-                out, out2, ftotal = st
-                return (ftotal < tries) & jnp.any(out == UNDEF)
-
-            def body(st):
-                out, out2, ftotal = st
-                for rep in range(out_size):
-                    undef = out[rep] == UNDEF
-                    r = jnp.int32(rep) + jnp.int32(numrep) * ftotal
-                    item, ok, skip = descend(root_row, x, r, target_type)
-                    collide = jnp.any(out == item)
-                    reject = (~ok) | collide
-                    if leaf:
-                        # recursion: out2[rep], parent_r = r, one try
-                        drow = jnp.where(item < 0, row_of_id_d[-1 - item], 0)
-                        lf, lok, _ = descend(drow, x, jnp.int32(rep) + r, 0)
-                        reject = reject | (~lok) | is_out(reweights, lf, x)
-                        leaf_item = lf
-                    else:
-                        leaf_item = item
-                        if target_type == 0:
-                            reject = reject | is_out(reweights, item, x)
-                    # structural badness pins the hole permanently
-                    pin_none = undef & skip
-                    good = undef & (~skip) & (~reject)
-                    out = jnp.where(pin_none, out.at[rep].set(NONE), out)
-                    out2 = jnp.where(pin_none, out2.at[rep].set(NONE), out2)
-                    out = jnp.where(good, out.at[rep].set(item), out)
-                    out2 = jnp.where(good, out2.at[rep].set(leaf_item), out2)
-                return out, out2, ftotal + 1
-
-            out, out2, _ = lax.while_loop(cond, body,
-                                          (out, out2, jnp.int32(0)))
-            result = out2 if leaf else out
-            return jnp.where(result == UNDEF, NONE, result), jnp.int32(out_size)
-
-        one = firstn_one if kind == "firstn" else indep_one
+            return firstn_one if kind == "firstn" else indep_one
 
         @jax.jit
-        def bulk(xs, reweights):
+        def bulk(xs, reweights, ws_pos, hash_ids):
+            one = make_one(ws_pos, hash_ids)
             return jax.vmap(lambda x: one(x, reweights))(xs)
 
         self._cache[key] = bulk
@@ -330,7 +383,8 @@ class BulkMapper:
 
     # -- public API ---------------------------------------------------------
 
-    def map_rule(self, ruleno: int, xs, reweights=None, result_max: int = 0):
+    def map_rule(self, ruleno: int, xs, reweights=None, result_max: int = 0,
+                 choose_args: dict | None = None):
         import jax.numpy as jnp
         rule = self.cmap.rules[ruleno]
         steps = rule.steps
@@ -365,7 +419,9 @@ class BulkMapper:
             reweights = np.full(self.cm.max_devices, 0x10000, dtype=np.int64)
         reweights = jnp.asarray(np.asarray(reweights, dtype=np.int64))
         xs = jnp.asarray(np.asarray(xs, dtype=np.uint32))
+        n_pos, ws_arr, ids_arr = self._compile_choose_args(choose_args)
         bulk = self._kernel(kind, root, int(numrep), int(out_size),
-                            int(arg2), leaf)
-        out, placed = bulk(xs, reweights)
+                            int(arg2), leaf, int(n_pos))
+        out, placed = bulk(xs, reweights, jnp.asarray(ws_arr),
+                           jnp.asarray(ids_arr))
         return np.asarray(out), np.asarray(placed)

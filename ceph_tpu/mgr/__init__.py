@@ -3,8 +3,8 @@
 The reference runs these as Python modules inside ceph-mgr
 (src/pybind/mgr/{balancer,pg_autoscaler}); here they are library functions
 over OSDMap — same decision logic, emitted as OSDMap incrementals."""
-from .balancer import calc_pg_upmaps, osd_deviation
+from .balancer import calc_pg_upmaps, calc_weight_set, osd_deviation
 from .pg_autoscaler import autoscale_recommendations, nearest_power_of_two
 
-__all__ = ["calc_pg_upmaps", "osd_deviation",
+__all__ = ["calc_pg_upmaps", "calc_weight_set", "osd_deviation",
            "autoscale_recommendations", "nearest_power_of_two"]

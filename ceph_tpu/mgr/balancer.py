@@ -111,6 +111,84 @@ def _try_move(work: OSDMap, pg: PG, over: int, under: int,
     return items
 
 
+def _subtree_devices(m: OSDMap) -> dict[int, list[int]]:
+    """bucket/device id -> devices under it (memoized DFS)."""
+    out: dict[int, list[int]] = {}
+
+    def walk(item: int) -> list[int]:
+        if item in out:
+            return out[item]
+        if item >= 0:
+            out[item] = [item]
+        else:
+            devs: list[int] = []
+            for child in m.crush.buckets[item].items:
+                devs.extend(walk(child))
+            out[item] = devs
+        return out[item]
+
+    for bid in m.crush.buckets:
+        walk(bid)
+    return out
+
+
+def calc_weight_set(m: OSDMap, max_iterations: int = 16, step: float = 0.4,
+                    pools: list[int] | None = None) -> dict | None:
+    """The balancer's crush-compat mode: build the COMPAT weight-set
+    (choose_args key -1, one position) nudging every bucket item's straw2
+    weight toward its subtree's PG-load target — the role
+    ``do_crush_compat`` plays in the reference's balancer module
+    (src/pybind/mgr/balancer/module.py) over CrushWrapper's
+    ``choose_args``.  Works where upmap can't be used (pre-luminous
+    clients), evaluated through the vmapped bulk mapper each iteration.
+
+    Returns the choose_args set ({bucket_id: {"weight_set": [[...]]}}) to
+    install as ``m.crush.choose_args[-1]``, or None if no improvement was
+    found.
+    """
+    work = m.clone()
+    subtree = _subtree_devices(work)
+    # candidate: start from the buckets' own weights (single position)
+    cand = {bid: {"weight_set": [list(b.item_weights)]}
+            for bid, b in work.crush.buckets.items()}
+
+    mapper = BulkPGMapper(work)     # kernels depend only on the crush tree
+
+    def evaluate():
+        counts, targets, _ = osd_deviation(work, pools, mapper=mapper)
+        mask = np.array([work.is_in(o) for o in range(work.max_osd)])
+        dev = np.where(mask, counts - targets, 0.0)
+        return counts, targets, float(np.sqrt((dev ** 2).mean()))
+
+    work.crush.choose_args[-1] = cand
+    counts, targets, best = evaluate()
+    best_cand = {bid: {"weight_set": [list(a["weight_set"][0])]}
+                 for bid, a in cand.items()}
+    improved = False
+
+    for _ in range(max_iterations):
+        # nudge each bucket item by its subtree's load ratio
+        for bid, b in work.crush.buckets.items():
+            ws = cand[bid]["weight_set"][0]
+            for i, item in enumerate(b.items):
+                devs = subtree[item]
+                c = sum(counts[d] for d in devs if d < len(counts))
+                t = sum(targets[d] for d in devs if d < len(targets))
+                if t <= 0 or ws[i] <= 0:
+                    continue
+                ratio = max(0.5, min(2.0, (t / max(c, 0.5)) ** step))
+                ws[i] = max(1, int(ws[i] * ratio))
+        counts, targets, rms = evaluate()
+        if rms < best - 1e-9:
+            best = rms
+            best_cand = {bid: {"weight_set": [list(a["weight_set"][0])]}
+                         for bid, a in cand.items()}
+            improved = True
+        else:
+            break
+    return best_cand if improved else None
+
+
 def calc_pg_upmaps(m: OSDMap, max_iterations: int = 32,
                    max_deviation: float = 1.0,
                    pools: list[int] | None = None) -> Incremental:

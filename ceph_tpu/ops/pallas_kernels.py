@@ -78,7 +78,7 @@ def _gf_stripes_kernel(bmat_ref, data_ref, out_ref, *, r: int, k: int,
                    static_argnames=("stripes", "groups", "tile_n",
                                     "interpret"))
 def gf_apply_stripes_pallas(mat: jax.Array, data: jax.Array, stripes: int,
-                            groups: int = 4, tile_n: int = 16384,
+                            groups: int = 4, tile_n: int = 8192,
                             interpret: bool = False) -> jax.Array:
     """Batched GF apply over the VERTICAL stripe layout.
 

@@ -127,12 +127,15 @@ class BulkPGMapper:
         pps = self.pool_pps(pool)
         ruleno = m.find_rule(pool.crush_rule, pool.type, size)
 
-        use_scalar = (ruleno < 0 or pool_id in m.crush.choose_args or
-                      -1 in m.crush.choose_args)
+        # per-pool choose_args, falling back to the compat set (-1) the
+        # way _pg_to_raw_osds does (OSDMap.cc choose_args_index)
+        ca = m.crush.choose_args.get(pool_id, m.crush.choose_args.get(-1))
+        use_scalar = ruleno < 0
         if not use_scalar:
             try:
                 out, placed = self.bulk.map_rule(
-                    ruleno, pps, reweights=m.osd_weight, result_max=size)
+                    ruleno, pps, reweights=m.osd_weight, result_max=size,
+                    choose_args=ca)
             except ValueError:
                 use_scalar = True
         if use_scalar:

@@ -21,21 +21,24 @@ with open(GOLDEN) as f:
 NX = 48
 
 
-def _interp_padded(cmap, ruleno, x, result_max, weights, numrep):
-    got = crush_do_rule(cmap, ruleno, x, result_max, weights)
+def _interp_padded(cmap, ruleno, x, result_max, weights, numrep,
+                   choose_args=None):
+    got = crush_do_rule(cmap, ruleno, x, result_max, weights,
+                        choose_args=choose_args)
     return got + [CRUSH_ITEM_NONE] * (numrep - len(got))
 
 
-def _compare(cmap, ruleno, result_max, weights=None):
+def _compare(cmap, ruleno, result_max, weights=None, choose_args=None):
     bm = BulkMapper(cmap)
     xs = np.arange(NX)
     out, placed = bm.map_rule(ruleno, xs, reweights=weights,
-                              result_max=result_max)
+                              result_max=result_max,
+                              choose_args=choose_args)
     numrep = out.shape[1]
     for x in range(NX):
         want = _interp_padded(cmap, ruleno, x, result_max,
                               list(weights) if weights is not None else None,
-                              numrep)
+                              numrep, choose_args=choose_args)
         assert list(out[x]) == want[:numrep], (
             f"x={x}: jax={list(out[x])} interp={want}")
 
@@ -128,6 +131,76 @@ def test_bulk_numrep_zero_uses_result_max():
                             (CRUSH_RULE_CHOOSELEAF_INDEP, 0, 1),
                             (CRUSH_RULE_EMIT, 0, 0)])
     _compare(cmap, ruleno, result_max=5)
+
+
+def _host_weight_sets(cmap, n_positions, seed):
+    """Per-position weight-set overrides for every host bucket (the shape
+    the mgr balancer's crush-compat mode writes, mapper.c:309-326)."""
+    rng = np.random.default_rng(seed)
+    args = {}
+    for bid, b in cmap.buckets.items():
+        if b.type != 1:
+            continue
+        wset = []
+        for _ in range(n_positions):
+            wset.append([int(w * rng.choice([0.5, 0.75, 1.0, 1.25]))
+                         for w in b.item_weights])
+        args[bid] = {"weight_set": wset}
+    return args
+
+
+@pytest.mark.parametrize("op,numrep,ttype", [
+    (CRUSH_RULE_CHOOSELEAF_FIRSTN, 3, 2),   # replicated shape
+    (CRUSH_RULE_CHOOSELEAF_INDEP, 4, 1),    # EC shape
+    (CRUSH_RULE_CHOOSE_INDEP, 3, 0),        # devices directly
+    (CRUSH_RULE_CHOOSE_FIRSTN, 2, 1),       # bucket targets
+])
+def test_bulk_choose_args_weight_sets(op, numrep, ttype):
+    """choose_args weight-set overrides: the bulk mapper must bit-match
+    the host interpreter when per-position weights replace the bucket
+    weights (VERDICT r3 #9; mapper.c:309-326 semantics)."""
+    cmap, root = _three_level_map(seed=21)
+    ruleno = cmap.add_rule([(CRUSH_RULE_TAKE, root, 0), (op, numrep, ttype),
+                            (CRUSH_RULE_EMIT, 0, 0)])
+    args = _host_weight_sets(cmap, n_positions=numrep, seed=31)
+    _compare(cmap, ruleno, result_max=numrep, choose_args=args)
+
+
+def test_bulk_choose_args_single_position_and_short_sets():
+    """A weight_set shorter than numrep clamps to its last entry."""
+    cmap, root = _three_level_map(seed=23)
+    ruleno = cmap.add_rule([(CRUSH_RULE_TAKE, root, 0),
+                            (CRUSH_RULE_CHOOSELEAF_FIRSTN, 4, 1),
+                            (CRUSH_RULE_EMIT, 0, 0)])
+    args = _host_weight_sets(cmap, n_positions=2, seed=37)   # < numrep
+    _compare(cmap, ruleno, result_max=4, choose_args=args)
+
+
+def test_bulk_choose_args_ids_override():
+    """``ids`` overrides reseed the straw2 hash while returning the
+    bucket's own items."""
+    cmap, root = _three_level_map(seed=29)
+    ruleno = cmap.add_rule([(CRUSH_RULE_TAKE, root, 0),
+                            (CRUSH_RULE_CHOOSELEAF_INDEP, 4, 1),
+                            (CRUSH_RULE_EMIT, 0, 0)])
+    args = {}
+    for bid, b in cmap.buckets.items():
+        if b.type == 1:
+            args[bid] = {"ids": [int(i) + 1000 for i in b.items]}
+    _compare(cmap, ruleno, result_max=4, choose_args=args)
+
+
+def test_bulk_choose_args_mixed_with_reweights():
+    cmap, root = _three_level_map(seed=31)
+    ruleno = cmap.add_rule([(CRUSH_RULE_TAKE, root, 0),
+                            (CRUSH_RULE_CHOOSELEAF_INDEP, 4, 1),
+                            (CRUSH_RULE_EMIT, 0, 0)])
+    args = _host_weight_sets(cmap, n_positions=4, seed=43)
+    n = cmap.max_devices
+    rng = np.random.default_rng(47)
+    weights = [int(w) for w in rng.choice(
+        [0, 0x8000, 0x10000], size=n, p=[0.1, 0.3, 0.6])]
+    _compare(cmap, ruleno, result_max=4, weights=weights, choose_args=args)
 
 
 def test_compile_rejects_unsupported():

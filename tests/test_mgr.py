@@ -7,10 +7,61 @@ import numpy as np
 import pytest
 
 from ceph_tpu.mgr import (autoscale_recommendations, calc_pg_upmaps,
-                          nearest_power_of_two, osd_deviation)
+                          calc_weight_set, nearest_power_of_two,
+                          osd_deviation)
 from ceph_tpu.osdmap import apply_incremental
 
 from test_osdmap import build_cluster
+
+
+class TestCrushCompatBalancer:
+    """The weight-set mode (reference: balancer module do_crush_compat
+    writing CrushWrapper choose_args) — possible now that the bulk mapper
+    honors choose_args (VERDICT r3 #9)."""
+
+    def test_weight_set_reduces_deviation(self):
+        m = build_cluster(seed=7)
+        m.pools[1].pg_num = 128
+        m.pools[1].pgp_num = 128
+        counts0, targets0, _ = osd_deviation(m, [1])
+        before = float(np.sqrt(((counts0 - targets0) ** 2).mean()))
+        ws = calc_weight_set(m, max_iterations=12, pools=[1])
+        assert ws is not None, "crush-compat found no improvement"
+        m.crush.choose_args[-1] = ws
+        counts1, targets1, _ = osd_deviation(m, [1])
+        after = float(np.sqrt(((counts1 - targets1) ** 2).mean()))
+        assert after < before, f"rms deviation {before} -> {after}"
+
+    def test_weight_set_keeps_placements_valid(self):
+        from ceph_tpu.osdmap import PG
+        m = build_cluster(seed=8)
+        m.pools[2].pg_num = 64
+        m.pools[2].pgp_num = 64
+        ws = calc_weight_set(m, max_iterations=8, pools=[2])
+        if ws is None:
+            pytest.skip("already balanced")
+        m.crush.choose_args[-1] = ws
+        for ps in range(64):
+            up, _, acting, _ = m.pg_to_up_acting_osds(PG(2, ps))
+            real = [o for o in acting if o != 0x7FFFFFFF]
+            assert len(real) == len(set(real)), f"pg {ps}: duplicate osd"
+
+    def test_bulk_and_scalar_agree_under_weight_set(self):
+        """The installed compat weight-set flows through BOTH mapping
+        paths identically (the bulk mapper no longer falls back to the
+        scalar interpreter for choose_args maps)."""
+        from ceph_tpu.osdmap import PG
+        from ceph_tpu.osdmap.bulk import BulkPGMapper
+        m = build_cluster(seed=13)
+        ws = calc_weight_set(m, max_iterations=6)
+        if ws is None:
+            pytest.skip("already balanced")
+        m.crush.choose_args[-1] = ws
+        pm = BulkPGMapper(m).map_pool(1)
+        for ps in range(m.pools[1].pg_num):
+            up, _, _, _ = m.pg_to_up_acting_osds(PG(1, ps))
+            want = list(up) + [0x7FFFFFFF] * (pm.up.shape[1] - len(up))
+            assert list(pm.up[ps]) == want[:pm.up.shape[1]], f"ps={ps}"
 
 
 class TestBalancer:
