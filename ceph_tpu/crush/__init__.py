@@ -10,6 +10,7 @@ from .map import (CrushMap, Bucket, Rule, CRUSH_BUCKET_UNIFORM,
                   CRUSH_RULE_CHOOSELEAF_INDEP, CRUSH_RULE_EMIT,
                   OPTIMAL_TUNABLES, LEGACY_TUNABLES)
 from .mapper import crush_do_rule, Workspace, is_out
+from .compiler import compile_crushmap, decompile
 
 __all__ = [
     "crush_hash32", "crush_hash32_2", "crush_hash32_3", "crush_hash32_4",
@@ -23,4 +24,5 @@ __all__ = [
     "CRUSH_RULE_CHOOSELEAF_FIRSTN", "CRUSH_RULE_CHOOSELEAF_INDEP",
     "CRUSH_RULE_EMIT", "OPTIMAL_TUNABLES", "LEGACY_TUNABLES",
     "crush_do_rule", "Workspace", "is_out",
+    "compile_crushmap", "decompile",
 ]

@@ -66,6 +66,11 @@ class Bucket:
 class Rule:
     steps: list[tuple[int, int, int]]
     ruleno: int = -1
+    # rule mask metadata (crush_rule_mask; carried for the text-format
+    # round trip, reference: CrushCompiler.cc:365-377)
+    type: int = 1                 # 1=replicated, 3=erasure
+    min_size: int = 1
+    max_size: int = 10
 
 
 # optimal tunable profile (builder.c set_optimal_crush_map semantics)
@@ -91,6 +96,7 @@ class CrushMap:
         self.item_names: dict[int, str] = {}
         self.rule_names: dict[str, int] = {}
         self.choose_args: dict[int, object] = {}
+        self.device_classes: dict[int, str] = {}
 
     # -- builder (builder.c semantics) -------------------------------------
 
@@ -283,7 +289,20 @@ class CrushMap:
             m.buckets[b.id] = b
         for rd in d.get("rules", []):
             m.rules[rd["ruleno"]] = Rule(
-                steps=[tuple(s) for s in rd["steps"]], ruleno=rd["ruleno"])
+                steps=[tuple(s) for s in rd["steps"]], ruleno=rd["ruleno"],
+                type=rd.get("type", 1), min_size=rd.get("min_size", 1),
+                max_size=rd.get("max_size", 10))
+        if "type_names" in d:
+            m.type_names = {int(t): n for t, n in d["type_names"].items()}
+        m.item_names = {int(i): n
+                        for i, n in d.get("item_names", {}).items()}
+        m.rule_names = dict(d.get("rule_names", {}))
+        if d.get("device_classes"):
+            m.device_classes = {int(i): c
+                                for i, c in d["device_classes"].items()}
+        for sid, args in d.get("choose_args", {}).items():
+            m.choose_args[int(sid)] = {int(bid): arg
+                                       for bid, arg in args.items()}
         m.max_devices = d.get("max_devices", 0)
         if not m.max_devices:
             m.finalize()
@@ -300,11 +319,24 @@ class CrushMap:
                 if v is not None:
                     bd[k] = v
             buckets.append(bd)
-        return {
+        d = {
             "tunables": dict(self.tunables),
             "max_devices": self.max_devices,
             "buckets": buckets,
-            "rules": [{"ruleno": r.ruleno, "steps": [list(s) for s in r.steps]}
+            "rules": [{"ruleno": r.ruleno, "type": r.type,
+                       "min_size": r.min_size, "max_size": r.max_size,
+                       "steps": [list(s) for s in r.steps]}
                       for r in sorted(self.rules.values(),
                                       key=lambda r: r.ruleno)],
+            "type_names": {str(t): n for t, n in self.type_names.items()},
+            "item_names": {str(i): n for i, n in self.item_names.items()},
+            "rule_names": dict(self.rule_names),
         }
+        if self.device_classes:
+            d["device_classes"] = {str(i): c
+                                   for i, c in self.device_classes.items()}
+        if self.choose_args:
+            d["choose_args"] = {
+                str(sid): {str(bid): arg for bid, arg in args.items()}
+                for sid, args in self.choose_args.items()}
+        return d

@@ -128,7 +128,16 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="crushtool",
                                  description=__doc__.splitlines()[0])
     ap.add_argument("-i", "--in", dest="infile", required=True,
-                    help="CrushMap as JSON (CrushMap.to_dict)")
+                    help="CrushMap as JSON (CrushMap.to_dict) or, with -c, "
+                         "crushmap TEXT")
+    ap.add_argument("-d", "--decompile", action="store_true",
+                    help="emit the map as crushmap text (crushtool -d)")
+    ap.add_argument("-c", "--compile", dest="compile_text",
+                    action="store_true",
+                    help="treat the input as crushmap text (crushtool -c); "
+                         "writes JSON with -o")
+    ap.add_argument("-o", "--out", dest="outfile", default="",
+                    help="output path for -d/-c (default stdout)")
     ap.add_argument("--test", action="store_true")
     ap.add_argument("--rule", type=int, default=-1)
     ap.add_argument("--num-rep", type=int, default=0)
@@ -143,14 +152,41 @@ def main(argv=None) -> int:
     ap.add_argument("--show-utilization", action="store_true")
     args = ap.parse_args(argv)
 
+    if args.compile_text and args.decompile and args.outfile:
+        ap.error("-c and -d share -o; run them separately")
+    if args.compile_text:
+        from ..crush.compiler import compile_crushmap
+        with open(args.infile) as f:
+            cmap = compile_crushmap(f.read())
+        # emit the compiled JSON only when it is the requested product
+        # (-o, or -c alone): --test/-d output must stay unpolluted
+        if args.outfile:
+            with open(args.outfile, "w") as f:
+                f.write(json.dumps(cmap.to_dict(), indent=1) + "\n")
+        elif not (args.test or args.decompile):
+            sys.stdout.write(json.dumps(cmap.to_dict(), indent=1) + "\n")
+        if not (args.test or args.decompile):
+            return 0
+    else:
+        with open(args.infile) as f:
+            cmap = CrushMap.from_dict(json.load(f))
+
+    if args.decompile:
+        from ..crush.compiler import decompile
+        text = decompile(cmap)
+        if args.outfile and not args.compile_text:
+            with open(args.outfile, "w") as f:
+                f.write(text)
+        else:
+            sys.stdout.write(text)
+        if not args.test:
+            return 0
+
     import jax
     jax.config.update("jax_enable_x64", True)   # exact straw2 draws
 
-    with open(args.infile) as f:
-        cmap = CrushMap.from_dict(json.load(f))
-
     if not args.test:
-        ap.error("only --test mode is supported")
+        ap.error("one of --test, -d, -c is required")
     weights = None
     if args.weight:
         weights = [0x10000] * cmap.max_devices
