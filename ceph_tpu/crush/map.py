@@ -169,6 +169,185 @@ class CrushMap:
         b.node_weights = node_weights
         b.weight = node_weights[num_nodes >> 1]
 
+    # -- map surgery (builder.c + CrushWrapper tree ops) -------------------
+
+    def _rebuild_bucket(self, b: Bucket) -> None:
+        """Recompute a bucket's aggregate/aux arrays after its items or
+        item_weights changed (builder.c crush_bucket_adjust/remove paths)."""
+        if b.alg == CRUSH_BUCKET_STRAW:
+            # the straws array would go stale (legacy straw recalculation
+            # is not implemented): refuse rather than corrupt placement
+            raise ValueError("straw(v1) buckets are load-only; convert to "
+                             "straw2 before mutating the map")
+        if b.alg == CRUSH_BUCKET_UNIFORM:
+            b.weight = (b.item_weight or 0) * len(b.items)
+            return
+        if b.item_weights is None and b.alg == CRUSH_BUCKET_TREE and \
+                b.node_weights is not None:
+            # golden dumps carry only the node table; recover the per-item
+            # weights from the leaf nodes (leaves live at odd indices)
+            b.item_weights = [b.node_weights[(i << 1) + 1]
+                              for i in range(len(b.items))]
+        if b.alg == CRUSH_BUCKET_LIST:
+            acc, sums = 0, []
+            for w in b.item_weights:
+                acc += w
+                sums.append(acc)
+            b.sum_weights = sums
+            b.weight = acc
+        elif b.alg == CRUSH_BUCKET_TREE:
+            self._build_tree(b)
+        else:                       # straw2
+            b.weight = sum(b.item_weights)
+
+    def _propagate_weight(self, bucket_id: int) -> None:
+        """Push a bucket's recomputed weight into its ancestors
+        (CrushWrapper::adjust_item_weight's upward walk)."""
+        cur = bucket_id
+        while True:
+            parent = self.parent_of(cur)
+            if parent is None:
+                return
+            pb = self.buckets[parent]
+            idx = pb.items.index(cur)
+            if pb.item_weights is not None:
+                pb.item_weights[idx] = self.buckets[cur].weight
+            self._rebuild_bucket(pb)
+            cur = parent
+
+    def insert_item(self, item: int, weight: int, bucket_id: int) -> None:
+        """Add a device/bucket to a bucket and reweight the ancestry
+        (CrushWrapper::insert_item)."""
+        b = self.buckets[bucket_id]
+        if item in b.items:
+            raise ValueError(f"item {item} already in bucket {bucket_id}")
+        if b.alg == CRUSH_BUCKET_UNIFORM:
+            # builder.c crush_bucket_add_item: uniform buckets reject a
+            # mismatched weight (-EINVAL) instead of silently dropping it
+            if b.items and int(weight) != (b.item_weight or 0):
+                raise ValueError(
+                    f"uniform bucket {bucket_id} holds items of weight "
+                    f"{b.item_weight:#x}; cannot insert weight {weight:#x}")
+            if not b.items:
+                b.item_weight = int(weight)
+            b.items.append(int(item))
+        else:
+            if b.item_weights is None:
+                self._rebuild_bucket(b)        # recover tree item weights
+            b.items.append(int(item))
+            b.item_weights.append(int(weight))
+        self._rebuild_bucket(b)
+        self._propagate_weight(bucket_id)
+        if item >= 0:
+            self.max_devices = max(self.max_devices, item + 1)
+
+    def remove_item(self, item: int) -> None:
+        """Detach an item from its parent and reweight the ancestry
+        (CrushWrapper::remove_item; buckets must be emptied first, like
+        the reference's non-recursive remove)."""
+        if item < 0 and item in self.buckets and self.buckets[item].items:
+            raise ValueError(f"bucket {item} not empty; move or remove its "
+                             f"items first")
+        parent = self.parent_of(item)
+        if parent is not None:
+            pb = self.buckets[parent]
+            idx = pb.items.index(item)
+            pb.items.pop(idx)
+            if pb.item_weights is not None:
+                pb.item_weights.pop(idx)
+            self._rebuild_bucket(pb)
+            self._propagate_weight(parent)
+        if item < 0:
+            self.buckets.pop(item, None)
+        self.item_names.pop(item, None)
+        self.device_classes.pop(item, None)
+
+    def move_bucket(self, bucket_id: int, new_parent_id: int) -> None:
+        """Re-home a bucket under a new parent, carrying its weight
+        (CrushWrapper::move_bucket = detach + insert)."""
+        if bucket_id not in self.buckets:
+            raise ValueError(f"no bucket {bucket_id}")
+        # cycle guard: the new parent must not live under the moved bucket
+        cur = new_parent_id
+        while cur is not None:
+            if cur == bucket_id:
+                raise ValueError("move would create a bucket cycle")
+            cur = self.parent_of(cur)
+        w = self.buckets[bucket_id].weight
+        parent = self.parent_of(bucket_id)
+        if parent is not None:
+            pb = self.buckets[parent]
+            idx = pb.items.index(bucket_id)
+            pb.items.pop(idx)
+            if pb.item_weights is not None:
+                pb.item_weights.pop(idx)
+            self._rebuild_bucket(pb)
+            self._propagate_weight(parent)
+        self.insert_item(bucket_id, w, new_parent_id)
+
+    def adjust_item_weight(self, item: int, weight: int) -> None:
+        """Set an item's weight in its parent bucket and propagate the
+        change to the root (CrushWrapper::adjust_item_weight)."""
+        parent = self.parent_of(item)
+        if parent is None:
+            raise ValueError(f"item {item} has no parent bucket")
+        pb = self.buckets[parent]
+        idx = pb.items.index(item)
+        if pb.alg == CRUSH_BUCKET_UNIFORM:
+            pb.item_weight = int(weight)
+        else:
+            pb.item_weights[idx] = int(weight)
+        self._rebuild_bucket(pb)
+        self._propagate_weight(parent)
+
+    def adjust_subtree_weight(self, bucket_id: int, device_weight: int
+                              ) -> int:
+        """Set EVERY device under ``bucket_id`` to ``device_weight`` and
+        reweight the tree (CrushWrapper::adjust_subtree_weight — the
+        ``crushtool --reweight-subtree`` operation).  Returns the number
+        of devices changed."""
+        changed = 0
+
+        def walk(bid: int) -> None:
+            nonlocal changed
+            b = self.buckets[bid]
+            for i, item in enumerate(b.items):
+                if item >= 0:
+                    if b.alg == CRUSH_BUCKET_UNIFORM:
+                        b.item_weight = int(device_weight)
+                    else:
+                        b.item_weights[i] = int(device_weight)
+                    changed += 1
+                elif item in self.buckets:     # skip dangling references
+                    walk(item)
+                    if b.item_weights is not None:
+                        b.item_weights[i] = self.buckets[item].weight
+            self._rebuild_bucket(b)
+
+        walk(bucket_id)
+        self._propagate_weight(bucket_id)
+        return changed
+
+    def reweight(self) -> None:
+        """Recompute every bucket weight bottom-up from the leaves
+        (builder.c crush_reweight)."""
+        done: set[int] = set()
+
+        def walk(bid: int) -> None:
+            if bid in done:
+                return
+            b = self.buckets[bid]
+            for i, item in enumerate(b.items):
+                if item < 0 and item in self.buckets:
+                    walk(item)
+                    if b.item_weights is not None:
+                        b.item_weights[i] = self.buckets[item].weight
+            self._rebuild_bucket(b)
+            done.add(bid)
+
+        for bid in self.buckets:
+            walk(bid)
+
     def add_rule(self, steps: list[tuple[int, int, int]],
                  ruleno: int | None = None) -> int:
         if ruleno is None:

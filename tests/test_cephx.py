@@ -1,0 +1,120 @@
+"""cephx ticket protocol: handshake, tickets, authorizers, rotation.
+
+Mirrors the reference's cephx flows (reference: src/auth/cephx/
+CephxProtocol.{h,cc}): challenge-response authentication, service
+tickets sealed under rotating secrets, authorizer verification with
+mutual auth and replay defense, expiry and renewal.
+"""
+import pytest
+
+from ceph_tpu.auth import (AuthError, CephxClient, CephxServiceHandler,
+                           KeyServer)
+
+
+@pytest.fixture()
+def world():
+    ks = KeyServer()
+    key = ks.create_entity("client.admin")
+    ks.rotate("osd")
+    client = CephxClient("client.admin", key)
+    osd = CephxServiceHandler("osd", ks)
+    return ks, client, osd
+
+
+class TestHandshake:
+    def test_full_mutual_auth(self, world):
+        ks, client, osd = world
+        client.authenticate(ks, now=0.0)
+        client.get_ticket(ks, "osd", now=0.0)
+        authz = client.build_authorizer("osd", now=1.0)
+        name, reply = osd.verify_authorizer(authz, now=1.0)
+        assert name == "client.admin"
+        client.verify_reply("osd", reply, authz.nonce)   # server proved too
+
+    def test_wrong_entity_key_rejected(self, world):
+        ks, _, _ = world
+        impostor = CephxClient("client.admin", b"\x00" * 32)
+        with pytest.raises(AuthError, match="bad authenticate"):
+            impostor.authenticate(ks, now=0.0)
+
+    def test_unknown_entity_rejected(self, world):
+        ks, _, _ = world
+        ghost = CephxClient("client.ghost", b"\x00" * 32)
+        with pytest.raises(AuthError, match="unknown entity"):
+            ghost.authenticate(ks, now=0.0)
+
+    def test_ticket_requires_session(self, world):
+        ks, client, _ = world
+        with pytest.raises(AuthError, match="authenticate first"):
+            client.get_ticket(ks, "osd", now=0.0)
+
+
+class TestAuthorizers:
+    def test_tampered_ticket_rejected(self, world):
+        ks, client, osd = world
+        client.authenticate(ks, now=0.0)
+        client.get_ticket(ks, "osd", now=0.0)
+        authz = client.build_authorizer("osd", now=0.0)
+        authz.blob = authz.blob[:-1] + bytes([authz.blob[-1] ^ 1])
+        with pytest.raises(AuthError, match="bad magic"):
+            osd.verify_authorizer(authz, now=0.0)
+
+    def test_replayed_authorizer_rejected(self, world):
+        ks, client, osd = world
+        client.authenticate(ks, now=0.0)
+        client.get_ticket(ks, "osd", now=0.0)
+        authz = client.build_authorizer("osd", now=0.0)
+        osd.verify_authorizer(authz, now=0.0)
+        with pytest.raises(AuthError, match="replay"):
+            osd.verify_authorizer(authz, now=0.0)
+
+    def test_wrong_service_rejected(self, world):
+        ks, client, _ = world
+        ks.rotate("mds")
+        client.authenticate(ks, now=0.0)
+        client.get_ticket(ks, "osd", now=0.0)
+        mds = CephxServiceHandler("mds", ks)
+        authz = client.build_authorizer("osd", now=0.0)
+        with pytest.raises(AuthError, match="wrong service"):
+            mds.verify_authorizer(authz, now=0.0)
+
+    def test_expired_ticket_rejected_then_renewed(self, world):
+        ks, client, osd = world
+        client.authenticate(ks, now=0.0)
+        client.get_ticket(ks, "osd", now=0.0)
+        late = KeyServer.TICKET_VALIDITY + 1
+        with pytest.raises(AuthError, match="expired"):
+            client.build_authorizer("osd", now=late)
+        # renewal: re-authenticate, new ticket works
+        client.authenticate(ks, now=late)
+        client.get_ticket(ks, "osd", now=late)
+        authz = client.build_authorizer("osd", now=late + 1)
+        name, _ = osd.verify_authorizer(authz, now=late + 1)
+        assert name == "client.admin"
+
+
+class TestRotation:
+    def test_old_generation_valid_within_grace(self, world):
+        """One rotation after ticket issue: the service still holds the
+        previous generation and accepts the ticket (rotation grace)."""
+        ks, client, osd = world
+        client.authenticate(ks, now=0.0)
+        client.get_ticket(ks, "osd", now=0.0)
+        ks.rotate("osd")                        # one generation forward
+        authz = client.build_authorizer("osd", now=1.0)
+        name, _ = osd.verify_authorizer(authz, now=1.0)
+        assert name == "client.admin"
+
+    def test_two_rotations_invalidate_ticket(self, world):
+        ks, client, osd = world
+        client.authenticate(ks, now=0.0)
+        client.get_ticket(ks, "osd", now=0.0)
+        ks.rotate("osd")
+        ks.rotate("osd")                        # grace window passed
+        authz = client.build_authorizer("osd", now=1.0)
+        with pytest.raises(AuthError, match="expired"):
+            osd.verify_authorizer(authz, now=1.0)
+        # refresh: new ticket under the current generation works
+        client.get_ticket(ks, "osd", now=1.0)
+        authz2 = client.build_authorizer("osd", now=1.0)
+        assert osd.verify_authorizer(authz2, now=1.0)[0] == "client.admin"
