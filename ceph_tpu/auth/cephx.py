@@ -112,6 +112,7 @@ class KeyServer:
         self.entity_keys: dict[str, bytes] = {}
         self.rotating: dict[str, _RotatingSecret] = {}
         self._pending: dict[str, bytes] = {}      # name -> server_challenge
+        self._sessions: dict[str, bytes] = {}     # name -> session key
 
     def create_entity(self, name: str) -> bytes:
         key = os.urandom(32)
@@ -158,13 +159,12 @@ class KeyServer:
         session_key = os.urandom(32)
         env = seal(key, {"session_key": session_key,
                          "expires": now + self.TICKET_VALIDITY})
-        self._sessions = getattr(self, "_sessions", {})
         self._sessions[name] = session_key
         return env
 
     # CEPHX_GET_PRINCIPAL_SESSION_KEY: service tickets under the session
     def issue_service_ticket(self, name: str, service: str, now: float):
-        sessions = getattr(self, "_sessions", {})
+        sessions = self._sessions
         if name not in sessions:
             raise AuthError(f"{name} has no session")
         sid, svc_secret = self.service_secret(service)

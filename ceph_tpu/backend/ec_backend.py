@@ -226,11 +226,22 @@ class ECBackend(PGBackend):
             pieces: list[tuple[int, bytes]] = []
             for off, length in will_write:
                 pieces.append((off, self._assemble_extent(op, oid, objop, off, length)))
-            # ONE batched encode over all extents' stripes
+            # ONE batched encode over all extents' stripes — or adopt the
+            # chunks a cross-op batch encoder (ecutil.encode_many via
+            # put_many) precomputed, IF the plan really is the single
+            # full-extent write they were computed for
             logical = np.concatenate(
                 [np.frombuffer(b, dtype=np.uint8) for _, b in pieces])
-            with self.perf.time("encode_time"):
-                encoded = ecutil.encode(self.sinfo, self.ec_impl, logical)
+            pre = objop.precomputed_chunks
+            if (pre is not None and len(pieces) == 1 and
+                    pieces[0][0] == 0 and
+                    logical.tobytes() == getattr(objop, "precomputed_for",
+                                                 None)):
+                encoded = {c: np.asarray(pre[c], dtype=np.uint8)
+                           for c in range(n)}
+            else:
+                with self.perf.time("encode_time"):
+                    encoded = ecutil.encode(self.sinfo, self.ec_impl, logical)
             self.perf.inc("stripe_bytes_encoded", int(logical.nbytes))
             if op.tracked:
                 op.tracked.mark_event("encoded")

@@ -247,6 +247,45 @@ def encode(sinfo: StripeInfo, ec_impl, data: bytes | np.ndarray,
     return {i: encoded[i] for i in want}
 
 
+def encode_many(sinfo: StripeInfo, ec_impl,
+                bufs: list[bytes | np.ndarray]) -> list[dict[int, np.ndarray]]:
+    """Encode MANY stripe-aligned buffers (different objects, different
+    PGs) in ONE ``encode_chunks`` call — the cross-op/cross-PG coalescing
+    the per-op :func:`encode` cannot do.  All buffers share the codec, so
+    their shard streams concatenate along the byte axis and one device
+    dispatch covers the lot; results split back per buffer.
+
+    Returns one ``{chunk: bytes}`` dict per input buffer, identical to
+    calling :func:`encode` per buffer."""
+    k = ec_impl.get_data_chunk_count()
+    n = ec_impl.get_chunk_count()
+    arrs = []
+    for data in bufs:
+        buf = np.frombuffer(data, dtype=np.uint8) \
+            if isinstance(data, (bytes, bytearray)) \
+            else np.asarray(data, dtype=np.uint8)
+        assert len(buf) % sinfo.stripe_width == 0, \
+            f"len {len(buf)} not stripe aligned"
+        arrs.append(buf)
+    shard_lens = [(len(b) // sinfo.stripe_width) * sinfo.chunk_size
+                  for b in arrs]
+    streams = [_to_shard_major(b, k, sinfo.chunk_size) for b in arrs]
+    data_shards = np.concatenate(streams, axis=1) if len(streams) > 1 \
+        else streams[0]
+    total = data_shards.shape[1]
+    encoded = {ec_impl.chunk_index(i): data_shards[i].copy()
+               for i in range(k)}
+    for i in range(k, n):
+        encoded[ec_impl.chunk_index(i)] = np.zeros(total, dtype=np.uint8)
+    ec_impl.encode_chunks(set(range(n)), encoded)
+    out: list[dict[int, np.ndarray]] = []
+    off = 0
+    for ln in shard_lens:
+        out.append({c: encoded[c][off:off + ln] for c in range(n)})
+        off += ln
+    return out
+
+
 def _as_u8(v) -> np.ndarray:
     if isinstance(v, (bytes, bytearray, memoryview)):
         return np.frombuffer(v, dtype=np.uint8)

@@ -22,6 +22,14 @@ class ObjectOperation:
     # (truncate_before_writes, truncate_after_writes) — ECTransaction.h:71,154
     truncate: tuple[int, int] | None = None
     source: str | None = None  # rename/clone source oid
+    # pre-encoded chunk streams ({chunk index: bytes-like}) supplied by a
+    # cross-op batch encoder (ecutil.encode_many): the backend uses them
+    # instead of encoding, IF the assembled write bytes equal
+    # ``precomputed_for`` exactly (a plan that turned into an RMW falls
+    # back to a live encode) — the cross-PG coalescing hook SURVEY §3.2
+    # marks as the main TPU restructuring
+    precomputed_chunks: dict | None = None
+    precomputed_for: bytes | None = None
 
     def write(self, offset: int, data: bytes) -> "ObjectOperation":
         self.buffer_updates.append((offset, bytes(data)))

@@ -200,6 +200,15 @@ class CrushMap:
         else:                       # straw2
             b.weight = sum(b.item_weights)
 
+    def _ensure_item_weights(self, b: Bucket) -> None:
+        """Tree buckets from golden dumps carry only the node table;
+        recover per-item weights BEFORE any mutation touches them (a
+        post-mutation recovery would read stale/misaligned leaves)."""
+        if b.item_weights is None and b.alg == CRUSH_BUCKET_TREE and \
+                b.node_weights is not None:
+            b.item_weights = [b.node_weights[(i << 1) + 1]
+                              for i in range(len(b.items))]
+
     def _propagate_weight(self, bucket_id: int) -> None:
         """Push a bucket's recomputed weight into its ancestors
         (CrushWrapper::adjust_item_weight's upward walk)."""
@@ -209,11 +218,25 @@ class CrushMap:
             if parent is None:
                 return
             pb = self.buckets[parent]
+            self._ensure_item_weights(pb)
             idx = pb.items.index(cur)
             if pb.item_weights is not None:
                 pb.item_weights[idx] = self.buckets[cur].weight
             self._rebuild_bucket(pb)
             cur = parent
+
+    def _check_no_cycle(self, item: int, bucket_id: int) -> None:
+        """Attaching ``item`` under ``bucket_id`` must not close a loop
+        (the reference's _search_item_exists/loop checks)."""
+        if item >= 0:
+            return
+        cur = bucket_id
+        while cur is not None:
+            if cur == item:
+                raise ValueError(
+                    f"inserting {item} under {bucket_id} would create a "
+                    f"bucket cycle")
+            cur = self.parent_of(cur)
 
     def insert_item(self, item: int, weight: int, bucket_id: int) -> None:
         """Add a device/bucket to a bucket and reweight the ancestry
@@ -221,6 +244,7 @@ class CrushMap:
         b = self.buckets[bucket_id]
         if item in b.items:
             raise ValueError(f"item {item} already in bucket {bucket_id}")
+        self._check_no_cycle(item, bucket_id)
         if b.alg == CRUSH_BUCKET_UNIFORM:
             # builder.c crush_bucket_add_item: uniform buckets reject a
             # mismatched weight (-EINVAL) instead of silently dropping it
@@ -232,8 +256,7 @@ class CrushMap:
                 b.item_weight = int(weight)
             b.items.append(int(item))
         else:
-            if b.item_weights is None:
-                self._rebuild_bucket(b)        # recover tree item weights
+            self._ensure_item_weights(b)
             b.items.append(int(item))
             b.item_weights.append(int(weight))
         self._rebuild_bucket(b)
@@ -251,6 +274,7 @@ class CrushMap:
         parent = self.parent_of(item)
         if parent is not None:
             pb = self.buckets[parent]
+            self._ensure_item_weights(pb)
             idx = pb.items.index(item)
             pb.items.pop(idx)
             if pb.item_weights is not None:
@@ -273,10 +297,21 @@ class CrushMap:
             if cur == bucket_id:
                 raise ValueError("move would create a bucket cycle")
             cur = self.parent_of(cur)
+        # validate the DESTINATION before detaching: a failed insert after
+        # the detach would orphan the whole subtree
         w = self.buckets[bucket_id].weight
+        dest = self.buckets[new_parent_id]
+        if bucket_id in dest.items:
+            raise ValueError(f"{bucket_id} already under {new_parent_id}")
+        if dest.alg == CRUSH_BUCKET_UNIFORM and dest.items and \
+                w != (dest.item_weight or 0):
+            raise ValueError(
+                f"uniform bucket {new_parent_id} holds items of weight "
+                f"{dest.item_weight:#x}; cannot move in weight {w:#x}")
         parent = self.parent_of(bucket_id)
         if parent is not None:
             pb = self.buckets[parent]
+            self._ensure_item_weights(pb)
             idx = pb.items.index(bucket_id)
             pb.items.pop(idx)
             if pb.item_weights is not None:
@@ -292,6 +327,7 @@ class CrushMap:
         if parent is None:
             raise ValueError(f"item {item} has no parent bucket")
         pb = self.buckets[parent]
+        self._ensure_item_weights(pb)
         idx = pb.items.index(item)
         if pb.alg == CRUSH_BUCKET_UNIFORM:
             pb.item_weight = int(weight)
