@@ -105,14 +105,28 @@ def xor_reduce(data: jax.Array) -> jax.Array:
 
 
 def _runs_on_tpu(data) -> bool:
-    """Where will this op execute?  The data's committed device wins over
-    the default backend (a CPU-committed array on a TPU host runs on CPU,
-    where the Mosaic kernel cannot lower)."""
+    """Where will this op execute?  For concrete arrays the committed
+    device wins (a CPU-committed array on a TPU host runs on CPU, where
+    the Mosaic kernel cannot lower).  Under jit there is no committed
+    device to inspect, so the runtime's default device decides — jitting
+    over a CPU-committed array on a TPU host is unsupported (pass
+    variant='bitslice' explicitly for that)."""
     try:
         devices = getattr(data, "devices", None)
         if callable(devices):
-            return all(d.platform == "tpu" for d in data.devices())
-        return jax.default_backend() == "tpu"
+            try:
+                devs = devices()
+            except Exception:
+                # Tracer.devices() raises ConcretizationTypeError: a traced
+                # array has no committed device.  This MUST fall through to
+                # the runtime check below — treating it as "not TPU" silently
+                # routed every jitted caller to the XLA fallback instead of
+                # the pallas kernel (observed 3x throughput loss on the
+                # tunneled backend).
+                devs = None
+            if devs:
+                return all(d.platform == "tpu" for d in devs)
+        return jax.devices()[0].platform == "tpu"
     except Exception:          # backend init failure -> act like CPU
         return False
 
@@ -170,3 +184,28 @@ def gf_apply(mat, data, variant: str = "auto"):
     if variant == "lookup":
         return gf_apply_lookup(mat, data)
     raise ValueError(f"unknown variant {variant!r}")
+
+
+@jax.jit
+def xor_apply(W, packets):
+    """GF(2) XOR-matmul on the MXU: out[r] = XOR over i with W[r,i]==1 of
+    packets[i], bytewise.
+
+    W: [R, K] 0/1 uint8, packets: [K, P] uint8 -> [R, P] uint8.  The device
+    path for bitmatrix codes (liberation/blaum_roth/liber8tion — see
+    gf/bitmatrix.py): a byte XOR is 8 independent GF(2) sums, so unpack the
+    bit-planes along the column axis, run ONE int8 matmul (exact: 0/1
+    values, <= K terms in int32), take mod 2, and repack.
+    """
+    W = jnp.asarray(W, dtype=jnp.int8)
+    packets = jnp.asarray(packets, dtype=jnp.uint8)
+    P = packets.shape[1]
+    planes = jnp.concatenate(
+        [(packets >> b) & 1 for b in range(8)], axis=1).astype(jnp.int8)
+    acc = jax.lax.dot_general(
+        W, planes, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32) & 1            # [R, 8P]
+    out = acc[:, :P]
+    for b in range(1, 8):
+        out = out | (acc[:, b * P:(b + 1) * P] << b)
+    return out.astype(jnp.uint8)

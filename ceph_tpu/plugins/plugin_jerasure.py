@@ -2,16 +2,34 @@
 
 Accepts the reference jerasure plugin's profile shape
 (reference: src/erasure-code/jerasure/ErasureCodeJerasure.h:81-252):
-techniques reed_sol_van (default, k=7 m=3), reed_sol_r6_op (m forced to 2,
-parity rows P=XOR / Q=sum 2^j d_j — exactly the geometric Vandermonde rows),
-cauchy_orig/cauchy_good (Cauchy matrices).  The bitmatrix-only techniques
-(liberation, blaum_roth, liber8tion) target word-level XOR scheduling that
-has no TPU analog and are rejected with a clear error.
+
+- reed_sol_van (default, k=7 m=3), reed_sol_r6_op (m forced to 2, parity
+  rows P=XOR / Q=sum 2^j d_j — exactly the geometric Vandermonde rows),
+  cauchy_orig/cauchy_good (Cauchy matrices): mapped onto the GF(2^8) byte
+  codec (ceph_tpu.ops.RSCodec).
+- liberation, blaum_roth, liber8tion: true bitmatrix RAID-6 codes with
+  jerasure's packet layout, run as GF(2) XOR-matmuls on the MXU
+  (gf/bitmatrix.py + ops.rs_kernels.xor_apply).  The reference compiles
+  these into word-XOR schedules (ErasureCodeJerasure.cc:453-509); on TPU
+  the bitmatrix apply is itself the native operation, so no scheduling
+  pass exists.
+
+Parameter envelopes follow the reference exactly: liberation needs prime
+w > 2, k <= w, packetsize set and a multiple of 4
+(ErasureCodeJerasure.cc:368-414); blaum_roth needs w+1 prime with w=7
+tolerated for backward compat (:461-471); liber8tion forces w=8, m=2,
+k <= 8 (:484-505).
 """
 from __future__ import annotations
 
+from typing import Mapping
+
+import numpy as np
+
 from .. import __version__
+from ..gf import bitmatrix as bm
 from .plugin_jax_rs import ErasureCodeJaxRS
+from .base import ErasureCode
 from .interface import ErasureCodeProfile
 from .registry import ErasureCodePlugin, ErasureCodePluginRegistry
 
@@ -21,19 +39,17 @@ _TECHNIQUE_MAP = {
     "cauchy_orig": "cauchy",
     "cauchy_good": "cauchy",
 }
-_UNSUPPORTED = ("liberation", "blaum_roth", "liber8tion")
+_BITMATRIX = ("liberation", "blaum_roth", "liber8tion")
+DEFAULT_PACKETSIZE = "2048"     # ErasureCodeJerasure.h:139
 
 
 class ErasureCodeJerasureCompat(ErasureCodeJaxRS):
     def init(self, profile: ErasureCodeProfile) -> None:
         technique = profile.get("technique") or "reed_sol_van"
-        if technique in _UNSUPPORTED:
-            raise ValueError(
-                f"technique={technique} is a CPU bitmatrix/XOR-schedule "
-                f"technique with no TPU mapping; use one of "
-                f"{sorted(_TECHNIQUE_MAP)}")
         if technique not in _TECHNIQUE_MAP:
-            raise ValueError(f"unknown jerasure technique {technique}")
+            raise ValueError(
+                f"unknown jerasure technique {technique}; bitmatrix "
+                f"techniques {_BITMATRIX} use ErasureCodeJerasureBitmatrix")
         if technique == "reed_sol_r6_op":
             # RAID6: m is always 2 (ErasureCodeJerasure.h:111-140)
             profile["m"] = "2"
@@ -44,10 +60,134 @@ class ErasureCodeJerasureCompat(ErasureCodeJaxRS):
         self._profile["technique"] = technique
 
 
+class ErasureCodeJerasureBitmatrix(ErasureCode):
+    """liberation / blaum_roth / liber8tion over packets on the MXU."""
+
+    DEFAULT_K = "2"             # ErasureCodeJerasure.h:202-204
+    DEFAULT_W = {"liberation": "7", "blaum_roth": "7", "liber8tion": "8"}
+
+    def __init__(self, technique: str):
+        super().__init__()
+        self.technique = technique
+        self.k = 0
+        self.m = 2
+        self.w = 0
+        self.packetsize = 0
+        self.coding: np.ndarray | None = None
+        self.device = "auto"
+
+    def init(self, profile: ErasureCodeProfile) -> None:
+        super().init(profile)
+        self.parse_mapping(profile)
+        technique = self.technique
+        if technique == "liber8tion":
+            # w and m are not parameters (ErasureCodeJerasure.cc:484-495)
+            profile.pop("w", None)
+            profile.pop("m", None)
+        self.k = self.to_int("k", profile, self.DEFAULT_K)
+        self.m = self.to_int("m", profile, "2")
+        self.w = self.to_int("w", profile, self.DEFAULT_W[technique])
+        self.packetsize = self.to_int("packetsize", profile,
+                                      DEFAULT_PACKETSIZE)
+        self.device = self.to_string("device", profile, "auto")
+        if self.device not in ("jax", "numpy", "auto"):
+            raise ValueError(f"device={self.device} must be jax|numpy|auto")
+        if "jax-threshold" in profile:
+            self.jax_threshold: int | None = self.to_int(
+                "jax-threshold", profile, "65536")
+        else:
+            self.jax_threshold = None
+        from ..common.context import default_context
+        self._conf = default_context().conf
+        self.sanity_check_k_m(self.k, self.m)
+        if self.m != 2:
+            raise ValueError(
+                f"m={self.m}: {technique} is a RAID-6 code, m must be 2")
+        if self.packetsize <= 0:
+            raise ValueError("packetsize must be set")
+        if self.packetsize % 4:
+            raise ValueError(
+                f"packetsize={self.packetsize} must be a multiple of 4")
+        if technique == "liberation":
+            self.coding = bm.liberation_bitmatrix(self.k, self.w)
+        elif technique == "blaum_roth":
+            self.coding = bm.blaum_roth_bitmatrix(self.k, self.w)
+        else:
+            self.coding = bm.liber8tion_bitmatrix(self.k)
+        if self.chunk_mapping and len(self.chunk_mapping) != self.k + self.m:
+            raise ValueError(
+                f"mapping maps {len(self.chunk_mapping)} chunks "
+                f"instead of {self.k + self.m}")
+        self._profile = dict(profile)
+        self._profile["technique"] = technique
+
+    # -- sizing ------------------------------------------------------------
+
+    def get_chunk_count(self) -> int:
+        return self.k + self.m
+
+    def get_data_chunk_count(self) -> int:
+        return self.k
+
+    def get_alignment(self) -> int:
+        # chunks must split into whole groups of w packets
+        # (cf. ErasureCodeJerasureLiberation::get_alignment,
+        # ErasureCodeJerasure.cc:367-373)
+        return self.w * self.packetsize
+
+    # -- encode/decode -----------------------------------------------------
+
+    def _apply(self, W: np.ndarray, packets: np.ndarray) -> np.ndarray:
+        if self.device == "auto":
+            # same routing policy as ErasureCodeJaxRS._route: profile
+            # jax-threshold pins the cutoff, else the live config option
+            cutoff = self.jax_threshold
+            if cutoff is None:
+                cutoff = int(self._conf.get("ec_device_threshold_bytes"))
+            use_jax = packets.nbytes >= cutoff
+        else:
+            use_jax = self.device == "jax"
+        if use_jax:
+            from ..ops.rs_kernels import xor_apply
+            import jax
+            return np.asarray(jax.device_get(xor_apply(W, packets)))
+        return bm.xor_apply_host(W, packets)
+
+    def encode_chunks(self, want_to_encode: set,
+                      encoded: dict[int, np.ndarray]) -> None:
+        data = np.stack([encoded[self.chunk_index(i)] for i in range(self.k)])
+        packets = bm.to_packets(data, self.w, self.packetsize)
+        out = self._apply(self.coding, packets)
+        parity = bm.from_packets(out, self.w, self.packetsize)
+        for i in range(self.m):
+            encoded[self.chunk_index(self.k + i)][:] = parity[i]
+
+    def decode_chunks(self, want_to_read: set,
+                      chunks: Mapping[int, np.ndarray],
+                      decoded: dict[int, np.ndarray]) -> None:
+        erasures = [i for i in range(self.k + self.m) if i not in chunks]
+        if not erasures:
+            return
+        avail, erasures_l = self.remap_for_decode(
+            {i: decoded[i] for i in chunks}, erasures)
+        D, src = bm.decode_bitmatrix(
+            self.coding, self.k, self.w, erasures_l, available=list(avail))
+        stack = np.stack([np.asarray(avail[c], dtype=np.uint8) for c in src])
+        packets = bm.to_packets(stack, self.w, self.packetsize)
+        rec = bm.from_packets(self._apply(D, packets), self.w,
+                              self.packetsize)
+        for row, e in enumerate(sorted(erasures_l)):
+            decoded[self.chunk_index(e)][:] = rec[row]
+
+
 class ErasureCodePluginJerasure(ErasureCodePlugin):
     def factory(self, directory: str,
-                profile: ErasureCodeProfile) -> ErasureCodeJerasureCompat:
-        instance = ErasureCodeJerasureCompat()
+                profile: ErasureCodeProfile) -> ErasureCode:
+        technique = profile.get("technique") or "reed_sol_van"
+        if technique in _BITMATRIX:
+            instance: ErasureCode = ErasureCodeJerasureBitmatrix(technique)
+        else:
+            instance = ErasureCodeJerasureCompat()
         instance.init(dict(profile))
         return instance
 

@@ -182,9 +182,13 @@ def test_jerasure_compat(registry):
                           {"k": "4", "m": "3", "technique": "reed_sol_r6_op",
                            "device": "numpy"})
     assert r6.get_coding_chunk_count() == 2
-    with pytest.raises(ValueError, match="bitmatrix"):
-        registry.factory("jerasure", "", {"k": "4", "m": "2",
-                                          "technique": "liber8tion"})
+    # bitmatrix techniques route to the packet-layout GF(2) codec
+    # (full coverage in tests/test_bitmatrix.py)
+    lib = registry.factory("jerasure", "", {"k": "4", "m": "2",
+                                            "technique": "liber8tion",
+                                            "packetsize": "8",
+                                            "device": "numpy"})
+    assert lib.get_profile()["technique"] == "liber8tion"
 
 
 def test_isa_compat(registry):

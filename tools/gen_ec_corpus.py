@@ -38,6 +38,12 @@ PROFILES = [
     ("jax_rs", {"k": "6", "m": "3", "technique": "vandermonde"}),
     ("jax_rs", {"k": "4", "m": "2", "technique": "reed_sol_van",
                 "mapping": "_DDD_D"}),
+    ("jerasure", {"k": "4", "m": "2", "technique": "liberation",
+                  "w": "7", "packetsize": "8"}),
+    ("jerasure", {"k": "4", "m": "2", "technique": "blaum_roth",
+                  "w": "6", "packetsize": "8"}),
+    ("jerasure", {"k": "6", "m": "2", "technique": "liber8tion",
+                  "packetsize": "8"}),
     ("cpp_rs", {"k": "4", "m": "2", "technique": "reed_sol_van"}),
     ("cpp_rs", {"k": "8", "m": "4", "technique": "cauchy"}),
     ("xor", {"k": "3", "m": "1"}),
