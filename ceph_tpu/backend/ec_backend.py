@@ -218,6 +218,24 @@ class ECBackend(PGBackend):
                     for chunk, shard in enumerate(self.acting):
                         shard_txns[shard].truncate(GObject(oid, shard), t_chunk)
                     hinfo.set_total_chunk_size_clear_hash(t_chunk)
+            if objop.omap_ops:
+                # EC pools do not support omap, exactly like the reference
+                # (PrimaryLogPG rejects with -EOPNOTSUPP before it gets
+                # here; this is the backend's own guard)
+                raise ValueError("EC pools do not support omap operations")
+            if objop.attr_updates and not is_delete:
+                # object attrs replicate to every shard (the reference
+                # stores xattrs on each shard's ghobject, PGTransaction.h).
+                # A delete+recreate vector (delete_first AND new writes)
+                # keeps its re-staged attrs: the remove is already queued
+                # above, so these setattrs land on the fresh object.
+                for shard in self.acting:
+                    obj = GObject(oid, shard)
+                    for name, value in objop.attr_updates.items():
+                        if value is None:
+                            shard_txns[shard].rmattr(obj, name)
+                        else:
+                            shard_txns[shard].setattr(obj, name, value)
             if not will_write:
                 if not objop.delete_first:
                     self._persist_hinfo(oid, hinfo, shard_txns)

@@ -154,5 +154,11 @@ class FileStore:
     def get_omap(self, obj: GObject) -> dict[str, bytes]:
         return self._mem.get_omap(obj)
 
+    def get_omap_header(self, obj: GObject) -> bytes:
+        return self._mem.get_omap_header(obj)
+
+    def getattrs(self, obj: GObject):
+        return self._mem.getattrs(obj)
+
     def list_objects(self) -> list[GObject]:
         return self._mem.list_objects()

@@ -27,6 +27,11 @@ class _Object:
     data: bytearray = field(default_factory=bytearray)
     xattrs: dict[str, Any] = field(default_factory=dict)
     omap: dict[str, bytes] = field(default_factory=dict)
+    omap_header: bytes = b""
+
+    def copy(self) -> "_Object":
+        return _Object(bytearray(self.data), dict(self.xattrs),
+                       dict(self.omap), self.omap_header)
 
 
 class Transaction:
@@ -75,6 +80,14 @@ class Transaction:
         self.ops.append(("omap_rmkeys", obj, list(keys)))
         return self
 
+    def omap_clear(self, obj: GObject) -> "Transaction":
+        self.ops.append(("omap_clear", obj))
+        return self
+
+    def omap_setheader(self, obj: GObject, header: bytes) -> "Transaction":
+        self.ops.append(("omap_setheader", obj, bytes(header)))
+        return self
+
     def append(self, other: "Transaction") -> "Transaction":
         self.ops.extend(other.ops)
         return self
@@ -106,8 +119,7 @@ class MemStore:
         for obj in touched:
             o = self.objects.get(obj)
             if o is not None:
-                staged[obj] = _Object(bytearray(o.data), dict(o.xattrs),
-                                      dict(o.omap))
+                staged[obj] = o.copy()
         for op in t.ops:
             self._apply(staged, op)
         for obj in touched:
@@ -147,8 +159,7 @@ class MemStore:
             objs.setdefault(op[1], _Object())
         elif kind == "clone":
             _, src, dst = op
-            s = objs.get(src, _Object())
-            objs[dst] = _Object(bytearray(s.data), dict(s.xattrs), dict(s.omap))
+            objs[dst] = objs.get(src, _Object()).copy()
         elif kind == "setattr":
             _, obj, name, value = op
             objs.setdefault(obj, _Object()).xattrs[name] = value
@@ -163,6 +174,12 @@ class MemStore:
             o = objs.setdefault(obj, _Object())
             for key in keys:
                 o.omap.pop(key, None)
+        elif kind == "omap_clear":
+            o = objs.setdefault(op[1], _Object())
+            o.omap.clear()
+            o.omap_header = b""
+        elif kind == "omap_setheader":
+            objs.setdefault(op[1], _Object()).omap_header = op[2]
         else:
             raise ValueError(f"unknown op {kind}")
 
@@ -196,6 +213,18 @@ class MemStore:
         if o is None:
             raise FileNotFoundError(obj)
         return dict(o.omap)
+
+    def get_omap_header(self, obj: GObject) -> bytes:
+        o = self.objects.get(obj)
+        if o is None:
+            raise FileNotFoundError(obj)
+        return o.omap_header
+
+    def getattrs(self, obj: GObject) -> dict[str, Any]:
+        o = self.objects.get(obj)
+        if o is None:
+            raise FileNotFoundError(obj)
+        return dict(o.xattrs)
 
     def list_objects(self) -> list[GObject]:
         return sorted(self.objects, key=lambda g: (g.oid, g.shard))

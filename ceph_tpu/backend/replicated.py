@@ -29,7 +29,10 @@ from .messages import ECSubRead, ECSubReadReply, MessageBus
 from .pg_backend import Op, OSDShard, PGBackend, RecoveryOp
 from ..osd.pg_log import OP_DELETE, OP_MODIFY
 
-VERSION_KEY = "_version"      # object_info_t::version analog
+VERSION_KEY = "@version"      # object_info_t::version analog; the "@"
+                              # prefix keeps it out of the user-xattr
+                              # namespace ("_"+name) so a user xattr
+                              # named "version" cannot collide with it
 
 
 class ReplicatedBackend(PGBackend):
@@ -84,6 +87,22 @@ class ReplicatedBackend(PGBackend):
                     t.truncate(obj, objop.truncate[0])
                 for w_off, data in objop.buffer_updates:
                     t.write(obj, w_off, data)
+                for name, value in objop.attr_updates.items():
+                    if value is None:
+                        t.rmattr(obj, name)
+                    else:
+                        t.setattr(obj, name, value)
+                for oop in objop.omap_ops:
+                    if oop[0] == "set":
+                        t.omap_setkeys(obj, oop[1])
+                    elif oop[0] == "rm":
+                        t.omap_rmkeys(obj, oop[1])
+                    elif oop[0] == "clear":
+                        t.omap_clear(obj)
+                    elif oop[0] == "header":
+                        t.omap_setheader(obj, oop[1])
+                    else:
+                        raise ValueError(f"unknown omap op {oop[0]!r}")
                 if not is_delete:
                     t.setattr(obj, VERSION_KEY, entry.version)
             self.perf.inc("stripe_bytes_encoded", sum(

@@ -30,9 +30,24 @@ class ObjectOperation:
     # marks as the main TPU restructuring
     precomputed_chunks: dict | None = None
     precomputed_for: bytes | None = None
+    # object attribute updates (name -> value, None = remove), applied to
+    # every shard like the reference's per-shard xattr replication
+    # (PGTransaction::ObjectOperation::attr_updates, src/osd/PGTransaction.h)
+    attr_updates: dict[str, object] = field(default_factory=dict)
+    # omap mutations in order: ("set", {k: v}) | ("rm", [k]) | ("clear",)
+    # — replicated pools only; EC pools reject omap like the reference
+    omap_ops: list[tuple] = field(default_factory=list)
 
     def write(self, offset: int, data: bytes) -> "ObjectOperation":
         self.buffer_updates.append((offset, bytes(data)))
+        return self
+
+    def setattr(self, name: str, value) -> "ObjectOperation":
+        self.attr_updates[name] = value
+        return self
+
+    def rmattr(self, name: str) -> "ObjectOperation":
+        self.attr_updates[name] = None
         return self
 
 

@@ -77,6 +77,11 @@ class PGGroup:
         for osd in acting:
             if osd != primary:
                 OSDShard(osd, self.bus, store=mk(osd))
+        # the primary's object-op engine (PrimaryLogPG analog): executes
+        # client op vectors atomically on top of the backend pipeline
+        from .osd.primary_log_pg import PrimaryLogPG
+        self.engine = PrimaryLogPG(
+            self.backend, pool_type="replicated" if ec_impl is None else "ec")
 
     def shutdown(self, discard_stores: bool = False) -> None:
         # closes the primary's store too; discard skips the final
@@ -122,6 +127,11 @@ class MiniCluster:
         self.pools: dict[int, dict] = {}       # pool_id -> {pgs, pool, ec}
         self.pool_ids: dict[str, int] = {}
         self.objects: dict[int, set[str]] = {}  # pool_id -> written oids
+        # one daemon shell per OSD: sharded mClock op queue + superblock
+        # (client ops route through the primary's daemon — OSD.cc:9490)
+        from .osd.osd_daemon import OSDDaemon
+        self.osds = {o: OSDDaemon(o, meta_store=self._osd_meta_store(o))
+                     for o in range(n_osds)}
 
     # -- pool creation (the mon's osd pool create path) --------------------
 
@@ -191,6 +201,7 @@ class MiniCluster:
                               store_factory=self._store_factory(
                                   pool.pool_id, ps),
                               epoch=self.osdmap.epoch)
+            self.osds[acting[0]].register_pg(pgid, pgs[ps])
         self.pools[pool.pool_id] = {"pool": pool, "pgs": pgs, "ec": ec}
         self.pool_ids[name] = pool.pool_id
         self._save_meta()
@@ -206,6 +217,14 @@ class MiniCluster:
         def factory(osd, _pid=pool_id, _ps=ps):
             return FileStore(self.data_dir / f"osd.{osd}" / f"pg.{_pid}.{_ps}")
         return factory
+
+    def _osd_meta_store(self, osd: int):
+        """The daemon's superblock store (FileStore in durable mode)."""
+        if self.data_dir is None:
+            from .backend.memstore import MemStore
+            return MemStore()
+        from .backend.filestore import FileStore
+        return FileStore(self.data_dir / f"osd.{osd}" / "meta")
 
     def _save_meta(self) -> None:
         """Persist what cannot be rebuilt from the shard stores: the pool
@@ -351,6 +370,46 @@ class MiniCluster:
             raise BlockedWriteError(
                 f"batch writes blocked on inactive PGs: {missing}")
 
+    def operate(self, pool_id: int, oid: str, op,
+                deliver: bool = True):
+        """Execute a librados-style op vector atomically on ``oid``
+        through the primary's op engine (IoCtx::operate →
+        PrimaryLogPG::do_osd_ops).  Returns the MOSDOpReply; raises
+        IOError on a negative overall result.  With ``deliver=False`` the
+        op is only queued on the primary's daemon (returns None); the
+        caller drains the daemon and delivers the bus itself — batch
+        submission, like put(deliver=False)."""
+        from .backend.memstore import GObject
+        from .osd.osd_ops import MOSDOp
+        g = self.pg_group(pool_id, oid)
+        out: list = []
+        # through the primary's daemon: epoch gate + mClock shard queue
+        daemon = self.osds[g.backend.whoami]
+        res = daemon.ms_dispatch(
+            g.pgid, MOSDOp(oid=oid, ops=op.ops, epoch=self.osdmap.epoch),
+            out.append)
+        if res is not None:
+            raise IOError(f"op on {oid} bounced as stale: {res}")
+        if not deliver:
+            return None
+        daemon.drain()
+        g.bus.deliver_all()
+        if not out:
+            raise BlockedWriteError(
+                f"op on {oid} blocked: PG {g.pgid} inactive")
+        reply = out[0]
+        if reply.result < 0:
+            err = IOError(f"op on {oid} failed: result {reply.result}")
+            err.errno = reply.result
+            err.reply = reply
+            raise err
+        # object bookkeeping from ground truth: the primary's store
+        if g.backend.local_shard.store.exists(GObject(oid, g.backend.whoami)):
+            self.objects.setdefault(pool_id, set()).add(oid)
+        else:
+            self.objects.get(pool_id, set()).discard(oid)
+        return reply
+
     def get(self, pool_id: int, oid: str, length: int) -> bytes:
         g = self.pg_group(pool_id, oid)
         out = {}
@@ -401,6 +460,9 @@ class MiniCluster:
         for p in self.pools.values():
             for g in p["pgs"].values():
                 g.shutdown()
+        for d in self.osds.values():
+            if hasattr(d.meta_store, "close"):
+                d.meta_store.close()
 
     # -- control plane -----------------------------------------------------
 
@@ -464,6 +526,11 @@ class MiniCluster:
             new.backend.submit_transaction(PGTransaction().write(oid, 0, data))
             new.bus.deliver_all()
         self.pools[pool_id]["pgs"][ps] = new
+        # re-home the PG on its (possibly new) primary's daemon
+        if old.backend.whoami != new.backend.whoami:
+            self.osds[old.backend.whoami].pgs.pop(new.pgid, None)
+            self.osds[old.backend.whoami].write_superblock()
+        self.osds[new.backend.whoami].register_pg(new.pgid, new)
 
     def attach_monitor(self, n_mons: int = 1):
         """Wire the control plane over this cluster's OSDMap: committed

@@ -76,7 +76,11 @@ def blaum_roth_bitmatrix(k: int, w: int) -> np.ndarray:
     w == 7 is tolerated without the primality check for backward
     compatibility, exactly like the reference
     (ErasureCodeJerasure.cc:461-471: "back in Firefly, w = 7 was the
-    default and produced usable chunks").
+    default and produced usable chunks").  WARNING: w=7 is NOT MDS —
+    1+x+...+x^7 = (1+x)^7 over GF(2), so x^i + x^j is a zero divisor and
+    every (data, data) double erasure is undecodable; single erasures and
+    data+parity pairs still decode ("usable", not safe).  The plugin never
+    defaults to it.
     """
     if w != 7 and (w <= 2 or not is_prime(w + 1)):
         raise ValueError(f"w={w} must be greater than two and w+1 prime")

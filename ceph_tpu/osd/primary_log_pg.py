@@ -1,0 +1,584 @@
+"""The primary's object-op engine: PrimaryLogPG's do_osd_ops analog.
+
+Executes a client op vector (``MOSDOp``) against one object, atomically:
+reads resolve against the (possibly degraded) PG via the backend's
+reconstructing read path, mutations stage into ONE ``PGTransaction`` that
+rides the backend's ordered write pipeline (min_size gate, rollback,
+recovery — all below this layer).
+
+Reference call stack (SURVEY §3.1): PrimaryLogPG::do_request → do_op →
+execute_ctx → do_osd_ops (the giant opcode switch,
+src/osd/PrimaryLogPG.cc:5577) → prepare_transaction → issue_repop →
+PGBackend::submit_transaction (src/osd/PrimaryLogPG.cc:1565,1756,3709,
+8319,10422).  Object metadata is an ``object_info_t`` xattr "_" on every
+shard and user xattrs are stored "_"-prefixed, both exactly like the
+reference (src/osd/osd_types.h OI_ATTR).
+
+Scope notes (deliberate divergences, all returning clean errors):
+- snapshots / clone / rollback / watch-notify / cache-tiering ops are not
+  implemented (no snapshot machinery in this framework yet);
+- data READs inside a *write* vector are rejected with -EINVAL on EC
+  pools (the reference queues them as pending_async_reads; here a vector
+  is either data-reading or mutating — metadata reads work in both);
+- CEPH_OSD_OP_ZERO never extends the object (the reference's behavior
+  with the default truncate_seq handling).
+
+Ordering: mutating vectors take a per-object in-flight slot; any later op
+on the same object queues until the commit callback fires — the obc
+rw-lock ordering of the reference collapsed to its observable effect.
+"""
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable
+
+from ..backend.memstore import GObject
+from ..backend.transaction import PGTransaction
+from .osd_ops import (
+    CMPXATTR_EQ, CMPXATTR_GT, CMPXATTR_GTE, CMPXATTR_LT, CMPXATTR_LTE,
+    CMPXATTR_MODE_STRING, CMPXATTR_MODE_U64, CMPXATTR_NE, DATA_READ_OPS,
+    MOSDOp, MOSDOpReply, OP_APPEND, OP_CALL, OP_CMPEXT, OP_CMPXATTR,
+    OP_CREATE, OP_DELETE, OP_GETXATTR, OP_GETXATTRS, OP_OMAPCLEAR,
+    OP_OMAPGETHEADER, OP_OMAPGETKEYS, OP_OMAPGETVALS, OP_OMAPGETVALSBYKEYS,
+    OP_OMAPRMKEYS, OP_OMAPSETHEADER, OP_OMAPSETVALS, OP_OMAP_CMP, OP_READ,
+    OP_RMXATTR, OP_SETXATTR, OP_SPARSE_READ, OP_STAT, OP_TRUNCATE,
+    OP_WRITE, OP_WRITEFULL, OP_ZERO, OSDOp, WRITE_OPS,
+)
+
+# errnos, negated like the reference's rvals
+ENOENT, EEXIST, EINVAL = -2, -17, -22
+ENODATA = -61
+EOPNOTSUPP = -95
+ECANCELED = -125
+MAX_ERRNO = 4095          # cmpext mismatch: -(MAX_ERRNO + offset)
+
+OI_ATTR = "_"             # object_info_t xattr (src/osd/osd_types.h)
+USER_PREFIX = "_"         # user xattr "foo" is stored as "_foo"
+# non-user attrs that share the "_" prefix (internal attrs otherwise use
+# non-"_" prefixes — e.g. the replicated backend's "@version" — so they
+# cannot collide with any user name)
+INTERNAL_ATTRS = frozenset({OI_ATTR})
+
+
+class OpError(Exception):
+    def __init__(self, rval: int):
+        self.rval = rval
+
+
+@dataclass
+class ClsMethod:
+    fn: Callable
+    mutates: bool
+
+
+class ClsRegistry:
+    """Object-class method registry (the reference's loadable cls plugins,
+    src/cls/ + PrimaryLogPG's CEPH_OSD_OP_CALL dispatch)."""
+
+    _methods: dict[tuple[str, str], ClsMethod] = {}
+
+    @classmethod
+    def register(cls, cls_name: str, method: str, fn: Callable,
+                 mutates: bool = False) -> None:
+        cls._methods[(cls_name, method)] = ClsMethod(fn, mutates)
+
+    @classmethod
+    def get(cls, cls_name: str, method: str) -> ClsMethod | None:
+        return cls._methods.get((cls_name, method))
+
+
+class ClsContext:
+    """What a cls method sees: the op's staged object state."""
+
+    def __init__(self, ectx: "_ExecCtx", indata: bytes):
+        self._ctx = ectx
+        self.indata = indata
+        self.oid = ectx.m.oid
+
+    def exists(self) -> bool:
+        return self._ctx.exists
+
+    def size(self) -> int:
+        return self._ctx.size
+
+    def getxattr(self, name: str):
+        return self._ctx.get_attr(USER_PREFIX + name)
+
+    # mutations stage into the surrounding op vector's transaction
+    def setxattr(self, name: str, value) -> None:
+        self._ctx.stage_attr(USER_PREFIX + name, value)
+
+    def write_full(self, data: bytes) -> None:
+        self._ctx.stage_write_full(data)
+
+    def append(self, data: bytes) -> None:
+        self._ctx.stage_write(self._ctx.size, data)
+
+
+@dataclass
+class _ExecCtx:
+    """Mutable execute state: the reference's OpContext (new_obs + op_t)."""
+    m: MOSDOp
+    engine: "PrimaryLogPG"
+    exists: bool
+    size: int
+    attrs: dict = field(default_factory=dict)       # overlay: name -> v|None
+    omap: dict = field(default_factory=dict)        # overlay: key -> v|None
+    omap_cleared: bool = False
+    omap_header: bytes | None = None
+    t: PGTransaction = field(default_factory=PGTransaction)
+    mutated: bool = False
+    user_modify: bool = False
+
+    # -- staged-state readers ---------------------------------------------
+
+    def _gobj(self) -> GObject:
+        return GObject(self.m.oid, self.engine.backend.whoami)
+
+    def get_attr(self, name: str):
+        """Committed attr overlaid with this vector's staged updates."""
+        if name in self.attrs:
+            if self.attrs[name] is None:
+                raise KeyError(name)
+            return self.attrs[name]
+        store = self.engine.backend.local_shard.store
+        gobj = self._gobj()
+        if not store.exists(gobj):
+            raise KeyError(name)
+        return store.getattr(gobj, name)
+
+    def get_attrs(self) -> dict:
+        store = self.engine.backend.local_shard.store
+        gobj = self._gobj()
+        base = store.getattrs(gobj) if store.exists(gobj) else {}
+        base.update({k: v for k, v in self.attrs.items() if v is not None})
+        for k, v in self.attrs.items():
+            if v is None:
+                base.pop(k, None)
+        return base
+
+    def get_omap(self) -> dict:
+        store = self.engine.backend.local_shard.store
+        gobj = self._gobj()
+        base = ({} if self.omap_cleared or not store.exists(gobj)
+                else store.get_omap(gobj))
+        base.update({k: v for k, v in self.omap.items() if v is not None})
+        for k, v in self.omap.items():
+            if v is None:
+                base.pop(k, None)
+        return base
+
+    def get_omap_header(self) -> bytes:
+        if self.omap_header is not None:
+            return self.omap_header
+        if self.omap_cleared:
+            return b""
+        store = self.engine.backend.local_shard.store
+        gobj = self._gobj()
+        return store.get_omap_header(gobj) if store.exists(gobj) else b""
+
+    # -- staged-state writers ----------------------------------------------
+
+    def objop(self):
+        return self.t.touch(self.m.oid)
+
+    def stage_attr(self, name: str, value) -> None:
+        self.attrs[name] = value
+        if value is None:
+            self.objop().rmattr(name)
+        else:
+            self.objop().setattr(name, value)
+        self.mutated = True
+
+    def stage_write(self, offset: int, data: bytes) -> None:
+        self.objop().write(offset, data)
+        self.size = max(self.size, offset + len(data))
+        self.exists = True
+        self.mutated = self.user_modify = True
+
+    def stage_write_full(self, data: bytes) -> None:
+        op = self.objop()
+        op.buffer_updates = [(0, bytes(data))]
+        op.truncate = (len(data), len(data))
+        self.size = len(data)
+        self.exists = True
+        self.mutated = self.user_modify = True
+
+    def stage_truncate(self, size: int) -> None:
+        op = self.objop()
+        # clip staged writes beyond the new size so a write-then-truncate
+        # vector ends at exactly `size` (the reference applies ops in
+        # order inside one transaction)
+        clipped = []
+        for off, data in op.buffer_updates:
+            if off >= size:
+                continue
+            clipped.append((off, data[:size - off]) if off + len(data) > size
+                           else (off, data))
+        op.buffer_updates = clipped
+        op.truncate = (size, size)
+        self.size = size
+        self.exists = True
+        self.mutated = self.user_modify = True
+
+    def stage_omap(self, kind: str, *args) -> None:
+        self.objop().omap_ops.append((kind, *args))
+        self.mutated = self.user_modify = True
+
+
+class PrimaryLogPG:
+    """The op engine bound to one PG's backend."""
+
+    def __init__(self, backend, pool_type: str = "ec"):
+        self.backend = backend
+        self.pool_type = pool_type
+        self.version = 0            # pg op version (eversion_t analog)
+        self.user_version = 0
+        self._busy: set[str] = set()
+        self._waiting: dict[str, deque] = {}
+
+    # -- entry -------------------------------------------------------------
+
+    def do_op(self, m: MOSDOp, on_reply: Callable[[MOSDOpReply], None]):
+        """Execute one client op vector; on_reply fires with the reply —
+        immediately for pure reads, at commit for mutations."""
+        if m.oid in self._busy:
+            self._waiting.setdefault(m.oid, deque()).append((m, on_reply))
+            return
+        self._start(m, on_reply)
+
+    def _op_mutates(self, op: OSDOp) -> bool:
+        if op.op in WRITE_OPS:
+            return True
+        if op.op == OP_CALL:
+            meth = ClsRegistry.get(op.params["cls"], op.params["method"])
+            return bool(meth and meth.mutates)
+        return False
+
+    def _start(self, m: MOSDOp, on_reply) -> None:
+        has_write = any(self._op_mutates(op) for op in m.ops)
+        data_reads = [op for op in m.ops if op.op in DATA_READ_OPS]
+        oi = self._load_oi(m.oid)
+        if data_reads:
+            if has_write and self.pool_type == "ec":
+                for op in m.ops:
+                    op.rval = EINVAL
+                on_reply(MOSDOpReply(EINVAL, m.ops))
+                return
+            if oi is None:
+                on_reply(MOSDOpReply(ENOENT, m.ops))
+                return
+            extents = []
+            for op in data_reads:
+                off = op.params["offset"]
+                length = op.params.get("length",
+                                       len(op.params.get("data", b"")))
+                if length == 0 and op.op != OP_CMPEXT:
+                    length = max(oi["size"] - off, 0)   # len 0 = to end
+                extents.append((off, length))
+
+            def _got(result, errors):
+                if errors:
+                    on_reply(MOSDOpReply(EINVAL, m.ops))
+                    return
+                got = {(off, ln): data
+                       for off, ln, data in result.get(m.oid, [])}
+                self._execute(m, oi, got, has_write, on_reply)
+            self.backend.objects_read_and_reconstruct(
+                {m.oid: extents}, lambda result, errors: _got(result, errors))
+        else:
+            self._execute(m, oi, {}, has_write, on_reply)
+
+    # -- the opcode switch (do_osd_ops) ------------------------------------
+
+    def _execute(self, m: MOSDOp, oi, readdata, has_write, on_reply) -> None:
+        ctx = _ExecCtx(m=m, engine=self,
+                       exists=oi is not None,
+                       size=oi["size"] if oi else 0)
+        if has_write:
+            self._busy.add(m.oid)
+        result = 0
+        try:
+            for op in m.ops:
+                op.rval = self._do_one(ctx, op, oi, readdata)
+        except OpError as e:
+            result = e.rval
+        if result != 0 or not ctx.mutated:
+            self._finish(m, MOSDOpReply(result, m.ops), has_write, on_reply)
+            return
+        # prepare_transaction: persist object_info on every shard with the
+        # data (atomically — it rides the same PGTransaction)
+        self.version += 1
+        if ctx.user_modify:
+            self.user_version += 1
+        objop = ctx.t.touch(m.oid)
+        if ctx.exists:
+            objop.setattr(OI_ATTR, {
+                "size": ctx.size, "version": self.version,
+                "user_version": self.user_version, "mtime": time.time()})
+        version = self.version
+
+        def _committed(tid):
+            self._finish(m, MOSDOpReply(0, m.ops, version=version),
+                         has_write, on_reply)
+        self.backend.submit_transaction(ctx.t, on_commit=_committed)
+
+    def _finish(self, m, reply, has_write, on_reply) -> None:
+        if has_write:
+            self._busy.discard(m.oid)
+        on_reply(reply)
+        q = self._waiting.get(m.oid)
+        while q and m.oid not in self._busy:
+            nm, cb = q.popleft()
+            self._start(nm, cb)
+        if q is not None and not q:
+            self._waiting.pop(m.oid, None)
+
+    def _load_oi(self, oid: str) -> dict | None:
+        store = self.backend.local_shard.store
+        gobj = GObject(oid, self.backend.whoami)
+        if not store.exists(gobj):
+            return None
+        try:
+            return dict(store.getattr(gobj, OI_ATTR))
+        except KeyError:
+            # object written below the op-engine layer (e.g. MiniCluster.put)
+            return {"size": self.backend.object_size(oid),
+                    "version": 0, "user_version": 0, "mtime": 0.0}
+
+    def _require(self, ctx: _ExecCtx) -> None:
+        if not ctx.exists:
+            raise OpError(ENOENT)
+
+    def _do_one(self, ctx: _ExecCtx, op: OSDOp, oi, readdata) -> int:
+        p = op.params
+        kind = op.op
+
+        # ---- data reads (pre-fetched through the reconstructing path)
+        if kind in (OP_READ, OP_SPARSE_READ):
+            self._require(ctx)
+            off = p["offset"]
+            length = p["length"] or max((oi["size"] if oi else 0) - off, 0)
+            data = readdata.get((off, length), b"")[:length]
+            op.outdata = ({off: bytes(data)} if kind == OP_SPARSE_READ
+                          else bytes(data))
+            return len(data)
+        if kind == OP_CMPEXT:
+            self._require(ctx)
+            off, want = p["offset"], p["data"]
+            got = bytes(readdata.get((off, len(want)), b""))
+            got = got.ljust(len(want), b"\0")
+            if got != want:
+                mism = next(i for i in range(len(want)) if got[i] != want[i])
+                raise OpError(-(MAX_ERRNO + mism))
+            return len(want)
+
+        # ---- metadata reads
+        if kind == OP_STAT:
+            self._require(ctx)
+            op.outdata = (ctx.size, (oi or {}).get("mtime", 0.0))
+            return 0
+        if kind == OP_GETXATTR:
+            if not p["name"]:
+                raise OpError(EINVAL)   # "" would alias OI_ATTR
+            self._require(ctx)
+            try:
+                op.outdata = ctx.get_attr(USER_PREFIX + p["name"])
+            except KeyError:
+                raise OpError(ENODATA)
+            return 0
+        if kind == OP_GETXATTRS:
+            self._require(ctx)
+            op.outdata = {k[len(USER_PREFIX):]: v
+                          for k, v in ctx.get_attrs().items()
+                          if k.startswith(USER_PREFIX)
+                          and k not in INTERNAL_ATTRS}
+            return 0
+        if kind == OP_CMPXATTR:
+            if not p["name"]:
+                raise OpError(EINVAL)
+            self._require(ctx)
+            try:
+                have = ctx.get_attr(USER_PREFIX + p["name"])
+            except KeyError:
+                raise OpError(ECANCELED if p["mode"] == CMPXATTR_MODE_STRING
+                              else ENODATA)
+            if p["mode"] == CMPXATTR_MODE_U64:
+                try:
+                    have = int(have)
+                except (TypeError, ValueError):
+                    raise OpError(EINVAL)
+            ok = {CMPXATTR_EQ: have == p["value"],
+                  CMPXATTR_NE: have != p["value"],
+                  CMPXATTR_GT: have > p["value"],
+                  CMPXATTR_GTE: have >= p["value"],
+                  CMPXATTR_LT: have < p["value"],
+                  CMPXATTR_LTE: have <= p["value"]}.get(p["cmp"])
+            if ok is None:
+                raise OpError(EINVAL)
+            if not ok:
+                raise OpError(ECANCELED)
+            return 1
+
+        # ---- omap (replicated pools only, like the reference)
+        if kind.startswith("omap"):
+            if self.pool_type == "ec":
+                raise OpError(EOPNOTSUPP)
+            return self._do_omap(ctx, op)
+
+        # ---- mutations
+        if kind == OP_CREATE:
+            if ctx.exists and p.get("exclusive"):
+                raise OpError(EEXIST)
+            if not ctx.exists:
+                ctx.stage_write(0, b"")     # touch
+                ctx.size = 0
+            return 0
+        if kind == OP_WRITE:
+            ctx.stage_write(p["offset"], p["data"])
+            return 0
+        if kind == OP_WRITEFULL:
+            ctx.stage_write_full(p["data"])
+            return 0
+        if kind == OP_APPEND:
+            ctx.stage_write(ctx.size, p["data"])
+            return 0
+        if kind == OP_ZERO:
+            self._require(ctx)
+            off = p["offset"]
+            length = min(p["length"], max(ctx.size - off, 0))
+            if length > 0:
+                ctx.stage_write(off, b"\0" * length)
+            return 0
+        if kind == OP_TRUNCATE:
+            self._require(ctx)
+            ctx.stage_truncate(p["size"])
+            return 0
+        if kind == OP_DELETE:
+            self._require(ctx)
+            op_obj = ctx.objop()
+            op_obj.delete_first = True
+            op_obj.buffer_updates = []
+            op_obj.truncate = None
+            op_obj.attr_updates = {}
+            op_obj.omap_ops = []
+            ctx.exists = False
+            ctx.size = 0
+            ctx.attrs = {}
+            ctx.omap = {}
+            ctx.omap_cleared = True
+            ctx.mutated = ctx.user_modify = True
+            return 0
+        if kind == OP_SETXATTR:
+            if not p["name"]:
+                raise OpError(EINVAL)   # "" would alias OI_ATTR
+            if not ctx.exists:
+                ctx.stage_write(0, b"")
+            ctx.stage_attr(USER_PREFIX + p["name"], p["value"])
+            return 0
+        if kind == OP_RMXATTR:
+            if not p["name"]:
+                raise OpError(EINVAL)
+            self._require(ctx)
+            ctx.stage_attr(USER_PREFIX + p["name"], None)
+            return 0
+
+        # ---- object classes
+        if kind == OP_CALL:
+            meth = ClsRegistry.get(p["cls"], p["method"])
+            if meth is None:
+                raise OpError(EOPNOTSUPP)
+            rval, out = meth.fn(ClsContext(ctx, p["indata"]))
+            op.outdata = out
+            if rval < 0:
+                raise OpError(rval)
+            return rval
+
+        raise OpError(EOPNOTSUPP)
+
+    def _do_omap(self, ctx: _ExecCtx, op: OSDOp) -> int:
+        p = op.params
+        kind = op.op
+        if kind == OP_OMAPGETKEYS:
+            self._require(ctx)
+            keys = sorted(k for k in ctx.get_omap()
+                          if k > p["start_after"])[:p["max_return"]]
+            op.outdata = keys
+            return 0
+        if kind == OP_OMAPGETVALS:
+            self._require(ctx)
+            omap = ctx.get_omap()
+            keys = sorted(k for k in omap if k > p["start_after"]
+                          and k.startswith(p["filter_prefix"]))
+            keys = keys[:p["max_return"]]
+            op.outdata = {k: omap[k] for k in keys}
+            return 0
+        if kind == OP_OMAPGETVALSBYKEYS:
+            self._require(ctx)
+            omap = ctx.get_omap()
+            op.outdata = {k: omap[k] for k in p["keys"] if k in omap}
+            return 0
+        if kind == OP_OMAPGETHEADER:
+            self._require(ctx)
+            op.outdata = ctx.get_omap_header()
+            return 0
+        if kind == OP_OMAP_CMP:
+            self._require(ctx)
+            omap = ctx.get_omap()
+            for key, (value, cmp_op) in sorted(p["assertions"].items()):
+                have = omap.get(key)
+                if have is None:
+                    raise OpError(ECANCELED)
+                ok = {CMPXATTR_EQ: have == value, CMPXATTR_NE: have != value,
+                      CMPXATTR_GT: have > value, CMPXATTR_GTE: have >= value,
+                      CMPXATTR_LT: have < value, CMPXATTR_LTE: have <= value,
+                      }.get(cmp_op)
+                if not ok:
+                    raise OpError(ECANCELED)
+            return 0
+        # mutations
+        if not ctx.exists:
+            ctx.stage_write(0, b"")
+        if kind == OP_OMAPSETVALS:
+            for k, v in p["kvs"].items():
+                ctx.omap[k] = v
+            ctx.stage_omap("set", dict(p["kvs"]))
+            return 0
+        if kind == OP_OMAPSETHEADER:
+            ctx.omap_header = p["header"]
+            ctx.stage_omap("header", p["header"])
+            return 0
+        if kind == OP_OMAPRMKEYS:
+            for k in p["keys"]:
+                ctx.omap[k] = None
+            ctx.stage_omap("rm", list(p["keys"]))
+            return 0
+        if kind == OP_OMAPCLEAR:
+            ctx.omap = {}
+            ctx.omap_cleared = True
+            ctx.omap_header = b""
+            ctx.stage_omap("clear")
+            return 0
+        raise OpError(EOPNOTSUPP)
+
+
+# -- built-in object classes (the reference ships src/cls/hello) -----------
+
+def _hello_say_hello(ctx: ClsContext):
+    who = ctx.indata.decode() if ctx.indata else "world"
+    return 0, f"Hello, {who}!".encode()
+
+
+def _hello_record_hello(ctx: ClsContext):
+    who = ctx.indata.decode() if ctx.indata else "world"
+    greeting = f"Hello, {who}!".encode()
+    ctx.write_full(greeting)
+    ctx.setxattr("recorded", b"1")
+    return 0, b""
+
+
+ClsRegistry.register("hello", "say_hello", _hello_say_hello, mutates=False)
+ClsRegistry.register("hello", "record_hello", _hello_record_hello,
+                     mutates=True)

@@ -23,6 +23,34 @@ SIMD_ALIGN = 32          # reference AVX alignment (ErasureCode.cc:42)
 TPU_LANE_ALIGN = 128     # TPU minor-dim tile width; our chunk alignment
 
 
+class DeviceRouting:
+    """ONE copy of the device/jax-threshold routing policy shared by every
+    TPU-backed plugin (the dispatch-economics split from SURVEY §7): a
+    profile ``jax-threshold`` pins the cutoff, otherwise the live config
+    option ``ec_device_threshold_bytes`` decides per call."""
+
+    def parse_device_routing(self, profile) -> None:
+        self.device = self.to_string("device", profile, "auto")
+        if self.device not in ("jax", "numpy", "auto"):
+            raise ValueError(f"device={self.device} must be jax|numpy|auto")
+        if "jax-threshold" in profile:
+            self.jax_threshold: int | None = self.to_int(
+                "jax-threshold", profile, "65536")
+        else:
+            self.jax_threshold = None
+        from ..common.context import default_context
+        self._conf = default_context().conf
+
+    def use_device(self, nbytes: int) -> bool:
+        """Should this call run on the accelerator?"""
+        if self.device != "auto":
+            return self.device == "jax"
+        cutoff = self.jax_threshold
+        if cutoff is None:
+            cutoff = int(self._conf.get("ec_device_threshold_bytes"))
+        return nbytes >= cutoff
+
+
 class ErasureCode(ErasureCodeInterface):
     DEFAULT_RULE_ROOT = "default"
     DEFAULT_RULE_FAILURE_DOMAIN = "host"

@@ -30,12 +30,12 @@ import numpy as np
 
 from .. import __version__
 from ..ops.codec import RSCodec, TECHNIQUES
-from .base import ErasureCode
+from .base import DeviceRouting, ErasureCode
 from .interface import ErasureCodeProfile
 from .registry import ErasureCodePlugin, ErasureCodePluginRegistry
 
 
-class ErasureCodeJaxRS(ErasureCode):
+class ErasureCodeJaxRS(DeviceRouting, ErasureCode):
     DEFAULT_K = "7"
     DEFAULT_M = "3"
 
@@ -70,18 +70,7 @@ class ErasureCodeJaxRS(ErasureCode):
             raise ValueError(
                 f"technique={technique} must be one of {sorted(TECHNIQUES)}")
         self.technique = technique
-        self.device = self.to_string("device", profile, "auto")
-        if self.device not in ("jax", "numpy", "auto"):
-            raise ValueError(f"device={self.device} must be jax|numpy|auto")
-        # routing cutoff: a profile override pins it; otherwise the
-        # config-store option ``ec_device_threshold_bytes`` is consulted
-        # live per call, so ``config set`` reaches the routing decision
-        from ..common.context import default_context
-        if "jax-threshold" in profile:
-            self.jax_threshold = self.to_int("jax-threshold", profile, "65536")
-        else:
-            self.jax_threshold = None
-        self._conf = default_context().conf
+        self.parse_device_routing(profile)
         self.variant = self.to_string("variant", profile, "auto")
         # one codec per backend; 'auto' keeps both and routes per call size
         dev = "numpy" if self.device == "numpy" else "jax"
@@ -95,10 +84,7 @@ class ErasureCodeJaxRS(ErasureCode):
     def _route(self, nbytes: int) -> RSCodec:
         if self.device != "auto":
             return self.codec
-        cutoff = self.jax_threshold
-        if cutoff is None:
-            cutoff = int(self._conf.get("ec_device_threshold_bytes"))
-        return self._cpu_codec if nbytes < cutoff else self.codec
+        return self.codec if self.use_device(nbytes) else self._cpu_codec
 
     # -- counts ------------------------------------------------------------
 

@@ -29,7 +29,7 @@ import numpy as np
 from .. import __version__
 from ..gf import bitmatrix as bm
 from .plugin_jax_rs import ErasureCodeJaxRS
-from .base import ErasureCode
+from .base import DeviceRouting, ErasureCode
 from .interface import ErasureCodeProfile
 from .registry import ErasureCodePlugin, ErasureCodePluginRegistry
 
@@ -60,11 +60,17 @@ class ErasureCodeJerasureCompat(ErasureCodeJaxRS):
         self._profile["technique"] = technique
 
 
-class ErasureCodeJerasureBitmatrix(ErasureCode):
+class ErasureCodeJerasureBitmatrix(DeviceRouting, ErasureCode):
     """liberation / blaum_roth / liber8tion over packets on the MXU."""
 
     DEFAULT_K = "2"             # ErasureCodeJerasure.h:202-204
-    DEFAULT_W = {"liberation": "7", "blaum_roth": "7", "liber8tion": "8"}
+    # The reference's blaum_roth inherits DEFAULT_W="7" from Liberation and
+    # tolerates it (ErasureCodeJerasure.cc:461-471) — but w=7 makes
+    # 1+x+...+x^7 = (1+x)^7 reducible, so double-DATA erasures are
+    # UNDECODABLE.  Defaulting a RAID-6 pool to a non-MDS profile loses
+    # data; here the default is the nearest valid w (w+1=7 prime) and w=7
+    # stays accept-on-explicit-request for profile compat only.
+    DEFAULT_W = {"liberation": "7", "blaum_roth": "6", "liber8tion": "8"}
 
     def __init__(self, technique: str):
         super().__init__()
@@ -89,16 +95,7 @@ class ErasureCodeJerasureBitmatrix(ErasureCode):
         self.w = self.to_int("w", profile, self.DEFAULT_W[technique])
         self.packetsize = self.to_int("packetsize", profile,
                                       DEFAULT_PACKETSIZE)
-        self.device = self.to_string("device", profile, "auto")
-        if self.device not in ("jax", "numpy", "auto"):
-            raise ValueError(f"device={self.device} must be jax|numpy|auto")
-        if "jax-threshold" in profile:
-            self.jax_threshold: int | None = self.to_int(
-                "jax-threshold", profile, "65536")
-        else:
-            self.jax_threshold = None
-        from ..common.context import default_context
-        self._conf = default_context().conf
+        self.parse_device_routing(profile)
         self.sanity_check_k_m(self.k, self.m)
         if self.m != 2:
             raise ValueError(
@@ -138,16 +135,7 @@ class ErasureCodeJerasureBitmatrix(ErasureCode):
     # -- encode/decode -----------------------------------------------------
 
     def _apply(self, W: np.ndarray, packets: np.ndarray) -> np.ndarray:
-        if self.device == "auto":
-            # same routing policy as ErasureCodeJaxRS._route: profile
-            # jax-threshold pins the cutoff, else the live config option
-            cutoff = self.jax_threshold
-            if cutoff is None:
-                cutoff = int(self._conf.get("ec_device_threshold_bytes"))
-            use_jax = packets.nbytes >= cutoff
-        else:
-            use_jax = self.device == "jax"
-        if use_jax:
+        if self.use_device(packets.nbytes):
             from ..ops.rs_kernels import xor_apply
             import jax
             return np.asarray(jax.device_get(xor_apply(W, packets)))

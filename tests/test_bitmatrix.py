@@ -186,3 +186,37 @@ def test_plugin_chunk_mapping(registry):
     encoded = ec.encode(set(range(4)), data)
     avail = {i: encoded[i] for i in (0, 2, 3)}   # physical position 1 lost
     assert ec.decode_concat(avail)[:3000] == data
+
+
+def test_blaum_roth_w7_compat_hazard_pinned():
+    """w=7 (the reference's Firefly legacy) is accepted but NOT MDS:
+    single and data+parity erasures decode; every (data, data) pair is
+    undecodable — pin both facts so the compat hole stays visible."""
+    k, w, ps = 4, 7, 4
+    coding = bm.blaum_roth_bitmatrix(k, w)
+    rng = np.random.default_rng(9)
+    data = rng.integers(0, 256, (k, w * ps * 2), dtype=np.uint8)
+    parity = bm.from_packets(
+        bm.xor_apply_host(coding, bm.to_packets(data, w, ps)), w, ps)
+    chunks = np.concatenate([data, parity], axis=0)
+    ok = [(0,), (3,), (k,), (0, k), (1, k + 1), (k, k + 1)]
+    for erasures in ok:
+        avail = [i for i in range(k + 2) if i not in erasures]
+        D, src = bm.decode_bitmatrix(coding, k, w, list(erasures), avail)
+        rec = bm.from_packets(
+            bm.xor_apply_host(D, bm.to_packets(chunks[src], w, ps)), w, ps)
+        for row, e in enumerate(sorted(erasures)):
+            assert np.array_equal(rec[row], chunks[e])
+    for d1 in range(k):
+        for d2 in range(d1 + 1, k):
+            with pytest.raises(np.linalg.LinAlgError):
+                bm.decode_bitmatrix(coding, k, w, [d1, d2],
+                                    [i for i in range(k + 2)
+                                     if i not in (d1, d2)])
+
+
+def test_blaum_roth_plugin_default_w_is_mds(registry):
+    ec = registry.factory("jerasure", "", {"technique": "blaum_roth",
+                                           "k": "4", "packetsize": "8",
+                                           "device": "numpy"})
+    assert ec.w == 6        # NOT the reference's non-MDS w=7 legacy

@@ -1,0 +1,346 @@
+"""PrimaryLogPG op engine: the do_osd_ops opcode switch.
+
+Mirrors the reference's op-execution semantics
+(src/osd/PrimaryLogPG.cc:5577 do_osd_ops; librados ObjectOperation):
+atomic op vectors, errno-shaped failures, xattr/omap surfaces, object
+classes — driven through MiniCluster.operate on both pool types.
+"""
+import numpy as np
+import pytest
+
+from ceph_tpu.cluster import MiniCluster
+from ceph_tpu.osd.osd_ops import (
+    CMPXATTR_EQ, CMPXATTR_GT, ObjectOperation,
+)
+from ceph_tpu.osd.primary_log_pg import (
+    ECANCELED, EEXIST, ENODATA, ENOENT, EOPNOTSUPP, MAX_ERRNO,
+)
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = MiniCluster(n_osds=12, osds_per_host=3, chunk_size=512)
+    ec = c.create_ec_pool("ecpool", {"k": "4", "m": "2", "device": "numpy"},
+                          pg_num=4)
+    rep = c.create_replicated_pool("reppool", size=3, pg_num=4)
+    yield c, ec, rep
+    c.shutdown()
+
+
+def _data(n, seed=0):
+    return np.random.default_rng(seed).integers(
+        0, 256, n, dtype=np.uint8).tobytes()
+
+
+@pytest.mark.parametrize("pool", ["ec", "rep"])
+def test_write_read_roundtrip(cluster, pool):
+    c, ec, rep = cluster
+    pid = ec if pool == "ec" else rep
+    payload = _data(5000, 1)
+    c.operate(pid, f"rt-{pool}", ObjectOperation().write(0, payload))
+    r = c.operate(pid, f"rt-{pool}", ObjectOperation().read(0, len(payload)))
+    assert r.outdata(0) == payload
+    # read with length 0 = read to end
+    r = c.operate(pid, f"rt-{pool}", ObjectOperation().read(0, 0))
+    assert r.outdata(0)[:5000] == payload
+
+
+@pytest.mark.parametrize("pool", ["ec", "rep"])
+def test_append_and_stat(cluster, pool):
+    c, ec, rep = cluster
+    pid = ec if pool == "ec" else rep
+    oid = f"app-{pool}"
+    c.operate(pid, oid, ObjectOperation().write_full(b"abc"))
+    c.operate(pid, oid, ObjectOperation().append(b"defg"))
+    r = c.operate(pid, oid, ObjectOperation().stat())
+    size, mtime = r.outdata(0)
+    assert size == 7
+    r = c.operate(pid, oid, ObjectOperation().read(0, 7))
+    assert r.outdata(0) == b"abcdefg"
+
+
+def test_writefull_replaces(cluster):
+    c, ec, _ = cluster
+    c.operate(ec, "wf", ObjectOperation().write(0, _data(4000, 2)))
+    c.operate(ec, "wf", ObjectOperation().write_full(b"short"))
+    r = c.operate(ec, "wf", ObjectOperation().stat())
+    assert r.outdata(0)[0] == 5
+    assert c.operate(ec, "wf",
+                     ObjectOperation().read(0, 0)).outdata(0)[:5] == b"short"
+
+
+def test_zero_and_truncate(cluster):
+    c, ec, _ = cluster
+    c.operate(ec, "zt", ObjectOperation().write_full(b"x" * 100))
+    c.operate(ec, "zt", ObjectOperation().zero(10, 20))
+    r = c.operate(ec, "zt", ObjectOperation().read(0, 100))
+    assert r.outdata(0)[:10] == b"x" * 10
+    assert r.outdata(0)[10:30] == b"\0" * 20
+    assert r.outdata(0)[30:100] == b"x" * 70
+    # zero never extends
+    c.operate(ec, "zt", ObjectOperation().zero(90, 1000))
+    assert c.operate(ec, "zt", ObjectOperation().stat()).outdata(0)[0] == 100
+    c.operate(ec, "zt", ObjectOperation().truncate(25))
+    assert c.operate(ec, "zt", ObjectOperation().stat()).outdata(0)[0] == 25
+
+
+def test_write_then_truncate_one_vector(cluster):
+    c, _, rep = cluster
+    c.operate(rep, "wt", ObjectOperation().write(0, b"A" * 100).truncate(10))
+    r = c.operate(rep, "wt", ObjectOperation().read(0, 0))
+    assert r.outdata(0) == b"A" * 10
+
+
+def test_create_exclusive(cluster):
+    c, ec, _ = cluster
+    c.operate(ec, "cx", ObjectOperation().create(exclusive=True))
+    with pytest.raises(IOError) as ei:
+        c.operate(ec, "cx", ObjectOperation().create(exclusive=True))
+    assert ei.value.errno == EEXIST
+    c.operate(ec, "cx", ObjectOperation().create())      # non-excl ok
+
+
+def test_delete(cluster):
+    c, ec, _ = cluster
+    c.operate(ec, "del", ObjectOperation().write_full(b"doomed"))
+    c.operate(ec, "del", ObjectOperation().remove())
+    with pytest.raises(IOError) as ei:
+        c.operate(ec, "del", ObjectOperation().stat())
+    assert ei.value.errno == ENOENT
+
+
+@pytest.mark.parametrize("pool", ["ec", "rep"])
+def test_xattrs(cluster, pool):
+    c, ec, rep = cluster
+    pid = ec if pool == "ec" else rep
+    oid = f"xa-{pool}"
+    c.operate(pid, oid, ObjectOperation()
+              .write_full(b"body").setxattr("color", b"blue")
+              .setxattr("n", b"3"))
+    r = c.operate(pid, oid, ObjectOperation().getxattr("color"))
+    assert r.outdata(0) == b"blue"
+    r = c.operate(pid, oid, ObjectOperation().getxattrs())
+    assert r.outdata(0) == {"color": b"blue", "n": b"3"}
+    c.operate(pid, oid, ObjectOperation().rmxattr("color"))
+    with pytest.raises(IOError) as ei:
+        c.operate(pid, oid, ObjectOperation().getxattr("color"))
+    assert ei.value.errno == ENODATA
+
+
+def test_cmpxattr_guard(cluster):
+    c, ec, _ = cluster
+    c.operate(ec, "guard", ObjectOperation()
+              .write_full(b"v1").setxattr("ver", b"1"))
+    # passing guard: xattr==1 allows the write
+    c.operate(ec, "guard", ObjectOperation()
+              .cmpxattr("ver", CMPXATTR_EQ, b"1")
+              .write_full(b"v2").setxattr("ver", b"2"))
+    # failing guard aborts the WHOLE vector atomically
+    with pytest.raises(IOError) as ei:
+        c.operate(ec, "guard", ObjectOperation()
+                  .cmpxattr("ver", CMPXATTR_EQ, b"1")
+                  .write_full(b"v3"))
+    assert ei.value.errno == ECANCELED
+    assert c.operate(ec, "guard",
+                     ObjectOperation().read(0, 0)).outdata(0)[:2] == b"v2"
+    # u64 mode compares numerically
+    c.operate(ec, "guard", ObjectOperation().setxattr("count", 7))
+    c.operate(ec, "guard", ObjectOperation().cmpxattr(
+        "count", CMPXATTR_GT, 5))
+
+
+def test_cmpext(cluster):
+    c, ec, _ = cluster
+    c.operate(ec, "ce", ObjectOperation().write_full(b"hello world"))
+    c.operate(ec, "ce", ObjectOperation().cmpext(0, b"hello"))
+    with pytest.raises(IOError) as ei:
+        c.operate(ec, "ce", ObjectOperation().cmpext(0, b"hellx"))
+    # mismatch offset encoded the reference way: -(MAX_ERRNO + offset)
+    assert ei.value.errno == -(MAX_ERRNO + 4)
+
+
+def test_omap_replicated(cluster):
+    c, _, rep = cluster
+    oid = "om"
+    c.operate(rep, oid, ObjectOperation()
+              .omap_set({"b": b"2", "a": b"1", "ab": b"12"})
+              .omap_set_header(b"HDR"))
+    r = c.operate(rep, oid, ObjectOperation().omap_get_keys())
+    assert r.outdata(0) == ["a", "ab", "b"]
+    r = c.operate(rep, oid, ObjectOperation().omap_get_vals(
+        start_after="a", filter_prefix="a"))
+    assert r.outdata(0) == {"ab": b"12"}
+    r = c.operate(rep, oid, ObjectOperation().omap_get_vals_by_keys(
+        ["a", "zz"]))
+    assert r.outdata(0) == {"a": b"1"}
+    assert c.operate(rep, oid, ObjectOperation()
+                     .omap_get_header()).outdata(0) == b"HDR"
+    c.operate(rep, oid, ObjectOperation().omap_rm_keys(["a"]))
+    assert c.operate(rep, oid, ObjectOperation()
+                     .omap_get_keys()).outdata(0) == ["ab", "b"]
+    # omap_cmp guard
+    c.operate(rep, oid, ObjectOperation().omap_cmp(
+        {"b": (b"2", CMPXATTR_EQ)}))
+    with pytest.raises(IOError) as ei:
+        c.operate(rep, oid, ObjectOperation()
+                  .omap_cmp({"b": (b"9", CMPXATTR_EQ)})
+                  .omap_set({"never": b"x"}))
+    assert ei.value.errno == ECANCELED
+    c.operate(rep, oid, ObjectOperation().omap_clear())
+    assert c.operate(rep, oid, ObjectOperation()
+                     .omap_get_keys()).outdata(0) == []
+    assert c.operate(rep, oid, ObjectOperation()
+                     .omap_get_header()).outdata(0) == b""
+
+
+def test_omap_rejected_on_ec(cluster):
+    c, ec, _ = cluster
+    with pytest.raises(IOError) as ei:
+        c.operate(ec, "omec", ObjectOperation().omap_set({"k": b"v"}))
+    assert ei.value.errno == EOPNOTSUPP
+
+
+def test_atomic_vector(cluster):
+    c, ec, _ = cluster
+    # second op fails -> first op's write must NOT be applied
+    with pytest.raises(IOError):
+        c.operate(ec, "atom", ObjectOperation()
+                  .write_full(b"data").create(exclusive=False)
+                  .getxattr("missing"))
+    with pytest.raises(IOError) as ei:
+        c.operate(ec, "atom", ObjectOperation().stat())
+    assert ei.value.errno == ENOENT
+
+
+def test_read_missing_object(cluster):
+    c, ec, _ = cluster
+    with pytest.raises(IOError) as ei:
+        c.operate(ec, "ghost", ObjectOperation().read(0, 100))
+    assert ei.value.errno == ENOENT
+
+
+def test_degraded_read_through_engine(cluster):
+    c, ec, _ = cluster
+    payload = _data(6000, 3)
+    g = c.operate(ec, "deg", ObjectOperation().write(0, payload))
+    pg = c.pg_group(ec, "deg")
+    victim = pg.acting[1]
+    pg.bus.mark_down(victim)
+    try:
+        r = c.operate(ec, "deg", ObjectOperation().read(0, len(payload)))
+        assert r.outdata(0) == payload       # reconstructed
+    finally:
+        pg.bus.mark_up(victim)
+
+
+def test_cls_hello(cluster):
+    c, ec, _ = cluster
+    r = c.operate(ec, "obj-cls", ObjectOperation()
+                  .call("hello", "say_hello", b"tpu"))
+    assert r.outdata(0) == b"Hello, tpu!"
+    c.operate(ec, "obj-cls", ObjectOperation()
+              .call("hello", "record_hello", b"ceph"))
+    r = c.operate(ec, "obj-cls", ObjectOperation().read(0, 0))
+    assert r.outdata(0)[:12] == b"Hello, ceph!"
+    assert c.operate(ec, "obj-cls", ObjectOperation()
+                     .getxattr("recorded")).outdata(0) == b"1"
+    with pytest.raises(IOError) as ei:
+        c.operate(ec, "obj-cls", ObjectOperation().call("nope", "x"))
+    assert ei.value.errno == EOPNOTSUPP
+
+
+def test_mixed_read_write_vector_rules(cluster):
+    c, ec, rep = cluster
+    # EC: data read + write in one vector -> EINVAL
+    c.operate(ec, "mix", ObjectOperation().write_full(b"0123456789"))
+    with pytest.raises(IOError):
+        c.operate(ec, "mix", ObjectOperation()
+                  .read(0, 4).write(0, b"zz"))
+    # replicated: allowed
+    c.operate(rep, "mix", ObjectOperation().write_full(b"0123456789"))
+    r = c.operate(rep, "mix", ObjectOperation().read(0, 4).write(4, b"ZZ"))
+    assert r.outdata(0) == b"0123"
+    assert c.operate(rep, "mix", ObjectOperation()
+                     .read(0, 0)).outdata(0) == b"0123ZZ6789"
+    # metadata reads inside a write vector work on EC too
+    r = c.operate(ec, "mix", ObjectOperation()
+                  .stat().write(10, b"more"))
+    assert r.outdata(0)[0] == 10
+
+
+def test_sparse_read(cluster):
+    c, _, rep = cluster
+    c.operate(rep, "sp", ObjectOperation().write_full(b"sparse-data"))
+    r = c.operate(rep, "sp", ObjectOperation().sparse_read(2, 4))
+    assert r.outdata(0) == {2: b"arse"}
+
+
+def test_legacy_put_object_visible_to_engine(cluster):
+    c, ec, _ = cluster
+    payload = _data(3000, 4)
+    c.put(ec, "legacy", payload)
+    r = c.operate(ec, "legacy", ObjectOperation().stat())
+    assert r.outdata(0)[0] >= 3000      # stripe-padded size, >= payload
+    r = c.operate(ec, "legacy", ObjectOperation().read(0, 3000))
+    assert r.outdata(0) == payload
+
+
+def test_delete_recreate_keeps_new_attrs(cluster):
+    """A remove+write+setxattr vector must land the new attrs on EC pools
+    too (regression: the EC backend dropped attr_updates whenever
+    delete_first was set)."""
+    c, ec, _ = cluster
+    c.operate(ec, "dr", ObjectOperation().write_full(b"old")
+              .setxattr("gen", b"1"))
+    c.operate(ec, "dr", ObjectOperation().remove().write(0, b"new")
+              .setxattr("gen", b"2"))
+    assert c.operate(ec, "dr", ObjectOperation()
+                     .getxattr("gen")).outdata(0) == b"2"
+    assert c.operate(ec, "dr", ObjectOperation()
+                     .read(0, 0)).outdata(0)[:3] == b"new"
+
+
+def test_empty_xattr_name_rejected(cluster):
+    c, ec, _ = cluster
+    c.operate(ec, "ean", ObjectOperation().create())
+    for bad in (ObjectOperation().setxattr("", b"x"),
+                ObjectOperation().getxattr(""),
+                ObjectOperation().rmxattr("")):
+        with pytest.raises(IOError) as ei:
+            c.operate(ec, "ean", bad)
+        assert ei.value.errno == -22
+
+
+def test_user_xattr_named_version_survives(cluster):
+    """'version' as a user xattr must not collide with the replicated
+    backend's internal version attr (regression: both mapped to
+    '_version')."""
+    c, _, rep = cluster
+    c.operate(rep, "vx", ObjectOperation().write_full(b"d")
+              .setxattr("version", b"user-value"))
+    c.operate(rep, "vx", ObjectOperation().append(b"2"))   # bumps internal
+    assert c.operate(rep, "vx", ObjectOperation()
+                     .getxattr("version")).outdata(0) == b"user-value"
+    assert c.operate(rep, "vx", ObjectOperation()
+                     .getxattrs()).outdata(0) == {"version": b"user-value"}
+
+
+def test_delete_clears_object_listing(cluster):
+    c, ec, _ = cluster
+    c.operate(ec, "gone", ObjectOperation().write_full(b"x"))
+    assert "gone" in c.objects[ec]
+    c.operate(ec, "gone", ObjectOperation().remove())
+    assert "gone" not in c.objects[ec]
+
+
+def test_operate_deliver_false_batches(cluster):
+    c, ec, _ = cluster
+    g = c.pg_group(ec, "batch0")
+    assert c.operate(ec, "batch0",
+                     ObjectOperation().write_full(b"b0"),
+                     deliver=False) is None
+    d = c.osds[g.backend.whoami]
+    d.drain()
+    c.deliver_all()
+    r = c.operate(ec, "batch0", ObjectOperation().read(0, 0))
+    assert r.outdata(0)[:2] == b"b0"
