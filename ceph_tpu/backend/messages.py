@@ -157,6 +157,24 @@ class PGLogUpdate:
 
 
 @dataclass
+class PGActivate:
+    """Primary -> replica: peering is done, serve at this epoch (the
+    MOSDPGLog-with-activation the Activating state fans out,
+    reference: PeeringState::Active constructor / activate())."""
+    from_shard: int
+    epoch: int
+    head: int = 0                 # authority log head at activation
+
+
+@dataclass
+class PGActivateAck:
+    """Replica -> primary: activated (reference: the peer_activated set
+    PeeringState::Active collects before pg goes clean)."""
+    from_shard: int
+    epoch: int
+
+
+@dataclass
 class FaultConfig:
     """Message-level fault injection (the messenger half of the Thrasher:
     the reference's ``ms inject socket failures`` / delivery randomization,

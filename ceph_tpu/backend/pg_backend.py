@@ -41,7 +41,8 @@ from enum import Enum
 
 from .memstore import GObject, MemStore, Transaction
 from .messages import (ECSubRead, ECSubReadReply, ECSubWrite, ECSubWriteReply,
-                       MessageBus, PGLogInfo, PGLogQuery, PGLogUpdate,
+                       MessageBus, PGActivate, PGActivateAck, PGLogInfo,
+                       PGLogQuery, PGLogUpdate,
                        PGScan, PGScanReply, PushOp, PushReply,
                        RollForward, Rollback)
 from .transaction import PGTransaction
@@ -79,6 +80,7 @@ class OSDShard:
         self.store = store if store is not None else MemStore()
         self.bus = bus
         self.pg_log = PGLog()
+        self.peered_epoch = 0     # last PGActivate epoch (ReplicaActive)
         # at_version -> inverse transaction restoring the pre-write state:
         # the rollback info the reference's log entries carry until the
         # write is rolled forward (ecbackend.rst:149-174)
@@ -217,6 +219,12 @@ class OSDShard:
                 self.shard, oids=sorted({g.oid for g in self.store.objects
                                          if g.shard == self.shard
                                          and g.oid != PG_META})))
+        elif isinstance(msg, PGActivate):
+            # Stray -> ReplicaActive: adopt the primary's epoch and ack
+            # (reference: PeeringState::ReplicaActive on MOSDPGLog)
+            self.peered_epoch = msg.epoch
+            self.bus.send(msg.from_shard,
+                          PGActivateAck(self.shard, msg.epoch))
         elif isinstance(msg, PGLogUpdate):
             # divergent entries past the rewind point were superseded by the
             # repair's pushes: drop their rollback data without applying it
@@ -553,6 +561,10 @@ class PGBackend:
             self.handle_push_reply(msg)
         elif isinstance(msg, PGLogInfo):
             self.handle_pg_log_info(msg)
+        elif isinstance(msg, PGActivateAck):
+            peering = getattr(self, "peering", None)
+            if peering is not None:
+                peering.on_activate_ack(msg)
         elif isinstance(msg, PGScanReply):
             self.handle_pg_scan_reply(msg)
         elif isinstance(msg, Rollback):
@@ -1009,6 +1021,15 @@ class PGBackend:
         infos = self._boot_peering
         self._boot_peering = None
         self._boot_peering_expect = set()
+        self.elect_and_adopt_authority(infos)
+
+    def elect_and_adopt_authority(self, infos: dict[int, PGLogInfo]) -> int:
+        """Authoritative-log election + divergent-entry rollback: adopt the
+        furthest-ahead witnessed log and roll back entries persisted on
+        < min_size shards (never acked).  Shared by boot peering and the
+        live peering statechart (osd/peering.py GetLog); returns the
+        commit boundary.  Reference: PeeringState GetLog merge +
+        ecbackend rollback semantics."""
         # adopt the furthest-ahead log: the primary may itself have been
         # down while peers committed (its RAM authority died with it)
         local = self.local_shard.pg_log
@@ -1067,6 +1088,7 @@ class PGBackend:
         self._rolled_forward_to = boundary
         for shard in sorted(self.up_shards()):
             self.bus.send(shard, RollForward(self.whoami, boundary))
+        return boundary
 
     def handle_pg_log_info(self, info: PGLogInfo) -> None:
         if self._boot_peering is not None and \
@@ -1075,6 +1097,15 @@ class PGBackend:
             if set(self._boot_peering) == self._boot_peering_expect:
                 self._finish_boot_peering()
             return
+        # The live peering statechart and a shard-repair op may BOTH be
+        # waiting on this shard's log state (PGLogQuery carries no
+        # correlation id, and the answer is identical either way), so the
+        # reply feeds both: peering collects it AND the repair planner
+        # still sees it — consuming it exclusively would stall whichever
+        # consumer asked second.
+        peering = getattr(self, "peering", None)
+        if peering is not None:
+            peering.offer_pg_log_info(info)
         rop = self.shard_repairs.get(info.from_shard)
         if rop is None or rop.state != RepairState.QUERY:
             return

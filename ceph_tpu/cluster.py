@@ -82,6 +82,9 @@ class PGGroup:
         from .osd.primary_log_pg import PrimaryLogPG
         self.engine = PrimaryLogPG(
             self.backend, pool_type="replicated" if ec_impl is None else "ec")
+        # the peering statechart (acting-set negotiation on map changes)
+        from .osd.peering import PeeringCoordinator
+        self.peering = PeeringCoordinator(self.backend)
 
     def shutdown(self, discard_stores: bool = False) -> None:
         # closes the primary's store too; discard skips the final
@@ -550,6 +553,7 @@ class MiniCluster:
 
         def on_map(new_map, inc):
             self.osdmap = new_map
+            affected: dict[int, PGGroup] = {}
             for o, st in inc.new_state.items():
                 if not (st & OSD_UP):
                     continue
@@ -563,6 +567,13 @@ class MiniCluster:
                         else:
                             g.bus.mark_up(o)
                             self._repair_after_boot(pid, g, o)
+                        affected[id(g)] = g
+            # AdvMap: ONE statechart round per affected PG per committed
+            # incremental, however many OSDs it flipped (GetInfo -> ... ->
+            # Active); explicit repairs above just join the repair queues
+            for g in affected.values():
+                g.peering.advance_map(new_map.epoch)
+                g.bus.deliver_all()
             if inc.new_weight:
                 # CRUSH remapping: re-place every PG, backfill the changed
                 for pid, pool in self.pools.items():

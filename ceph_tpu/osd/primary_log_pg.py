@@ -125,6 +125,7 @@ class _ExecCtx:
     exists: bool
     size: int
     attrs: dict = field(default_factory=dict)       # overlay: name -> v|None
+    attrs_cleared: bool = False     # staged delete dropped the base attrs
     omap: dict = field(default_factory=dict)        # overlay: key -> v|None
     omap_cleared: bool = False
     omap_header: bytes | None = None
@@ -143,6 +144,8 @@ class _ExecCtx:
             if self.attrs[name] is None:
                 raise KeyError(name)
             return self.attrs[name]
+        if self.attrs_cleared:      # staged delete: base attrs are gone
+            raise KeyError(name)
         store = self.engine.backend.local_shard.store
         gobj = self._gobj()
         if not store.exists(gobj):
@@ -152,7 +155,8 @@ class _ExecCtx:
     def get_attrs(self) -> dict:
         store = self.engine.backend.local_shard.store
         gobj = self._gobj()
-        base = store.getattrs(gobj) if store.exists(gobj) else {}
+        base = ({} if self.attrs_cleared or not store.exists(gobj)
+                else store.getattrs(gobj))
         base.update({k: v for k, v in self.attrs.items() if v is not None})
         for k, v in self.attrs.items():
             if v is None:
@@ -259,16 +263,24 @@ class PrimaryLogPG:
 
     def _start(self, m: MOSDOp, on_reply) -> None:
         has_write = any(self._op_mutates(op) for op in m.ops)
+        if has_write:
+            # take the per-object write slot BEFORE any async hop: a
+            # second vector arriving while this one's data read is in
+            # flight must queue, or both would read the same pre-state
+            # and commit out of order (the obc write-lock ordering)
+            self._busy.add(m.oid)
         data_reads = [op for op in m.ops if op.op in DATA_READ_OPS]
         oi = self._load_oi(m.oid)
         if data_reads:
             if has_write and self.pool_type == "ec":
                 for op in m.ops:
                     op.rval = EINVAL
-                on_reply(MOSDOpReply(EINVAL, m.ops))
+                self._finish(m, MOSDOpReply(EINVAL, m.ops),
+                             has_write, on_reply)
                 return
             if oi is None:
-                on_reply(MOSDOpReply(ENOENT, m.ops))
+                self._finish(m, MOSDOpReply(ENOENT, m.ops),
+                             has_write, on_reply)
                 return
             extents = []
             for op in data_reads:
@@ -281,7 +293,8 @@ class PrimaryLogPG:
 
             def _got(result, errors):
                 if errors:
-                    on_reply(MOSDOpReply(EINVAL, m.ops))
+                    self._finish(m, MOSDOpReply(EINVAL, m.ops),
+                                 has_write, on_reply)
                     return
                 got = {(off, ln): data
                        for off, ln, data in result.get(m.oid, [])}
@@ -297,8 +310,6 @@ class PrimaryLogPG:
         ctx = _ExecCtx(m=m, engine=self,
                        exists=oi is not None,
                        size=oi["size"] if oi else 0)
-        if has_write:
-            self._busy.add(m.oid)
         result = 0
         try:
             for op in m.ops:
@@ -467,8 +478,10 @@ class PrimaryLogPG:
             ctx.exists = False
             ctx.size = 0
             ctx.attrs = {}
+            ctx.attrs_cleared = True     # later reads must not see base
             ctx.omap = {}
             ctx.omap_cleared = True
+            ctx.omap_header = None
             ctx.mutated = ctx.user_modify = True
             return 0
         if kind == OP_SETXATTR:

@@ -344,3 +344,49 @@ def test_operate_deliver_false_batches(cluster):
     c.deliver_all()
     r = c.operate(ec, "batch0", ObjectOperation().read(0, 0))
     assert r.outdata(0)[:2] == b"b0"
+
+
+def test_staged_delete_hides_attrs_in_vector(cluster):
+    """After remove() in a vector, attr reads must see post-delete state
+    (regression: they fell through to the committed store)."""
+    c, ec, _ = cluster
+    c.operate(ec, "sdel", ObjectOperation().write_full(b"x")
+              .setxattr("a", b"1"))
+    with pytest.raises(IOError) as ei:
+        c.operate(ec, "sdel", ObjectOperation()
+                  .remove().write(0, b"b").getxattr("a"))
+    assert ei.value.errno == ENODATA
+    # the failed vector aborted atomically: old object + attr intact
+    assert c.operate(ec, "sdel", ObjectOperation()
+                     .getxattr("a")).outdata(0) == b"1"
+    assert c.operate(ec, "sdel", ObjectOperation()
+                     .read(0, 0)).outdata(0)[:1] == b"x"
+    # a cmpxattr guard after remove() must not pass against deleted attrs
+    with pytest.raises(IOError) as ei:
+        c.operate(ec, "sdel", ObjectOperation()
+                  .remove().write(0, b"c")
+                  .cmpxattr("a", CMPXATTR_EQ, b"1"))
+    assert ei.value.errno in (ENODATA, ECANCELED)
+
+
+def test_write_slot_taken_before_async_hop(cluster):
+    """A second vector on the same object must queue the moment the first
+    is accepted — even while the first is still mid-flight (regression:
+    the slot was taken only after the async read hop)."""
+    from ceph_tpu.osd.osd_ops import MOSDOp
+    c, ec, _ = cluster
+    c.operate(ec, "slot", ObjectOperation().write_full(b"v0"))
+    g = c.pg_group(ec, "slot")
+    replies = []
+    m1 = MOSDOp(oid="slot", ops=ObjectOperation().write_full(b"v1").ops,
+                epoch=g.epoch)
+    m2 = MOSDOp(oid="slot", ops=ObjectOperation().write_full(b"v2").ops,
+                epoch=g.epoch)
+    g.engine.do_op(m1, lambda r: replies.append(("m1", r.result)))
+    assert "slot" in g.engine._busy           # slot held immediately
+    g.engine.do_op(m2, lambda r: replies.append(("m2", r.result)))
+    assert len(g.engine._waiting.get("slot", ())) == 1   # m2 queued
+    g.bus.deliver_all()
+    assert [x[0] for x in replies] == ["m1", "m2"]       # ordered commits
+    assert c.operate(ec, "slot", ObjectOperation()
+                     .read(0, 0)).outdata(0)[:2] == b"v2"

@@ -29,8 +29,8 @@ def test_ops_route_through_primary_daemon(cluster):
     g = c.pg_group(pid, "obj")
     d = c.osds[g.backend.whoami]
     assert g.pgid in d.pgs
-    assert d.booted is False or True     # shell exists; drain left it empty
-    assert d.pending() == 0
+    assert d.booted is False    # boot() never ran: registered live, no sb load
+    assert d.pending() == 0     # operate() drained the shard queues
 
 
 def test_epoch_gate_bounces_stale_ops(cluster):
@@ -127,19 +127,32 @@ def test_superblock_boot(tmp_path):
 
 
 def test_primary_change_rehomes_pg():
-    c = MiniCluster(n_osds=9, osds_per_host=3, chunk_size=512)
+    """down -> auto-out -> CRUSH remap: PGs whose primary changed must be
+    re-registered on the new primary's daemon and dropped from the old."""
+    from ceph_tpu.common import Context
+    cct = Context(overrides={"mon_osd_down_out_interval": 60})
+    c = MiniCluster(n_osds=12, osds_per_host=3, chunk_size=128, cct=cct)
     pid = c.create_ec_pool("p", {"k": "2", "m": "1", "device": "numpy"},
                            pg_num=8)
     mon = c.attach_monitor()
     c.put(pid, "obj", b"data" * 100)
-    g = c.pg_group(pid, "obj")
-    old_primary = g.backend.whoami
-    # kill the primary and let the monitor route around + backfill
-    mon.osd_down(old_primary) if hasattr(mon, "osd_down") else None
-    c.osd_down(old_primary) if hasattr(c, "osd_down") else None
-    # whatever path remapped it, the daemon registry must match reality
-    for p in c.pools.values():
-        for gg in p["pgs"].values():
-            d = c.osds[gg.backend.whoami]
-            assert gg.pgid in d.pgs and d.pgs[gg.pgid] is gg
+    victim = next(g.backend.whoami               # kill a PRIMARY
+                  for g in c.pools[pid]["pgs"].values())
+    moved = [g.pgid for g in c.pools[pid]["pgs"].values()
+             if g.backend.whoami == victim]
+    reporters = [o for o in range(12) if o // 3 != victim // 3][:4]
+    for r in reporters:
+        mon.prepare_failure(victim, r, 0.0, 25.0)
+    mon.propose_pending(25.0)
+    assert mon.osdmap.is_down(victim)
+    mon.tick(2000.0)                             # auto-out -> backfill
+    assert mon.osdmap.is_out(victim)
+    # the moved PGs are gone from the dead primary's daemon...
+    for pgid in moved:
+        assert pgid not in c.osds[victim].pgs
+    # ...and every PG is hosted exactly by its current primary's daemon
+    for gg in c.pools[pid]["pgs"].values():
+        assert gg.backend.whoami != victim
+        d = c.osds[gg.backend.whoami]
+        assert gg.pgid in d.pgs and d.pgs[gg.pgid] is gg
     c.shutdown()

@@ -1,0 +1,160 @@
+"""Peering statechart: GetInfo → GetLog → GetMissing → Activating → Active.
+
+Mirrors the reference's PeeringState machine observables
+(src/osd/PeeringState.{h,cc}): transition order, authoritative-log
+election, acting-set negotiation (clean vs repair vs backfill peers),
+replica activation epochs, mid-peering failures.
+"""
+import numpy as np
+import pytest
+
+from ceph_tpu.cluster import MiniCluster
+from ceph_tpu.osd.peering import PState
+from ceph_tpu.osd.osd_ops import ObjectOperation
+
+
+@pytest.fixture
+def cluster():
+    c = MiniCluster(n_osds=9, osds_per_host=3, chunk_size=512)
+    pid = c.create_ec_pool("p", {"k": "2", "m": "1", "device": "numpy"},
+                           pg_num=4)
+    yield c, pid
+    c.shutdown()
+
+
+def _transitions(g, epoch=None):
+    return [s for e, s in g.peering.history if epoch is None or e == epoch]
+
+
+def test_full_transition_sequence(cluster):
+    c, pid = cluster
+    c.put(pid, "obj", b"x" * 2000)
+    g = c.pg_group(pid, "obj")
+    g.peering.advance_map(epoch=5)
+    g.bus.deliver_all()
+    assert g.peering.state is PState.ACTIVE
+    assert _transitions(g, 5) == [
+        PState.GET_INFO.value, PState.GET_LOG.value,
+        PState.GET_MISSING.value, PState.ACTIVATING.value,
+        PState.ACTIVE.value]
+    assert g.peering.last_epoch_started == 5
+    # clean peers all joined the negotiated acting set
+    assert sorted(g.peering.acting_set) == sorted(g.acting)
+    assert not g.peering.repair_targets
+    assert not g.peering.backfill_targets
+
+
+def test_replicas_stamp_activation_epoch(cluster):
+    c, pid = cluster
+    c.put(pid, "obj", b"y" * 1000)
+    g = c.pg_group(pid, "obj")
+    g.peering.advance_map(epoch=7)
+    g.bus.deliver_all()
+    for osd in g.acting:
+        if osd == g.backend.whoami:
+            continue
+        shard = g.bus.handlers[osd]
+        assert shard.peered_epoch == 7
+
+
+def test_stale_peer_negotiated_into_repair():
+    # k=2,m=2 (min_size 3 of 4): one shard can die and the PG stays active
+    c = MiniCluster(n_osds=8, osds_per_host=2, chunk_size=512)
+    pid = c.create_ec_pool("p", {"k": "2", "m": "2", "device": "numpy"},
+                           pg_num=4)
+    c.put(pid, "obj", b"a" * 1500)
+    g = c.pg_group(pid, "obj")
+    victim = next(o for o in g.acting if o != g.backend.whoami)
+    g.bus.mark_down(victim)
+    oid2 = next(f"obj2-{s}" for s in "xyzwvut"
+                if c.object_pg(pid, f"obj2-{s}") == c.object_pg(pid, "obj"))
+    c.put(pid, oid2, b"b" * 1500)         # same PG: victim misses this write
+    g.bus.mark_up(victim)
+    g.peering.advance_map(epoch=9)
+    g.bus.deliver_all()
+    assert g.peering.state is PState.ACTIVE
+    # the stale shard was negotiated as a repair target and caught up
+    assert victim in g.peering.repair_targets | g.peering.backfill_targets
+    shard = g.bus.handlers[victim]
+    assert shard.pg_log.head == g.backend.pg_log.head
+    c.shutdown()
+
+
+def test_primary_adopts_authority_from_peer(cluster):
+    """A primary whose log is behind a peer's must adopt the peer's log in
+    GetLog (find_best_info elects the peer)."""
+    c, pid = cluster
+    c.put(pid, "obj", b"c" * 1000)
+    g = c.pg_group(pid, "obj")
+    # fabricate staleness: rewind the primary's authority + local logs
+    peer_head = g.backend.pg_log.head
+    assert peer_head > 0
+    from ceph_tpu.osd.pg_log import PGLog
+    g.backend.pg_log = PGLog()            # primary lost its in-RAM log
+    g.peering.advance_map(epoch=11)
+    g.bus.deliver_all()
+    assert g.peering.state is PState.ACTIVE
+    assert g.backend.pg_log.head == peer_head     # adopted from the peer
+
+
+def test_peer_death_mid_peering(cluster):
+    c, pid = cluster
+    c.put(pid, "obj", b"d" * 1000)
+    g = c.pg_group(pid, "obj")
+    victim = next(o for o in g.acting if o != g.backend.whoami)
+    # advance without delivering: GetInfo is in flight
+    g.peering.advance_map(epoch=13)
+    assert g.peering.state is PState.GET_INFO
+    g.bus.mark_down(victim)               # dies before answering
+    g.bus.deliver_all()
+    assert g.peering.state is PState.ACTIVE
+    assert victim not in g.peering.acting_set
+
+
+def test_monitor_down_up_drives_statechart(cluster):
+    c, pid = cluster
+    mon = c.attach_monitor()
+    c.put(pid, "obj", b"e" * 1200)
+    g = c.pg_group(pid, "obj")
+    victim = next(o for o in g.acting if o != g.backend.whoami)
+    # one reporter from each of the two OTHER hosts (distinct subtrees)
+    other_hosts = sorted({o // 3 for o in range(9)} - {victim // 3})
+    reporters = [h * 3 for h in other_hosts][:2]
+    t0 = 100.0
+    grace = 25.0
+    for rep in reporters:
+        mon.prepare_failure(victim, rep, failed_since=t0, now=t0 + 1)
+    mon.prepare_failure(victim, reporters[0], failed_since=t0,
+                        now=t0 + grace)
+    assert mon.propose_pending(t0 + grace) is not None   # down committed
+    assert g.peering.state is PState.ACTIVE
+    runs = len([e for e, s in g.peering.history
+                if s == PState.GET_INFO.value])
+    mon.osd_boot(victim)
+    assert mon.propose_pending(t0 + grace + 1) is not None   # up committed
+    assert g.peering.state is PState.ACTIVE
+    assert len([e for e, s in g.peering.history
+                if s == PState.GET_INFO.value]) > runs
+    # the PG still serves after the churn
+    r = c.operate(pid, "obj", ObjectOperation().read(0, 0))
+    assert r.outdata(0)[:4] == b"eeee"
+
+
+def test_parked_write_redrives_after_peering(cluster):
+    """Below min_size the PG parks writes; peering back to Active with the
+    revived shard re-drives them (the reference's waiting_for_peered)."""
+    from ceph_tpu.cluster import BlockedWriteError
+    c, pid = cluster
+    c.put(pid, "obj", b"f" * 900)
+    g = c.pg_group(pid, "obj")
+    peers = [o for o in g.acting if o != g.backend.whoami]
+    for o in peers:
+        g.bus.mark_down(o)                # k=2,m=1: below min_size
+    with pytest.raises(BlockedWriteError):
+        c.put(pid, "obj", b"g" * 900)
+    for o in peers:
+        g.bus.mark_up(o)
+    g.peering.advance_map(epoch=17)
+    g.bus.deliver_all()
+    assert g.peering.state is PState.ACTIVE
+    assert c.get(pid, "obj", 900) == b"g" * 900   # parked write committed
