@@ -175,6 +175,15 @@ class PGActivateAck:
 
 
 @dataclass
+class _WireEnvelope:
+    """A framed message in flight (wire-mode bus): real bytes between
+    send and delivery.  from_shard survives outside the frame so the
+    reorder scheduler keeps per-sender FIFO without parsing."""
+    from_shard: int | None
+    frame: bytes
+
+
+@dataclass
 class FaultConfig:
     """Message-level fault injection (the messenger half of the Thrasher:
     the reference's ``ms inject socket failures`` / delivery randomization,
@@ -199,12 +208,21 @@ class FaultConfig:
 
 
 class MessageBus:
-    """Per-shard FIFO queues; handlers registered per shard id."""
+    """Per-shard FIFO queues; handlers registered per shard id.
 
-    def __init__(self):
+    ``wire=True`` runs every message through the v2-style frame codec
+    (backend/wire.py): send serializes to integrity-protected bytes,
+    delivery parses them back — so codec/registration bugs and corrupted
+    payloads surface as frame errors instead of silent shared-object
+    aliasing.  ``wire_secret`` switches the frames from crc to secure
+    (HMAC) mode, e.g. with a cephx session key."""
+
+    def __init__(self, wire: bool = False, wire_secret: bytes | None = None):
         self.queues: dict[int, deque] = {}
         self.handlers: dict[int, object] = {}
         self.down: set[int] = set()
+        self.wire = wire
+        self.wire_secret = wire_secret
         self.delivered = 0
         self.dropped = 0
         self.duplicated = 0
@@ -248,6 +266,11 @@ class MessageBus:
                 self._fault_rng.random() < f.drop_prob:
             self.dropped += 1
             return
+        if self.wire:
+            from .wire import message_encode
+            sender = getattr(msg, "from_shard", None)
+            msg = _WireEnvelope(
+                sender, message_encode(msg, secret=self.wire_secret))
         self.queues.setdefault(to_shard, deque()).append(msg)
 
     def _pick(self, q: deque):
@@ -275,6 +298,11 @@ class MessageBus:
         if not q or shard in self.down:
             return False
         msg = self._pick(q)
+        if isinstance(msg, _WireEnvelope):
+            from .wire import FrameParser, message_decode
+            parser = FrameParser(self.wire_secret)
+            [(tag, segs)] = parser.feed(msg.frame)
+            msg = message_decode(tag, segs)
         handler = self.handlers[shard]
         handler.handle_message(msg)
         self.delivered += 1

@@ -81,6 +81,7 @@ class OSDShard:
         self.bus = bus
         self.pg_log = PGLog()
         self.peered_epoch = 0     # last PGActivate epoch (ReplicaActive)
+        self.peered_head = 0      # authority log head at that activation
         # at_version -> inverse transaction restoring the pre-write state:
         # the rollback info the reference's log entries carry until the
         # write is rolled forward (ecbackend.rst:149-174)
@@ -220,9 +221,12 @@ class OSDShard:
                                          if g.shard == self.shard
                                          and g.oid != PG_META})))
         elif isinstance(msg, PGActivate):
-            # Stray -> ReplicaActive: adopt the primary's epoch and ack
+            # Stray -> ReplicaActive: adopt the primary's epoch and the
+            # authority head it activated at (a later repair rewinding
+            # past this head would mean the primary regressed), then ack
             # (reference: PeeringState::ReplicaActive on MOSDPGLog)
             self.peered_epoch = msg.epoch
+            self.peered_head = msg.head
             self.bus.send(msg.from_shard,
                           PGActivateAck(self.shard, msg.epoch))
         elif isinstance(msg, PGLogUpdate):

@@ -564,6 +564,12 @@ class MiniCluster:
                             continue
                         if down_now:
                             g.bus.mark_down(o)
+                            if o == g.backend.whoami:
+                                # the PRIMARY died: its coordinator cannot
+                                # peer (replies to a down shard drop);
+                                # re-homing happens via the weight/backfill
+                                # path, which rebuilds the group
+                                continue
                         else:
                             g.bus.mark_up(o)
                             self._repair_after_boot(pid, g, o)

@@ -85,9 +85,6 @@ class PeeringCoordinator:
         self.state = state
         self.history.append((self.epoch, state.value))
 
-    def is_active(self) -> bool:
-        return self.state in (PState.INITIAL, PState.ACTIVE)
-
     # -- events ------------------------------------------------------------
 
     def advance_map(self, epoch: int) -> None:
@@ -105,7 +102,10 @@ class PeeringCoordinator:
             self._got_all_infos()
             return
         for shard in sorted(peers):
-            b.bus.send(shard, PGLogQuery(b.whoami, since=0))
+            # entries below our tail are trimmed cluster-wide, so the
+            # reply only ships the segment election/repair can use (the
+            # same bound start_shard_repair queries with)
+            b.bus.send(shard, PGLogQuery(b.whoami, since=b.pg_log.tail))
 
     def offer_pg_log_info(self, info: PGLogInfo) -> bool:
         """MNotifyRec: a peer's info arrived.  Returns False when this
@@ -132,9 +132,7 @@ class PeeringCoordinator:
         if self.state == PState.GET_INFO and shard in self._expect_infos:
             self._expect_infos.discard(shard)
             self._infos.pop(shard, None)
-            if self._expect_infos and set(self._infos) >= self._expect_infos:
-                self._got_all_infos()
-            elif not self._expect_infos:
+            if set(self._infos) >= self._expect_infos:
                 self._got_all_infos()
         elif self.state == PState.ACTIVATING and shard in self._expect_acks:
             self._expect_acks.discard(shard)

@@ -158,3 +158,34 @@ def test_parked_write_redrives_after_peering(cluster):
     g.bus.deliver_all()
     assert g.peering.state is PState.ACTIVE
     assert c.get(pid, "obj", 900) == b"g" * 900   # parked write committed
+
+
+def test_replicas_record_activation_head(cluster):
+    c, pid = cluster
+    c.put(pid, "hobj", b"h" * 800)
+    g = c.pg_group(pid, "hobj")
+    g.peering.advance_map(epoch=21)
+    g.bus.deliver_all()
+    for osd in g.acting:
+        if osd != g.backend.whoami:
+            shard = g.bus.handlers[osd]
+            assert shard.peered_head == g.backend.pg_log.head
+
+
+def test_primary_death_skips_statechart(cluster):
+    """A down-mark for the PG's own primary must NOT re-run its peering
+    (replies to a dead shard drop — it would wedge in GetInfo)."""
+    c, pid = cluster
+    mon = c.attach_monitor()
+    c.put(pid, "pobj", b"p" * 800)
+    g = c.pg_group(pid, "pobj")
+    primary = g.backend.whoami
+    runs = len(g.peering.history)
+    other_hosts = sorted({o // 3 for o in range(9)} - {primary // 3})
+    reporters = [h * 3 for h in other_hosts][:2]
+    for rep in reporters:
+        mon.prepare_failure(primary, rep, failed_since=10.0, now=11.0)
+    mon.prepare_failure(primary, reporters[0], failed_since=10.0, now=40.0)
+    assert mon.propose_pending(40.0) is not None
+    assert len(g.peering.history) == runs          # no GetInfo wedge
+    assert g.peering.state is not PState.GET_INFO

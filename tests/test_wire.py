@@ -1,0 +1,160 @@
+"""Wire protocol: framing, integrity modes, handshake, wire-mode bus.
+
+Mirrors the reference's ProtocolV2 frame semantics
+(src/msg/async/frames_v2.h, ProtocolV2.cc): preamble-validated lengths,
+per-segment crc32c, secure (MAC) mode, banner/hello handshake, and the
+rule that corruption is DETECTED, never silently delivered.
+"""
+import numpy as np
+import pytest
+
+from ceph_tpu.backend import wire
+from ceph_tpu.backend.messages import (
+    ECSubWrite, ECSubWriteReply, FaultConfig, MessageBus, PGActivate,
+    PGLogInfo, PGLogQuery, PushOp,
+)
+from ceph_tpu.backend.memstore import GObject, Transaction
+
+
+def test_frame_roundtrip_and_incremental_parse():
+    segs = [b"header", b"x" * 1000, b"tail"]
+    buf = wire.frame_encode(wire.TAG_MESSAGE, segs)
+    p = wire.FrameParser()
+    # drip-feed byte by byte: nothing yields until the frame completes
+    out = []
+    for i in range(len(buf)):
+        out += p.feed(buf[i:i + 1])
+        if i < len(buf) - 1:
+            assert out == []
+    assert out == [(wire.TAG_MESSAGE, segs)]
+    # two frames in one feed
+    p2 = wire.FrameParser()
+    assert p2.feed(buf + buf) == [(wire.TAG_MESSAGE, segs)] * 2
+
+
+def test_corruption_detected_everywhere():
+    buf = bytearray(wire.frame_encode(wire.TAG_MESSAGE, [b"abc", b"defg"]))
+    for pos in range(len(buf)):
+        mutated = bytearray(buf)
+        mutated[pos] ^= 0x40
+        p = wire.FrameParser()
+        with pytest.raises(wire.WireError):
+            frames = p.feed(bytes(mutated))
+            # a flipped bit may land in a length field that makes the
+            # frame look longer: starve it and force the verdict
+            if not frames:
+                raise wire.WireError("incomplete (length corrupted)")
+            raise AssertionError(f"byte {pos} corruption undetected")
+
+
+def test_secure_mode_mac():
+    key = b"k" * 32
+    buf = wire.frame_encode(wire.TAG_MESSAGE, [b"secret", b"data"],
+                            secret=key)
+    assert wire.FrameParser(key).feed(buf) == [
+        (wire.TAG_MESSAGE, [b"secret", b"data"])]
+    with pytest.raises(wire.WireError):
+        wire.FrameParser(b"wrong" * 7).feed(buf)
+    tampered = bytearray(buf)
+    tampered[-20] ^= 1
+    with pytest.raises(wire.WireError):
+        wire.FrameParser(key).feed(bytes(tampered))
+
+
+def test_message_codec_all_types():
+    t = Transaction().write(GObject("o", 1), 0, b"abc").setattr(
+        GObject("o", 1), "k", b"v")
+    samples = [
+        ECSubWrite(0, 7, t, at_version=3),
+        ECSubWriteReply(1, 7),
+        PGLogQuery(0, since=2),
+        PGLogInfo(2, 9, 1, entries=[]),
+        PGActivate(0, 12, head=9),
+        PushOp(0, 5, "obj", {1: b"chunk"}),
+    ]
+    for msg in samples:
+        buf = wire.message_encode(msg)
+        [(tag, segs)] = wire.FrameParser().feed(buf)
+        back = wire.message_decode(tag, segs)
+        assert type(back) is type(msg)
+        assert getattr(back, "from_shard", None) == \
+            getattr(msg, "from_shard", None)
+
+
+def test_message_decode_rejects_unknown_type():
+    frame = wire.frame_encode(wire.TAG_MESSAGE,
+                              [b"NotAMessage", b"payload"])
+    [(tag, segs)] = wire.FrameParser().feed(frame)
+    with pytest.raises(wire.WireError):
+        wire.message_decode(tag, segs)
+
+
+def test_handshake():
+    a = wire.FramedConnection("osd.0")
+    b = wire.FramedConnection("osd.1")
+    assert not a.ready and not b.ready
+    a_bytes, b_bytes = bytes(a.out), bytes(b.out)
+    a.receive(b_bytes)
+    b.receive(a_bytes)
+    assert a.ready and a.peer_hello.entity == "osd.1"
+    assert b.ready and b.peer_hello.entity == "osd.0"
+    a.out.clear()
+    a.send(PGLogQuery(0, since=1))
+    msgs = b.receive(bytes(a.out))
+    assert isinstance(msgs[0], PGLogQuery) and msgs[0].since == 1
+
+
+def test_handshake_banner_mismatch():
+    a = wire.FramedConnection("osd.0")
+    with pytest.raises(wire.WireError):
+        a.receive(b"ceph v027 legacy banner....." + b"\0" * 32)
+
+
+def test_send_before_handshake_fails():
+    a = wire.FramedConnection("osd.0")
+    with pytest.raises(wire.WireError):
+        a.send(PGLogQuery(0))
+
+
+def test_wire_mode_bus_end_to_end():
+    """A full MiniCluster over wire-mode buses: every sub-op serializes
+    to framed bytes and back; data still roundtrips bit-exact."""
+    import ceph_tpu.cluster as cluster_mod
+    from ceph_tpu.cluster import MiniCluster
+    orig = cluster_mod.MessageBus
+    cluster_mod.MessageBus = lambda: MessageBus(wire=True)
+    try:
+        c = MiniCluster(n_osds=9, osds_per_host=3, chunk_size=512)
+        pid = c.create_ec_pool("w", {"k": "2", "m": "1", "device": "numpy"},
+                               pg_num=4)
+        payload = np.random.default_rng(0).integers(
+            0, 256, 5000, np.uint8).tobytes()
+        c.put(pid, "obj", payload)
+        g = c.pg_group(pid, "obj")
+        assert g.bus.wire
+        # degraded read over the wire too
+        victim = next(o for o in g.acting if o != g.backend.whoami)
+        g.bus.mark_down(victim)
+        assert c.get(pid, "obj", 5000) == payload
+        g.bus.mark_up(victim)
+        c.shutdown()
+    finally:
+        cluster_mod.MessageBus = orig
+
+
+def test_wire_mode_with_faults():
+    """Wire framing composes with cross-sender reorder + dup injection."""
+    bus = MessageBus(wire=True)
+    got = []
+
+    class H:
+        def handle_message(self, m):
+            got.append(m)
+    bus.register(1, H())
+    bus.inject_faults(FaultConfig(seed=3, reorder=True, dup_prob=0.5))
+    for i in range(10):
+        bus.send(1, PGLogQuery(0, since=i))
+    bus.deliver_all()
+    assert len(got) >= 10
+    assert {m.since for m in got} == set(range(10))
+    assert all(isinstance(m, PGLogQuery) for m in got)
