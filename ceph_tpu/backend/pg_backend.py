@@ -82,6 +82,7 @@ class OSDShard:
         self.pg_log = PGLog()
         self.peered_epoch = 0     # last PGActivate epoch (ReplicaActive)
         self.peered_head = 0      # authority log head at that activation
+        self.activation_regressions = 0   # rollbacks below peered_head
         # at_version -> inverse transaction restoring the pre-write state:
         # the rollback info the reference's log entries carry until the
         # write is rolled forward (ecbackend.rst:149-174)
@@ -210,6 +211,12 @@ class OSDShard:
         elif isinstance(msg, RollForward):
             self._roll_forward(msg.to)
         elif isinstance(msg, Rollback):
+            if msg.to < self.peered_head:
+                # the primary is rewinding below the head it ACTIVATED us
+                # at — acked state regressing.  Legitimate only in crash
+                # recovery where < min_size witnesses survive; surfaced
+                # as a counter so scrub/ops can tell the two apart.
+                self.activation_regressions += 1
             self._rollback(msg.to)
         elif isinstance(msg, PGLogQuery):
             self.bus.send(msg.from_shard, PGLogInfo(

@@ -176,6 +176,7 @@ class FramedConnection:
         self.parser = FrameParser(secret)
         self.out = bytearray()
         self.peer_hello: Hello | None = None
+        self._banner_buf = bytearray()
         self._banner_seen = False
         self.out += BANNER
         self.out += frame_encode(
@@ -193,13 +194,18 @@ class FramedConnection:
     def receive(self, data: bytes) -> list:
         msgs = []
         if not self._banner_seen:
-            if len(data) < len(BANNER):
-                raise WireError("short banner")
-            if data[:len(BANNER)] != BANNER:
+            # buffer like the frame parser: a banner split across reads
+            # is normal stream behavior, not an error
+            self._banner_buf += data
+            if len(self._banner_buf) < len(BANNER):
+                return msgs
+            if self._banner_buf[:len(BANNER)] != BANNER:
                 raise WireError(
-                    f"banner mismatch: {bytes(data[:len(BANNER)])!r}")
+                    f"banner mismatch: "
+                    f"{bytes(self._banner_buf[:len(BANNER)])!r}")
             self._banner_seen = True
-            data = data[len(BANNER):]
+            data = bytes(self._banner_buf[len(BANNER):])
+            self._banner_buf.clear()
         for tag, segs in self.parser.feed(data):
             if tag == TAG_HELLO:
                 self.peer_hello = pickle.loads(segs[0])

@@ -36,6 +36,7 @@ class _Op:
     oid: str
     data: bytes | None                    # None => read
     read_len: int = 0
+    ops: list | None = None               # op VECTOR (IoCtx::operate path)
     on_complete: object = None
     target: tuple | None = None           # (ps, primary, acting) last sent
     attempts: int = 0
@@ -77,6 +78,20 @@ class Objecter:
         self._send_op(op)
         return op.tid
 
+    def operate(self, pool_id: int, oid: str, op,
+                on_complete=None) -> int:
+        """Submit a librados-style op VECTOR (ObjectOperation) through the
+        full client lifecycle — epoch-stamped target, stale reject +
+        resend on map change — landing in the primary's op engine
+        (IoCtx::operate -> op_submit -> PrimaryLogPG::do_osd_ops).
+        ``on_complete`` receives the MOSDOpReply."""
+        self.next_tid += 1
+        o = _Op(self.next_tid, pool_id, oid, None, ops=list(op.ops),
+                on_complete=on_complete)
+        self.inflight[o.tid] = o
+        self._send_op(o)
+        return o.tid
+
     def read(self, pool_id: int, oid: str, length: int) -> bytes:
         """Synchronous read convenience (librados rados_read shape)."""
         self.next_tid += 1
@@ -103,7 +118,7 @@ class Objecter:
         op.target = (ps, primary, acting)
         reply = self.cluster.osd_submit(
             op.pool_id, ps, primary, self.osdmap.epoch,
-            oid=op.oid, data=op.data, read_len=op.read_len,
+            oid=op.oid, data=op.data, read_len=op.read_len, ops=op.ops,
             on_done=lambda result, _op=op: self._op_done(_op, result))
         if reply is not None:             # ("stale", current_map)
             _, newer = reply

@@ -373,6 +373,35 @@ class MiniCluster:
             raise BlockedWriteError(
                 f"batch writes blocked on inactive PGs: {missing}")
 
+    def _dispatch_op_vector(self, g, pool_id: int, oid: str, ops,
+                            epoch: int, on_done, drain: bool = True):
+        """ONE copy of the MOSDOp dispatch path (used by operate() and
+        the Objecter-facing osd_submit): daemon queue -> op engine, with
+        object bookkeeping in the COMPLETION callback — a write parked on
+        an inactive PG has not hit the store yet, so bookkeeping at
+        dispatch time would let a later backfill drop the acked object.
+        Returns None when accepted, or ("stale", current_map)."""
+        from .backend.memstore import GObject
+        from .osd.osd_ops import MOSDOp
+        daemon = self.osds[g.backend.whoami]
+
+        def _done(reply):
+            if g.backend.local_shard.store.exists(
+                    GObject(oid, g.backend.whoami)):
+                self.objects.setdefault(pool_id, set()).add(oid)
+            else:
+                self.objects.get(pool_id, set()).discard(oid)
+            if on_done:
+                on_done(reply)
+        res = daemon.ms_dispatch(
+            g.pgid, MOSDOp(oid=oid, ops=ops, epoch=epoch), _done)
+        if res is not None:
+            return res
+        if drain:
+            daemon.drain()
+            g.bus.deliver_all()
+        return None
+
     def operate(self, pool_id: int, oid: str, op,
                 deliver: bool = True):
         """Execute a librados-style op vector atomically on ``oid``
@@ -382,21 +411,15 @@ class MiniCluster:
         op is only queued on the primary's daemon (returns None); the
         caller drains the daemon and delivers the bus itself — batch
         submission, like put(deliver=False)."""
-        from .backend.memstore import GObject
-        from .osd.osd_ops import MOSDOp
         g = self.pg_group(pool_id, oid)
         out: list = []
-        # through the primary's daemon: epoch gate + mClock shard queue
-        daemon = self.osds[g.backend.whoami]
-        res = daemon.ms_dispatch(
-            g.pgid, MOSDOp(oid=oid, ops=op.ops, epoch=self.osdmap.epoch),
-            out.append)
+        res = self._dispatch_op_vector(g, pool_id, oid, op.ops,
+                                       self.osdmap.epoch, out.append,
+                                       drain=deliver)
         if res is not None:
             raise IOError(f"op on {oid} bounced as stale: {res}")
         if not deliver:
             return None
-        daemon.drain()
-        g.bus.deliver_all()
         if not out:
             raise BlockedWriteError(
                 f"op on {oid} blocked: PG {g.pgid} inactive")
@@ -406,11 +429,6 @@ class MiniCluster:
             err.errno = reply.result
             err.reply = reply
             raise err
-        # object bookkeeping from ground truth: the primary's store
-        if g.backend.local_shard.store.exists(GObject(oid, g.backend.whoami)):
-            self.objects.setdefault(pool_id, set()).add(oid)
-        else:
-            self.objects.get(pool_id, set()).discard(oid)
         return reply
 
     def get(self, pool_id: int, oid: str, length: int) -> bytes:
@@ -433,15 +451,24 @@ class MiniCluster:
 
     def osd_submit(self, pool_id: int, ps: int, target_osd: int,
                    client_epoch: int, oid: str, data: bytes | None,
-                   read_len: int = 0, on_done=None):
+                   read_len: int = 0, on_done=None, ops=None):
         """One client op arriving at an OSD.  Returns None when accepted
         (completion via ``on_done``), or ``("stale", current_map)`` when
         the client's map is too old for this PG — wrong primary, or an
         epoch predating the PG's current acting set — mirroring the OSD's
-        require_same_or_newer_map + "client has old map" resend dance."""
+        require_same_or_newer_map + "client has old map" resend dance.
+        ``ops`` carries an op VECTOR through the daemon queue into the
+        primary's op engine (the MOSDOp path); data/read_len are the
+        legacy whole-object put/get shape."""
         g = self.pools[pool_id]["pgs"][ps]
         if target_osd != g.backend.whoami or client_epoch < g.epoch:
             return ("stale", self.osdmap)
+        if ops is not None:
+            res = self._dispatch_op_vector(g, pool_id, oid, ops,
+                                           client_epoch, on_done)
+            if res is not None:
+                return ("stale", self.osdmap)
+            return None
         if data is not None:
             # wait=False: an inactive PG parks the op, which stays in the
             # objecter's inflight list until it commits — the reference's
@@ -500,7 +527,12 @@ class MiniCluster:
         # read everything out of the old layout FIRST: in durable mode the
         # new group reopens the same per-(osd, pg) directories, so the old
         # stores must be drained and closed before the new ones open
+        from .backend.ecutil import HINFO_KEY
+        from .backend.memstore import GObject
+        from .backend.replicated import VERSION_KEY
         contents: dict[str, bytes] = {}
+        metadata: dict[str, tuple] = {}       # oid -> (attrs, omap, header)
+        store = old.backend.local_shard.store
         for oid in self._pg_objects(pool_id, old):
             size = old.backend.object_size(oid)
             out = {}
@@ -512,6 +544,18 @@ class MiniCluster:
             if out.get("errors"):
                 raise IOError(f"backfill read of {oid}: {out['errors']}")
             contents[oid] = out["result"][oid][0][2]
+            # object metadata moves with the data: attrs (minus per-layout
+            # internals — hinfo is chunk-layout-specific, @version is
+            # re-stamped by the new group's log) plus omap on replicated
+            gobj = GObject(oid, old.backend.whoami)
+            attrs = {k: v for k, v in store.getattrs(gobj).items()
+                     if k not in (HINFO_KEY, VERSION_KEY)} \
+                if store.exists(gobj) else {}
+            omap = store.get_omap(gobj) if ec is None and \
+                store.exists(gobj) else {}
+            header = store.get_omap_header(gobj) if ec is None and \
+                store.exists(gobj) else b""
+            metadata[oid] = (attrs, omap, header)
         old.shutdown(discard_stores=self.data_dir is not None)
         if self.data_dir is not None:
             import shutil
@@ -526,7 +570,15 @@ class MiniCluster:
                       store_factory=self._store_factory(pool_id, ps),
                       epoch=self.osdmap.epoch)
         for oid, data in contents.items():
-            new.backend.submit_transaction(PGTransaction().write(oid, 0, data))
+            t = PGTransaction().write(oid, 0, data)
+            attrs, omap, header = metadata[oid]
+            objop = t.ops[oid]
+            objop.attr_updates.update(attrs)
+            if omap:
+                objop.omap_ops.append(("set", omap))
+            if header:
+                objop.omap_ops.append(("header", header))
+            new.backend.submit_transaction(t)
             new.bus.deliver_all()
         self.pools[pool_id]["pgs"][ps] = new
         # re-home the PG on its (possibly new) primary's daemon
@@ -564,14 +616,17 @@ class MiniCluster:
                             continue
                         if down_now:
                             g.bus.mark_down(o)
-                            if o == g.backend.whoami:
-                                # the PRIMARY died: its coordinator cannot
-                                # peer (replies to a down shard drop);
-                                # re-homing happens via the weight/backfill
-                                # path, which rebuilds the group
-                                continue
                         else:
                             g.bus.mark_up(o)
+                        if new_map.is_down(g.backend.whoami):
+                            # the PRIMARY is dead (this flip or an earlier
+                            # one): its coordinator cannot peer and its
+                            # repairs cannot complete (replies to a down
+                            # shard drop) — the group is moribund until
+                            # the weight/backfill path re-homes it or the
+                            # primary itself boots back
+                            continue
+                        if not down_now:
                             self._repair_after_boot(pid, g, o)
                         affected[id(g)] = g
             # AdvMap: ONE statechart round per affected PG per committed

@@ -189,3 +189,59 @@ def test_primary_death_skips_statechart(cluster):
     assert mon.propose_pending(40.0) is not None
     assert len(g.peering.history) == runs          # no GetInfo wedge
     assert g.peering.state is not PState.GET_INFO
+
+
+def test_parked_write_survives_backfill_bookkeeping():
+    """An op-vector write acked AFTER parking must still be known to the
+    backfill object list (regression: bookkeeping ran at dispatch time,
+    before the parked write hit the store)."""
+    from ceph_tpu.cluster import MiniCluster
+    c = MiniCluster(n_osds=9, osds_per_host=3, chunk_size=512)
+    pid = c.create_ec_pool("pk", {"k": "2", "m": "1", "device": "numpy"},
+                           pg_num=4)
+    g = c.pg_group(pid, "parked")
+    peers = [o for o in g.acting if o != g.backend.whoami]
+    for o in peers:
+        g.bus.mark_down(o)
+    done = []
+    res = c.osd_submit(pid, c.object_pg(pid, "parked"), g.backend.whoami,
+                       c.osdmap.epoch, "parked", None,
+                       ops=ObjectOperation().write_full(b"parked!").ops,
+                       on_done=done.append)
+    assert res is None and not done           # accepted, parked
+    assert "parked" not in c.objects.get(pid, set())
+    for o in peers:
+        g.bus.mark_up(o)
+    g.bus.deliver_all()
+    assert done and done[0].result == 0       # committed on revival
+    assert "parked" in c.objects[pid]         # bookkeeping at completion
+    c.shutdown()
+
+
+def test_batched_incremental_with_dead_primary_no_wedge():
+    """One incremental marking the primary AND a replica down must not
+    run the dead primary's statechart (regression: the guard was
+    per-state-entry, so the replica's flip still advanced it)."""
+    from ceph_tpu.cluster import MiniCluster
+    c = MiniCluster(n_osds=9, osds_per_host=3, chunk_size=512)
+    pid = c.create_ec_pool("bp", {"k": "2", "m": "1", "device": "numpy"},
+                           pg_num=4)
+    mon = c.attach_monitor()
+    c.put(pid, "obj", b"q" * 800)
+    g = c.pg_group(pid, "obj")
+    primary = g.backend.whoami
+    replica = next(o for o in g.acting if o != primary)
+    runs = len(g.peering.history)
+    # report BOTH down so one propose commits a batched incremental
+    for victim in (primary, replica):
+        hosts = sorted({o // 3 for o in range(9)} - {victim // 3})
+        reps = [h * 3 for h in hosts if h * 3 not in (primary, replica)][:2]
+        for rep in reps:
+            mon.prepare_failure(victim, rep, failed_since=5.0, now=6.0)
+        mon.prepare_failure(victim, reps[0], failed_since=5.0, now=35.0)
+    new = mon.propose_pending(35.0)
+    assert new is not None
+    assert new.is_down(primary) and new.is_down(replica)
+    assert len(g.peering.history) == runs, "dead primary's statechart ran"
+    assert g.peering.state is not PState.GET_INFO
+    c.shutdown()

@@ -158,3 +158,13 @@ def test_wire_mode_with_faults():
     assert len(got) >= 10
     assert {m.since for m in got} == set(range(10))
     assert all(isinstance(m, PGLogQuery) for m in got)
+
+
+def test_banner_split_across_reads():
+    a = wire.FramedConnection("osd.0")
+    b = wire.FramedConnection("osd.1")
+    payload = bytes(b.out)
+    # drip the peer's banner+hello in 3-byte chunks: must buffer, not fail
+    for i in range(0, len(payload), 3):
+        a.receive(payload[i:i + 3])
+    assert a.ready and a.peer_hello.entity == "osd.1"
