@@ -183,6 +183,16 @@ class ECBackend(PGBackend):
             self.bus.send(shard, ECSubRead(self.whoami, op._rmw_read_tid,
                                            to_read))
 
+    def _apply_attr_updates(self, oid: str, objop, shard_txns) -> None:
+        """Replicate the op's attr updates to every shard's transaction."""
+        for shard in self.acting:
+            obj = GObject(oid, shard)
+            for name, value in objop.attr_updates.items():
+                if value is None:
+                    shard_txns[shard].rmattr(obj, name)
+                else:
+                    shard_txns[shard].setattr(obj, name, value)
+
     def _generate_transactions(self, op: Op):
         """(ECBackend.cc:1930-2087 / ECTransaction.cc generate_transactions):
         encode the will-write extents in one batched device call and
@@ -192,6 +202,29 @@ class ECBackend(PGBackend):
         log_entries = []
         for oid, will_write in op.plan.will_write.items():
             objop = op.plan.t.ops[oid]
+            if objop.clone_to:
+                # snapshot COW: clone the PRE-op shard chunks (+ attrs,
+                # incl. hinfo — a chunk-wise clone is exact for EC)
+                for shard in self.acting:
+                    src = GObject(oid, shard)
+                    for clone_oid in objop.clone_to:
+                        shard_txns[shard].clone(src, GObject(clone_oid,
+                                                             shard))
+            if objop.rollback_from is not None:
+                # replace head wholesale with the clone's shard state;
+                # the cached head hinfo is now stale — the cloned attrs
+                # carry the authoritative one.  attr updates staged by
+                # the op engine (object_info/snapset) land ON TOP of the
+                # cloned attrs, in the same atomic transaction.
+                for shard in self.acting:
+                    shard_txns[shard].clone(
+                        GObject(objop.rollback_from, shard),
+                        GObject(oid, shard))
+                self._apply_attr_updates(oid, objop, shard_txns)
+                log_entries.append(self.pg_log.append(oid, OP_MODIFY))
+                self.hinfo_cache.pop(oid, None)
+                op.plan.hash_infos.pop(oid, None)
+                continue
             hinfo = op.plan.hash_infos[oid]
             hinfo.version += 1      # down shards miss this bump -> stale
             # one pg_log entry per touched object (pg_log_entry_t); a pure
@@ -229,13 +262,7 @@ class ECBackend(PGBackend):
                 # A delete+recreate vector (delete_first AND new writes)
                 # keeps its re-staged attrs: the remove is already queued
                 # above, so these setattrs land on the fresh object.
-                for shard in self.acting:
-                    obj = GObject(oid, shard)
-                    for name, value in objop.attr_updates.items():
-                        if value is None:
-                            shard_txns[shard].rmattr(obj, name)
-                        else:
-                            shard_txns[shard].setattr(obj, name, value)
+                self._apply_attr_updates(oid, objop, shard_txns)
             if not will_write:
                 if not objop.delete_first:
                     self._persist_hinfo(oid, hinfo, shard_txns)

@@ -81,6 +81,10 @@ class ReplicatedBackend(PGBackend):
             for shard in self.acting:
                 obj = GObject(oid, shard)
                 t = shard_txns[shard]
+                for clone_oid in objop.clone_to:
+                    t.clone(obj, GObject(clone_oid, shard))   # COW first
+                if objop.rollback_from is not None:
+                    t.clone(GObject(objop.rollback_from, shard), obj)
                 if objop.delete_first:
                     t.remove(obj)
                 if objop.truncate is not None:

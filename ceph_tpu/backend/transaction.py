@@ -37,6 +37,14 @@ class ObjectOperation:
     # omap mutations in order: ("set", {k: v}) | ("rm", [k]) | ("clear",)
     # — replicated pools only; EC pools reject omap like the reference
     omap_ops: list[tuple] = field(default_factory=list)
+    # snapshot copy-on-write: clone this object's PRE-op state to each
+    # listed oid before mutations apply (PGTransaction's clone op; the
+    # make_writable COW, src/osd/PrimaryLogPG.cc).  Shard-local clones
+    # are exact for both pool types (chunks clone chunk-wise).
+    clone_to: list[str] = field(default_factory=list)
+    # snapshot rollback: replace this object wholesale with the named
+    # source object's state (CEPH_OSD_OP_ROLLBACK -> _rollback_to)
+    rollback_from: str | None = None
 
     def write(self, offset: int, data: bytes) -> "ObjectOperation":
         self.buffer_updates.append((offset, bytes(data)))

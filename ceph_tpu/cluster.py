@@ -246,7 +246,10 @@ class MiniCluster:
                        "type": p["pool"].type,
                        "size": p["pool"].size,
                        "params": dict(p["pool"].params),
-                       "pg_num": p["pool"].pg_num}
+                       "pg_num": p["pool"].pg_num,
+                       "snap_seq": p["pool"].snap_seq,
+                       "snaps": dict(p["pool"].snaps),
+                       "removed_snaps": set(p["pool"].removed_snaps)}
                       for _, p in sorted(self.pools.items())],
         }
         tmp = self.data_dir / "cluster_meta.pkl.tmp"
@@ -269,9 +272,14 @@ class MiniCluster:
                 chunk_size=meta["chunk_size"], cct=cct, data_dir=data_dir)
         for p in meta["pools"]:
             if p["type"] == POOL_TYPE_REPLICATED:
-                c.create_replicated_pool(p["name"], p["size"], p["pg_num"])
+                pid = c.create_replicated_pool(p["name"], p["size"],
+                                               p["pg_num"])
             else:
-                c.create_ec_pool(p["name"], p["params"], p["pg_num"])
+                pid = c.create_ec_pool(p["name"], p["params"], p["pg_num"])
+            pool = c.pools[pid]["pool"]
+            pool.snap_seq = p.get("snap_seq", 0)
+            pool.snaps = dict(p.get("snaps", {}))
+            pool.removed_snaps = set(p.get("removed_snaps", ()))
         for pid, pool in c.pools.items():
             for g in pool["pgs"].values():
                 # crash recovery first: elect the authoritative log and
@@ -320,6 +328,22 @@ class MiniCluster:
             done.append(tid)
             if on_commit:
                 on_commit(tid)
+        if self.pools[pool_id]["pool"].snap_seq:
+            # pool snapshots exist: the write MUST run through the op
+            # engine so make_writable clones the head at snap boundaries
+            # (bypassing it would silently break snapshot isolation)
+            from .osd.osd_ops import ObjectOperation
+            res = self._dispatch_op_vector(
+                g, pool_id, oid,
+                ObjectOperation().write(0, bytes(data) + b"\0" * pad).ops,
+                self.osdmap.epoch,
+                lambda reply: _committed(reply.version), drain=deliver)
+            if res is not None:
+                raise IOError(f"put of {oid} bounced as stale: {res}")
+            if deliver and wait and not done:
+                raise BlockedWriteError(
+                    f"write of {oid} blocked: PG {g.pgid} inactive")
+            return g
         g.backend.submit_transaction(
             PGTransaction().write(oid, 0, bytes(data) + b"\0" * pad),
             on_commit=_committed)
@@ -343,7 +367,9 @@ class MiniCluster:
         if not objects:
             return
         pool = self.pools[pool_id]
-        if pool["ec"] is None:
+        if pool["ec"] is None or pool["pool"].snap_seq:
+            # replicated: nothing to batch-encode.  Snapped pools: every
+            # write must run the op engine's COW (put handles both).
             for oid, data in objects.items():
                 self.put(pool_id, oid, data, wait=wait)
             return
@@ -373,8 +399,19 @@ class MiniCluster:
             raise BlockedWriteError(
                 f"batch writes blocked on inactive PGs: {missing}")
 
+    def _snap_context(self, pool_id: int):
+        """The pool's live SnapContext (what librados attaches to every
+        write once pool snaps exist)."""
+        from .osd.osd_ops import SnapContext
+        pool = self.pools[pool_id]["pool"]
+        if not pool.snap_seq:
+            return None
+        return SnapContext(pool.snap_seq,
+                           tuple(sorted(pool.snaps, reverse=True)))
+
     def _dispatch_op_vector(self, g, pool_id: int, oid: str, ops,
-                            epoch: int, on_done, drain: bool = True):
+                            epoch: int, on_done, drain: bool = True,
+                            snapid: int | None = None):
         """ONE copy of the MOSDOp dispatch path (used by operate() and
         the Objecter-facing osd_submit): daemon queue -> op engine, with
         object bookkeeping in the COMPLETION callback — a write parked on
@@ -394,7 +431,8 @@ class MiniCluster:
             if on_done:
                 on_done(reply)
         res = daemon.ms_dispatch(
-            g.pgid, MOSDOp(oid=oid, ops=ops, epoch=epoch), _done)
+            g.pgid, MOSDOp(oid=oid, ops=ops, epoch=epoch, snapid=snapid,
+                           snapc=self._snap_context(pool_id)), _done)
         if res is not None:
             return res
         if drain:
@@ -403,7 +441,7 @@ class MiniCluster:
         return None
 
     def operate(self, pool_id: int, oid: str, op,
-                deliver: bool = True):
+                deliver: bool = True, snapid: int | None = None):
         """Execute a librados-style op vector atomically on ``oid``
         through the primary's op engine (IoCtx::operate →
         PrimaryLogPG::do_osd_ops).  Returns the MOSDOpReply; raises
@@ -415,7 +453,7 @@ class MiniCluster:
         out: list = []
         res = self._dispatch_op_vector(g, pool_id, oid, op.ops,
                                        self.osdmap.epoch, out.append,
-                                       drain=deliver)
+                                       drain=deliver, snapid=snapid)
         if res is not None:
             raise IOError(f"op on {oid} bounced as stale: {res}")
         if not deliver:
@@ -446,6 +484,81 @@ class MiniCluster:
         for p in self.pools.values():
             for g in p["pgs"].values():
                 g.bus.deliver_all()
+
+    # -- pool snapshots (the mon's 'osd pool mksnap/rmsnap') ----------------
+
+    def create_pool_snap(self, pool_id: int, name: str) -> int:
+        """Issue a pool snapshot: bumps snap_seq; subsequent writes carry
+        the new SnapContext and COW-clone heads at first touch
+        (pg_pool_t::add_snap)."""
+        pool = self.pools[pool_id]["pool"]
+        if name in pool.snaps.values():
+            raise ValueError(f"pool snap {name!r} already exists")
+        pool.snap_seq += 1
+        pool.snaps[pool.snap_seq] = name
+        self._save_meta()
+        return pool.snap_seq
+
+    def remove_pool_snap(self, pool_id: int, name: str) -> None:
+        """Delete a pool snapshot and queue snaptrim: clone objects of the
+        removed snap are deleted by BACKGROUND work riding the daemons'
+        mClock queues under BG_SNAPTRIM — trimming cannot starve client
+        ops (pg_pool_t::remove_snap + the SnapTrimmer)."""
+        from .osd.mclock import BG_SNAPTRIM
+        from .osd.primary_log_pg import SNAP_SEP, SS_ATTR
+        from .backend.memstore import GObject
+        pool = self.pools[pool_id]["pool"]
+        snapid = next((s for s, n in pool.snaps.items() if n == name), None)
+        if snapid is None:
+            raise ValueError(f"no pool snap named {name!r}")
+        del pool.snaps[snapid]
+        pool.removed_snaps.add(snapid)
+        self._save_meta()
+        live = set(pool.snaps)
+        for g in self.pools[pool_id]["pgs"].values():
+            daemon = self.osds[g.backend.whoami]
+
+            def trim(g=g, live=live):
+                # A clone with id c covers the snaps in (previous clone,
+                # c]; it is removable only when NO live snap remains in
+                # that interval (the reference deletes a clone when its
+                # per-clone snaps list empties, SnapTrimmer).
+                store = g.backend.local_shard.store
+                whoami = g.backend.whoami
+                t = PGTransaction()
+                clones_by_head: dict[str, list[int]] = {}
+                for gobj in store.list_objects():
+                    if gobj.shard != whoami or SNAP_SEP not in gobj.oid:
+                        continue
+                    head, _, cid = gobj.oid.rpartition(SNAP_SEP)
+                    clones_by_head.setdefault(head, []).append(int(cid))
+                for head, clones in sorted(clones_by_head.items()):
+                    clones.sort()
+                    keep = []
+                    for i, c in enumerate(clones):
+                        prev = clones[i - 1] if i else 0
+                        if any(prev < s <= c for s in live):
+                            keep.append(c)
+                            continue
+                        t.delete(f"{head}{SNAP_SEP}{c}")
+                    if keep != clones:
+                        hobj = GObject(head, whoami)
+                        if store.exists(hobj):
+                            try:
+                                ss = dict(store.getattr(hobj, SS_ATTR))
+                            except KeyError:
+                                ss = {"seq": 0, "clones": [], "sizes": {}}
+                            ss["clones"] = keep
+                            ss["sizes"] = {k: v
+                                           for k, v in ss["sizes"].items()
+                                           if int(k) in keep}
+                            t.touch(head).setattr(SS_ATTR, ss)
+                if t.ops:
+                    g.backend.submit_transaction(t)
+                    g.bus.deliver_all()
+            daemon.queue_background(g.pgid, trim, op_class=BG_SNAPTRIM)
+            daemon.drain()
+            g.bus.deliver_all()
 
     # -- RADOS protocol surface (what an Objecter talks to) ----------------
 
@@ -533,7 +646,12 @@ class MiniCluster:
         contents: dict[str, bytes] = {}
         metadata: dict[str, tuple] = {}       # oid -> (attrs, omap, header)
         store = old.backend.local_shard.store
-        for oid in self._pg_objects(pool_id, old):
+        # ground truth from the primary's own store, not just client
+        # bookkeeping: snapshot CLONES are real objects the engine
+        # created internally and must move with their heads
+        moving = sorted(set(self._pg_objects(pool_id, old)) |
+                        set(old.backend._local_oids()))
+        for oid in moving:
             size = old.backend.object_size(oid)
             out = {}
             old.backend.objects_read_and_reconstruct(

@@ -40,12 +40,14 @@ OP_OMAPRMKEYS = "omap_rm_keys"
 OP_OMAPCLEAR = "omap_clear"
 OP_OMAP_CMP = "omap_cmp"
 OP_CALL = "call"
+OP_ROLLBACK = "rollback"
+OP_LIST_SNAPS = "list_snaps"
 
 # ops that mutate object state (CEPH_OSD_FLAG_WRITE classification)
 WRITE_OPS = frozenset({
     OP_CREATE, OP_WRITE, OP_WRITEFULL, OP_APPEND, OP_ZERO, OP_TRUNCATE,
     OP_DELETE, OP_SETXATTR, OP_RMXATTR, OP_OMAPSETVALS, OP_OMAPSETHEADER,
-    OP_OMAPRMKEYS, OP_OMAPCLEAR,
+    OP_OMAPRMKEYS, OP_OMAPCLEAR, OP_ROLLBACK,
 })
 # ops that need object DATA from the (possibly degraded) store
 DATA_READ_OPS = frozenset({OP_READ, OP_SPARSE_READ, OP_CMPEXT})
@@ -167,6 +169,24 @@ class ObjectOperation:
         return self._add(OP_CALL, cls=cls, method=method,
                          indata=bytes(indata))
 
+    # snapshots
+    def rollback(self, snapid: int):
+        """CEPH_OSD_OP_ROLLBACK: restore the object to its state at
+        ``snapid`` (must be the only mutation in the vector)."""
+        return self._add(OP_ROLLBACK, snapid=snapid)
+
+    def list_snaps(self):
+        return self._add(OP_LIST_SNAPS)
+
+
+@dataclass
+class SnapContext:
+    """The write-time snap context (SnapContext, src/include/rados.h):
+    ``seq`` is the newest snap id the client knows, ``snaps`` the live
+    snap ids newest-first."""
+    seq: int = 0
+    snaps: tuple = ()
+
 
 @dataclass
 class MOSDOp:
@@ -176,6 +196,8 @@ class MOSDOp:
     epoch: int = 0
     client: str = "client"
     tid: int = 0
+    snapid: int | None = None          # read AT this snap (None = head)
+    snapc: SnapContext | None = None   # write-time snap context
 
 
 @dataclass

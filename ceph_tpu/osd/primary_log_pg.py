@@ -42,8 +42,9 @@ from .osd_ops import (
     MOSDOp, MOSDOpReply, OP_APPEND, OP_CALL, OP_CMPEXT, OP_CMPXATTR,
     OP_CREATE, OP_DELETE, OP_GETXATTR, OP_GETXATTRS, OP_OMAPCLEAR,
     OP_OMAPGETHEADER, OP_OMAPGETKEYS, OP_OMAPGETVALS, OP_OMAPGETVALSBYKEYS,
-    OP_OMAPRMKEYS, OP_OMAPSETHEADER, OP_OMAPSETVALS, OP_OMAP_CMP, OP_READ,
-    OP_RMXATTR, OP_SETXATTR, OP_SPARSE_READ, OP_STAT, OP_TRUNCATE,
+    OP_LIST_SNAPS, OP_OMAPRMKEYS, OP_OMAPSETHEADER, OP_OMAPSETVALS,
+    OP_OMAP_CMP, OP_READ, OP_RMXATTR, OP_ROLLBACK, OP_SETXATTR,
+    OP_SPARSE_READ, OP_STAT, OP_TRUNCATE,
     OP_WRITE, OP_WRITEFULL, OP_ZERO, OSDOp, WRITE_OPS,
 )
 
@@ -52,10 +53,23 @@ ENOENT, EEXIST, EINVAL = -2, -17, -22
 ENODATA = -61
 EOPNOTSUPP = -95
 ECANCELED = -125
+EROFS = -30
+ENOTSUP_COMBINED = -22    # rollback combined with other mutations
 MAX_ERRNO = 4095          # cmpext mismatch: -(MAX_ERRNO + offset)
 
 OI_ATTR = "_"             # object_info_t xattr (src/osd/osd_types.h)
+SS_ATTR = "snapset"       # SnapSet xattr (src/osd/osd_types.h SS_ATTR)
 USER_PREFIX = "_"         # user xattr "foo" is stored as "_foo"
+SNAP_SEP = "\x00snap\x00"  # clone object namespace (ghobject snap field
+                           # analog; NUL keeps user oids collision-free)
+
+
+def clone_oid(oid: str, snapid: int) -> str:
+    return f"{oid}{SNAP_SEP}{snapid}"
+
+
+def is_clone_oid(oid: str) -> bool:
+    return SNAP_SEP in oid
 # non-user attrs that share the "_" prefix (internal attrs otherwise use
 # non-"_" prefixes — e.g. the replicated backend's "@version" — so they
 # cannot collide with any user name)
@@ -261,8 +275,51 @@ class PrimaryLogPG:
             return bool(meth and meth.mutates)
         return False
 
+    def _load_snapset(self, oid: str) -> dict:
+        """The head's SnapSet.  An existing head without the attr simply
+        has no clones (cheap).  Only a MISSING head (deleted under
+        snapshots — the reference keeps a snapdir object for this case)
+        pays a store scan to rediscover its clones."""
+        store = self.backend.local_shard.store
+        gobj = GObject(oid, self.backend.whoami)
+        if store.exists(gobj):
+            try:
+                return dict(store.getattr(gobj, SS_ATTR))
+            except KeyError:
+                return {"seq": 0, "clones": [], "sizes": {}}
+        prefix = oid + SNAP_SEP
+        clones = sorted(
+            int(g.oid[len(prefix):]) for g in store.list_objects()
+            if g.shard == self.backend.whoami and g.oid.startswith(prefix))
+        return {"seq": max(clones, default=0), "clones": clones,
+                "sizes": {}}
+
+    def _resolve_snap(self, oid: str, snapid: int) -> str | None:
+        """find_object_context's snap resolution: clone c covers snaps up
+        to c; a read at snap s hits the oldest clone >= s, else the head.
+        None = the object did not exist at that snap (head postdates it:
+        snapset.seq is stamped at creation/COW) -> ENOENT."""
+        ss = self._load_snapset(oid)
+        for c in sorted(ss["clones"]):
+            if c >= snapid:
+                return clone_oid(oid, c)
+        if snapid <= ss["seq"]:
+            return None
+        return oid
+
     def _start(self, m: MOSDOp, on_reply) -> None:
         has_write = any(self._op_mutates(op) for op in m.ops)
+        if m.snapid is not None:
+            # snaps are read-only; resolve the whole vector onto the
+            # covering clone (or the head)
+            if has_write:
+                on_reply(MOSDOpReply(EROFS, m.ops))
+                return
+            resolved = self._resolve_snap(m.oid, m.snapid)
+            if resolved is None:        # object postdates the snap
+                on_reply(MOSDOpReply(ENOENT, m.ops))
+                return
+            m.oid = resolved
         if has_write:
             # take the per-object write slot BEFORE any async hop: a
             # second vector arriving while this one's data read is in
@@ -310,6 +367,29 @@ class PrimaryLogPG:
         ctx = _ExecCtx(m=m, engine=self,
                        exists=oi is not None,
                        size=oi["size"] if oi else 0)
+        # make_writable (PrimaryLogPG::make_writable): first mutation of
+        # an existing head under a NEWER snap context clones the pre-op
+        # state to <oid>@<newest snap> — copy-on-write at snap boundaries
+        if has_write and m.snapc is not None and not is_clone_oid(m.oid):
+            if ctx.exists:
+                ss = self._load_snapset(m.oid)
+                if m.snapc.seq > ss["seq"] and m.snapc.snaps:
+                    newest = max(m.snapc.snaps)
+                    ctx.objop().clone_to.append(clone_oid(m.oid, newest))
+                    ss["clones"] = sorted(set(ss["clones"]) | {newest})
+                    ss["sizes"] = dict(ss["sizes"])
+                    ss["sizes"][newest] = ctx.size
+                    ss["seq"] = m.snapc.seq
+                    ctx.stage_attr(SS_ATTR, ss)
+            else:
+                # creation under a snap context stamps the seq so reads
+                # at PRE-creation snaps resolve to ENOENT, not to the
+                # head (the reference stamps snapset.seq the same way).
+                # _load_snapset DISCOVERS orphaned clones of a deleted
+                # head, so re-creation keeps its snap history (snapdir).
+                ss = self._load_snapset(m.oid)
+                ss["seq"] = max(ss["seq"], m.snapc.seq)
+                ctx.stage_attr(SS_ATTR, ss)
         result = 0
         try:
             for op in m.ops:
@@ -496,6 +576,57 @@ class PrimaryLogPG:
                 raise OpError(EINVAL)
             self._require(ctx)
             ctx.stage_attr(USER_PREFIX + p["name"], None)
+            return 0
+
+        # ---- snapshots
+        if kind == OP_LIST_SNAPS:
+            ss = self._load_snapset(ctx.m.oid)
+            op.outdata = {"seq": ss["seq"],
+                          "clones": [{"snapid": c,
+                                      "size": ss["sizes"].get(c)}
+                                     for c in sorted(ss["clones"])]}
+            return 0
+        if kind == OP_ROLLBACK:
+            if any(o is not op and self._op_mutates(o) for o in ctx.m.ops):
+                # rollback replaces the object wholesale at the store
+                # level; mixing it with other mutations in one vector is
+                # rejected (the reference serializes it through its own
+                # transaction machinery instead)
+                raise OpError(ENOTSUP_COMBINED)
+            # the STAGED snapset wins: make_writable may have just COWed
+            # the pre-rollback head in this very vector (rollback after a
+            # newer snap) — re-reading the store would clobber that
+            # update and orphan the fresh clone
+            try:
+                ss = dict(ctx.get_attr(SS_ATTR))
+            except KeyError:
+                ss = self._load_snapset(ctx.m.oid)
+            cands = [c for c in sorted(ss["clones"]) if c >= p["snapid"]]
+            if not cands:
+                # rolling back to the head state: no-op on an existing
+                # head, ENOENT when there is nothing to restore
+                self._require(ctx)
+                return 0
+            src = clone_oid(ctx.m.oid, cands[0])
+            snap = cands[0]
+            objop = ctx.objop()
+            objop.rollback_from = src
+            # the clone's attrs replace the head's, EXCEPT the SnapSet:
+            # the head keeps knowing all its clones (the reference's
+            # snapset stays on the head/snapdir through rollback)
+            objop.attr_updates[SS_ATTR] = ss
+            fallback = ss["sizes"].get(snap, ss["sizes"].get(str(snap)))
+            store = self.backend.local_shard.store
+            try:
+                src_oi = dict(store.getattr(
+                    GObject(src, self.backend.whoami), OI_ATTR))
+                ctx.size = src_oi["size"]
+            except (FileNotFoundError, KeyError):
+                ctx.size = fallback if fallback is not None else ctx.size
+            ctx.exists = True             # a deleted head is recreated
+            ctx.attrs_cleared = True      # head attrs replaced by clone's
+            ctx.attrs = {}
+            ctx.mutated = ctx.user_modify = True
             return 0
 
         # ---- object classes

@@ -75,6 +75,12 @@ class Pool:
     erasure_code_profile: str = ""
     name: str = ""
     params: dict = field(default_factory=dict)
+    # pool snapshots (pg_pool_t::snap_seq / snaps / removed_snaps,
+    # src/osd/osd_types.h): snap_seq is the newest issued snap id,
+    # snaps maps live snap ids -> names, removed_snaps awaits snaptrim
+    snap_seq: int = 0
+    snaps: dict = field(default_factory=dict)          # snapid -> name
+    removed_snaps: set = field(default_factory=set)
 
     def __post_init__(self):
         if not self.pgp_num:

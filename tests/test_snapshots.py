@@ -1,0 +1,294 @@
+"""Pool snapshots: COW clones, snap reads, rollback, list_snaps, trim.
+
+Mirrors the reference's snapshot semantics (src/osd/PrimaryLogPG.cc
+make_writable COW + find_object_context snap resolution + _rollback_to;
+pg_pool_t snap bookkeeping; the SnapTrimmer running as background work):
+writes after a pool snap clone the head at first touch, reads at a snap
+id resolve to the covering clone, rollback restores a snapped state,
+and removing a snap trims its clones under the BG_SNAPTRIM QoS class.
+"""
+import numpy as np
+import pytest
+
+from ceph_tpu.cluster import MiniCluster
+from ceph_tpu.osd.osd_ops import ObjectOperation
+from ceph_tpu.osd.primary_log_pg import EROFS, clone_oid
+
+
+@pytest.fixture(params=["ec", "rep"])
+def cluster(request):
+    c = MiniCluster(n_osds=9, osds_per_host=3, chunk_size=512)
+    if request.param == "ec":
+        pid = c.create_ec_pool("p", {"k": "2", "m": "1", "device": "numpy"},
+                               pg_num=4)
+    else:
+        pid = c.create_replicated_pool("p", size=3, pg_num=4)
+    yield c, pid
+    c.shutdown()
+
+
+def _data(n, seed):
+    return np.random.default_rng(seed).integers(
+        0, 256, n, dtype=np.uint8).tobytes()
+
+
+def test_snapshot_isolation(cluster):
+    c, pid = cluster
+    v1 = _data(3000, 1)
+    c.operate(pid, "obj", ObjectOperation().write_full(v1)
+              .setxattr("gen", b"1"))
+    s1 = c.create_pool_snap(pid, "before")
+    v2 = _data(2000, 2)
+    c.operate(pid, "obj", ObjectOperation().write_full(v2)
+              .setxattr("gen", b"2"))
+    # head sees v2; the snap sees v1 (data AND attrs)
+    assert c.operate(pid, "obj", ObjectOperation()
+                     .read(0, 0)).outdata(0)[:2000] == v2
+    r = c.operate(pid, "obj", ObjectOperation().read(0, 0).getxattr("gen"),
+                  snapid=s1)
+    assert r.outdata(0)[:3000] == v1
+    assert r.outdata(1) == b"1"
+
+
+def test_multiple_snap_levels(cluster):
+    c, pid = cluster
+    versions = {}
+    snaps = {}
+    for i in range(3):
+        versions[i] = _data(1000 + 200 * i, 10 + i)
+        c.operate(pid, "ml", ObjectOperation().write_full(versions[i]))
+        snaps[i] = c.create_pool_snap(pid, f"s{i}")
+    final = _data(500, 99)
+    c.operate(pid, "ml", ObjectOperation().write_full(final))
+    for i in range(3):
+        r = c.operate(pid, "ml", ObjectOperation().read(0, 0),
+                      snapid=snaps[i])
+        assert r.outdata(0)[:len(versions[i])] == versions[i], i
+    assert c.operate(pid, "ml", ObjectOperation()
+                     .read(0, 0)).outdata(0)[:500] == final
+
+
+def test_no_cow_without_intervening_snap(cluster):
+    """Two writes under the SAME snap seq clone only once."""
+    c, pid = cluster
+    c.operate(pid, "once", ObjectOperation().write_full(b"a" * 600))
+    c.create_pool_snap(pid, "s")
+    c.operate(pid, "once", ObjectOperation().write_full(b"b" * 600))
+    c.operate(pid, "once", ObjectOperation().write_full(b"c" * 600))
+    r = c.operate(pid, "once", ObjectOperation().list_snaps())
+    assert len(r.outdata(0)["clones"]) == 1
+
+
+def test_list_snaps(cluster):
+    c, pid = cluster
+    c.operate(pid, "ls", ObjectOperation().write_full(b"x" * 700))
+    s1 = c.create_pool_snap(pid, "a")
+    c.operate(pid, "ls", ObjectOperation().write_full(b"y" * 300))
+    r = c.operate(pid, "ls", ObjectOperation().list_snaps())
+    out = r.outdata(0)
+    assert [cl["snapid"] for cl in out["clones"]] == [s1]
+    assert out["clones"][0]["size"] == 700      # v1's logical size
+    assert out["seq"] >= s1
+
+
+def test_rollback(cluster):
+    c, pid = cluster
+    v1 = _data(2500, 3)
+    c.operate(pid, "rb", ObjectOperation().write_full(v1)
+              .setxattr("tag", b"old"))
+    s1 = c.create_pool_snap(pid, "keep")
+    c.operate(pid, "rb", ObjectOperation().write_full(b"clobbered")
+              .setxattr("tag", b"new"))
+    c.operate(pid, "rb", ObjectOperation().rollback(s1))
+    r = c.operate(pid, "rb", ObjectOperation().read(0, 0).getxattr("tag")
+                  .list_snaps())
+    assert r.outdata(0)[:2500] == v1
+    assert r.outdata(1) == b"old"               # attrs restored too
+    # the head still knows its clones after rollback
+    assert [cl["snapid"] for cl in r.outdata(2)["clones"]] == [s1]
+
+
+def test_rollback_recreates_deleted_head(cluster):
+    c, pid = cluster
+    v1 = _data(1200, 4)
+    c.operate(pid, "undel", ObjectOperation().write_full(v1))
+    s1 = c.create_pool_snap(pid, "pre")
+    c.operate(pid, "undel", ObjectOperation().remove())
+    # head gone, snap still readable (clone discovered without the head)
+    r = c.operate(pid, "undel", ObjectOperation().read(0, 0), snapid=s1)
+    assert r.outdata(0)[:1200] == v1
+    c.operate(pid, "undel", ObjectOperation().rollback(s1))
+    assert c.operate(pid, "undel", ObjectOperation()
+                     .read(0, 0)).outdata(0)[:1200] == v1
+
+
+def test_writes_at_snap_rejected(cluster):
+    c, pid = cluster
+    c.operate(pid, "ro", ObjectOperation().write_full(b"w" * 600))
+    s1 = c.create_pool_snap(pid, "rosnap")
+    with pytest.raises(IOError) as ei:
+        c.operate(pid, "ro", ObjectOperation().write_full(b"nope"),
+                  snapid=s1)
+    assert ei.value.errno == EROFS
+
+
+def test_rollback_combined_with_write_rejected(cluster):
+    c, pid = cluster
+    c.operate(pid, "comb", ObjectOperation().write_full(b"z" * 600))
+    s1 = c.create_pool_snap(pid, "c")
+    c.operate(pid, "comb", ObjectOperation().write_full(b"zz" * 300))
+    with pytest.raises(IOError):
+        c.operate(pid, "comb", ObjectOperation().rollback(s1)
+                  .write(0, b"no"))
+
+
+def test_snap_trim_removes_clones(cluster):
+    c, pid = cluster
+    from ceph_tpu.backend.memstore import GObject
+    c.operate(pid, "tr", ObjectOperation().write_full(b"t" * 900))
+    s1 = c.create_pool_snap(pid, "doomed")
+    c.operate(pid, "tr", ObjectOperation().write_full(b"u" * 900))
+    g = c.pg_group(pid, "tr")
+    cl = clone_oid("tr", s1)
+    assert g.backend.local_shard.store.exists(
+        GObject(cl, g.backend.whoami))
+    c.remove_pool_snap(pid, "doomed")
+    assert not g.backend.local_shard.store.exists(
+        GObject(cl, g.backend.whoami))
+    # the head's snapset no longer lists the trimmed clone
+    r = c.operate(pid, "tr", ObjectOperation().list_snaps())
+    assert r.outdata(0)["clones"] == []
+    # head data untouched by the trim
+    assert c.operate(pid, "tr", ObjectOperation()
+                     .read(0, 0)).outdata(0)[:900] == b"u" * 900
+
+
+def test_snap_read_degraded(cluster):
+    """Snap reads reconstruct like any other read when a shard is down."""
+    c, pid = cluster
+    v1 = _data(4000, 5)
+    c.operate(pid, "deg", ObjectOperation().write_full(v1))
+    s1 = c.create_pool_snap(pid, "dsnap")
+    c.operate(pid, "deg", ObjectOperation().write_full(b"new" * 100))
+    g = c.pg_group(pid, "deg")
+    victim = next(o for o in g.acting if o != g.backend.whoami)
+    g.bus.mark_down(victim)
+    try:
+        r = c.operate(pid, "deg", ObjectOperation().read(0, 0), snapid=s1)
+        assert r.outdata(0)[:4000] == v1
+    finally:
+        g.bus.mark_up(victim)
+
+
+def test_snapshots_survive_restart(tmp_path):
+    """Durable mode: snaps, clones, and snapsets reload with the stores."""
+    c = MiniCluster(n_osds=9, osds_per_host=3, chunk_size=512,
+                    data_dir=tmp_path)
+    pid = c.create_ec_pool("p", {"k": "2", "m": "1", "device": "numpy"},
+                           pg_num=4)
+    v1 = _data(1500, 6)
+    c.operate(pid, "dur", ObjectOperation().write_full(v1))
+    s1 = c.create_pool_snap(pid, "persist")
+    c.operate(pid, "dur", ObjectOperation().write_full(b"head" * 100))
+    c.shutdown()
+    c2 = MiniCluster.load(tmp_path)
+    pool = c2.pools[pid]["pool"]
+    assert pool.snaps == {s1: "persist"}
+    r = c2.operate(pid, "dur", ObjectOperation().read(0, 0), snapid=s1)
+    assert r.outdata(0)[:1500] == v1
+    assert c2.operate(pid, "dur", ObjectOperation()
+                      .read(0, 0)).outdata(0)[:400] == b"head" * 100
+    c2.shutdown()
+
+
+def test_shared_clone_survives_newer_snap_removal(cluster):
+    """A clone covering several snaps must survive removal of the newest
+    one while an older snap still depends on it (regression: trim
+    deleted any clone tagged with the removed id)."""
+    c, pid = cluster
+    v1 = _data(1100, 20)
+    c.operate(pid, "sh", ObjectOperation().write_full(v1))
+    s1 = c.create_pool_snap(pid, "old")
+    s2 = c.create_pool_snap(pid, "new")
+    # first write AFTER both snaps: ONE clone (tagged s2) covers s1 + s2
+    c.operate(pid, "sh", ObjectOperation().write_full(b"head" * 100))
+    c.remove_pool_snap(pid, "new")
+    # snap s1 still resolves to the shared clone and reads v1
+    r = c.operate(pid, "sh", ObjectOperation().read(0, 0), snapid=s1)
+    assert r.outdata(0)[:1100] == v1
+    # removing the LAST dependent snap finally trims it
+    c.remove_pool_snap(pid, "old")
+    assert c.operate(pid, "sh", ObjectOperation()
+                     .list_snaps()).outdata(0)["clones"] == []
+
+
+def test_rollback_after_newer_snap_keeps_fresh_clone(cluster):
+    """rollback under a newer snap context COWs the pre-rollback head
+    first; the fresh clone must stay recorded (regression: the rollback
+    handler clobbered the snapset staged by make_writable)."""
+    c, pid = cluster
+    v1, v2 = _data(900, 21), _data(900, 22)
+    c.operate(pid, "rc", ObjectOperation().write_full(v1))
+    s1 = c.create_pool_snap(pid, "s1")
+    c.operate(pid, "rc", ObjectOperation().write_full(v2))
+    s2 = c.create_pool_snap(pid, "s2")
+    c.operate(pid, "rc", ObjectOperation().rollback(s1))
+    # head restored to v1; snap s2 still reads v2 via the fresh clone
+    assert c.operate(pid, "rc", ObjectOperation()
+                     .read(0, 0)).outdata(0)[:900] == v1
+    r = c.operate(pid, "rc", ObjectOperation().read(0, 0), snapid=s2)
+    assert r.outdata(0)[:900] == v2
+    snaps = c.operate(pid, "rc", ObjectOperation().list_snaps()).outdata(0)
+    assert [cl["snapid"] for cl in snaps["clones"]] == [s1, s2]
+
+
+def test_read_at_precreation_snap_is_enoent(cluster):
+    """An object created AFTER a snap must not exist at that snap
+    (regression: the first write's content was backdated)."""
+    c, pid = cluster
+    s1 = c.create_pool_snap(pid, "early")
+    c.operate(pid, "late", ObjectOperation().write_full(b"v1" * 200))
+    c.operate(pid, "late", ObjectOperation().write_full(b"v2" * 200))
+    with pytest.raises(IOError) as ei:
+        c.operate(pid, "late", ObjectOperation().read(0, 0), snapid=s1)
+    assert ei.value.errno == -2
+
+
+def test_legacy_put_respects_cow(cluster):
+    """The whole-object put() API honors snapshots too (regression:
+    it bypassed the op engine entirely)."""
+    c, pid = cluster
+    v1 = _data(1000, 23)
+    c.put(pid, "lp", v1)
+    s1 = c.create_pool_snap(pid, "lps")
+    c.put(pid, "lp", _data(1000, 24))
+    r = c.operate(pid, "lp", ObjectOperation().read(0, 0), snapid=s1)
+    assert r.outdata(0)[:1000] == v1
+
+
+def test_backfill_preserves_clones():
+    """Snapshot clones move with their heads on remap (regression:
+    backfill only moved bookkept head objects)."""
+    from ceph_tpu.common import Context
+    cct = Context(overrides={"mon_osd_down_out_interval": 60})
+    c = MiniCluster(n_osds=12, osds_per_host=3, chunk_size=256, cct=cct)
+    pid = c.create_ec_pool("bf", {"k": "2", "m": "1", "device": "numpy"},
+                           pg_num=4)
+    mon = c.attach_monitor()
+    v1 = _data(800, 25)
+    c.operate(pid, "snapped", ObjectOperation().write_full(v1))
+    s1 = c.create_pool_snap(pid, "keep")
+    c.operate(pid, "snapped", ObjectOperation().write_full(b"x" * 800))
+    g = c.pg_group(pid, "snapped")
+    victim = next(o for o in range(12)
+                  if o not in {gg.backend.whoami
+                               for gg in c.pools[pid]["pgs"].values()})
+    reporters = [o for o in range(12) if o != victim][:4]
+    for r in reporters:
+        mon.prepare_failure(victim, r, 0.0, 25.0)
+    mon.propose_pending(25.0)
+    mon.tick(5000.0)                     # auto-out -> remap + backfill
+    assert mon.osdmap.is_out(victim)
+    r = c.operate(pid, "snapped", ObjectOperation().read(0, 0), snapid=s1)
+    assert r.outdata(0)[:800] == v1      # clone survived the move
+    c.shutdown()
