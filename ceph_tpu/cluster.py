@@ -449,6 +449,15 @@ class MiniCluster:
         op is only queued on the primary's daemon (returns None); the
         caller drains the daemon and delivers the bus itself — batch
         submission, like put(deliver=False)."""
+        if snapid is not None and \
+                snapid not in self.pools[pool_id]["pool"].snaps:
+            # reads at a removed (or never-issued) pool snap are ENOENT
+            # even while a shared clone still covers the id for an older
+            # live snap (the reference validates the snap against the
+            # pool before resolution)
+            err = IOError(f"snap {snapid} does not exist in pool {pool_id}")
+            err.errno = -2
+            raise err
         g = self.pg_group(pool_id, oid)
         out: list = []
         res = self._dispatch_op_vector(g, pool_id, oid, op.ops,
@@ -485,6 +494,42 @@ class MiniCluster:
             for g in p["pgs"].values():
                 g.bus.deliver_all()
 
+    # -- scrub (PG::scrub scheduling through the daemons' op queues) --------
+
+    def scrub_pool(self, pool_id: int, repair: bool = True) -> dict:
+        """Deep-scrub every PG of the pool as BG_SCRUB work on the
+        primaries' mClock queues (scrubs cannot starve clients), compare
+        every shard against the authority, and (with ``repair``) queue
+        shard repairs for inconsistencies — the reference's
+        'ceph pg deep-scrub' + repair flow.  Returns
+        {pgid: {oid: [bad shards]}} with only the inconsistencies."""
+        from .osd.mclock import BG_SCRUB
+        report: dict = {}
+        for g in self.pools[pool_id]["pgs"].values():
+            daemon = self.osds[g.backend.whoami]
+
+            def scrub(g=g):
+                bad: dict[str, list[int]] = {}
+                for oid in sorted(g.backend._local_oids()):
+                    per_shard = g.backend.be_deep_scrub(oid)
+                    bads = sorted(s for s, ok in per_shard.items() if not ok)
+                    if bads:
+                        bad[oid] = bads
+                if bad:
+                    report[repr(g.pgid)] = bad
+                    if repair:
+                        # object-level recovery, not log repair: scrub
+                        # finds BITROT, which the logs cannot see — the
+                        # bad chunks reconstruct from healthy shards and
+                        # re-push (be_deep_scrub keys by chunk index)
+                        for oid, chunks in sorted(bad.items()):
+                            g.backend.recover_object(oid, set(chunks))
+                        g.bus.deliver_all()
+            daemon.queue_background(g.pgid, scrub, op_class=BG_SCRUB)
+            daemon.drain()
+            g.bus.deliver_all()
+        return report
+
     # -- pool snapshots (the mon's 'osd pool mksnap/rmsnap') ----------------
 
     def create_pool_snap(self, pool_id: int, name: str) -> int:
@@ -505,7 +550,8 @@ class MiniCluster:
         mClock queues under BG_SNAPTRIM — trimming cannot starve client
         ops (pg_pool_t::remove_snap + the SnapTrimmer)."""
         from .osd.mclock import BG_SNAPTRIM
-        from .osd.primary_log_pg import SNAP_SEP, SS_ATTR
+        from .osd.primary_log_pg import (SNAP_SEP, SS_ATTR, empty_snapset,
+                                         split_clone_oid)
         from .backend.memstore import GObject
         pool = self.pools[pool_id]["pool"]
         snapid = next((s for s, n in pool.snaps.items() if n == name), None)
@@ -528,10 +574,13 @@ class MiniCluster:
                 t = PGTransaction()
                 clones_by_head: dict[str, list[int]] = {}
                 for gobj in store.list_objects():
-                    if gobj.shard != whoami or SNAP_SEP not in gobj.oid:
+                    if gobj.shard != whoami:
                         continue
-                    head, _, cid = gobj.oid.rpartition(SNAP_SEP)
-                    clones_by_head.setdefault(head, []).append(int(cid))
+                    parsed = split_clone_oid(gobj.oid)
+                    if parsed is None:
+                        continue
+                    head, cid = parsed
+                    clones_by_head.setdefault(head, []).append(cid)
                 for head, clones in sorted(clones_by_head.items()):
                     clones.sort()
                     keep = []
@@ -547,7 +596,7 @@ class MiniCluster:
                             try:
                                 ss = dict(store.getattr(hobj, SS_ATTR))
                             except KeyError:
-                                ss = {"seq": 0, "clones": [], "sizes": {}}
+                                ss = empty_snapset()
                             ss["clones"] = keep
                             ss["sizes"] = {k: v
                                            for k, v in ss["sizes"].items()

@@ -1,0 +1,52 @@
+"""Prometheus exporter: perf counters in the text exposition format.
+
+Analog of the reference mgr's prometheus module (reference:
+src/pybind/mgr/prometheus/module.py — walks every daemon's perf counter
+schema and renders `ceph_<subsystem>_<counter>` metrics).  Here the
+process-wide PerfCounters registry renders to the same text format:
+counters as `ceph_tpu_<collection>_<name>`, averages as `_sum`/`_count`
+pairs, histograms as cumulative `_bucket{le=...}` series — scrapeable by
+an actual Prometheus, or by the tests that pin the format.
+"""
+from __future__ import annotations
+
+from ..common import default_context
+from ..common.perf_counters import (
+    PERFCOUNTER_AVG, PERFCOUNTER_HISTOGRAM, PERFCOUNTER_TIME_AVG,
+)
+
+
+def _sanitize(name: str) -> str:
+    return "".join(ch if ch.isalnum() or ch == "_" else "_"
+                   for ch in name)
+
+
+def render(cct=None, prefix: str = "ceph_tpu") -> str:
+    """The /metrics payload: every registered collection's metrics."""
+    cct = cct if cct is not None else default_context()
+    lines: list[str] = []
+    for coll_name, pc in sorted(cct.perf._loggers.items()):
+        label = f'{{collection="{coll_name}"}}'
+        for key, m in sorted(pc._metrics.items()):
+            metric = f"{prefix}_{_sanitize(key)}"
+            if m.kind in (PERFCOUNTER_AVG, PERFCOUNTER_TIME_AVG):
+                lines.append(f"# TYPE {metric} summary")
+                lines.append(f"{metric}_sum{label} {m.sum}")
+                lines.append(f"{metric}_count{label} {m.count}")
+            elif m.kind == PERFCOUNTER_HISTOGRAM:
+                lines.append(f"# TYPE {metric} histogram")
+                cum = 0
+                for bound, n in zip(m.buckets, m.bucket_counts):
+                    cum += n
+                    lines.append(
+                        f'{metric}_bucket{{collection="{coll_name}",'
+                        f'le="{bound}"}} {cum}')
+                total = sum(m.bucket_counts)
+                lines.append(
+                    f'{metric}_bucket{{collection="{coll_name}",'
+                    f'le="+Inf"}} {total}')
+                lines.append(f"{metric}_count{label} {total}")
+            else:
+                lines.append(f"# TYPE {metric} counter")
+                lines.append(f"{metric}{label} {m.value}")
+    return "\n".join(lines) + "\n"

@@ -42,6 +42,10 @@ OP_OMAP_CMP = "omap_cmp"
 OP_CALL = "call"
 OP_ROLLBACK = "rollback"
 OP_LIST_SNAPS = "list_snaps"
+OP_WATCH = "watch"
+OP_UNWATCH = "unwatch"
+OP_NOTIFY = "notify"
+OP_LIST_WATCHERS = "list_watchers"
 
 # ops that mutate object state (CEPH_OSD_FLAG_WRITE classification)
 WRITE_OPS = frozenset({
@@ -168,6 +172,23 @@ class ObjectOperation:
     def call(self, cls: str, method: str, indata: bytes = b""):
         return self._add(OP_CALL, cls=cls, method=method,
                          indata=bytes(indata))
+
+    # watch/notify (librados watch2/notify2 shape)
+    def watch(self, cookie: int, on_notify):
+        """Register a watch: ``on_notify(notify_id, cookie, payload) ->
+        reply bytes`` fires for every notify on the object."""
+        return self._add(OP_WATCH, cookie=cookie, on_notify=on_notify)
+
+    def unwatch(self, cookie: int):
+        return self._add(OP_UNWATCH, cookie=cookie)
+
+    def notify(self, payload: bytes = b""):
+        """Deliver ``payload`` to every watcher; outdata maps each
+        watcher cookie to its reply (notify_ack collection)."""
+        return self._add(OP_NOTIFY, payload=bytes(payload))
+
+    def list_watchers(self):
+        return self._add(OP_LIST_WATCHERS)
 
     # snapshots
     def rollback(self, snapid: int):

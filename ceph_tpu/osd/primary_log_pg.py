@@ -14,14 +14,18 @@ PGBackend::submit_transaction (src/osd/PrimaryLogPG.cc:1565,1756,3709,
 shard and user xattrs are stored "_"-prefixed, both exactly like the
 reference (src/osd/osd_types.h OI_ATTR).
 
+Implemented surfaces: data/metadata reads, the write family, xattr and
+omap ops with guards, object classes (cls registry), snapshots
+(SnapContext COW + snap reads + rollback + list_snaps) and watch/notify.
+
 Scope notes (deliberate divergences, all returning clean errors):
-- snapshots / clone / rollback / watch-notify / cache-tiering ops are not
-  implemented (no snapshot machinery in this framework yet);
+- cache-tiering ops are not implemented;
 - data READs inside a *write* vector are rejected with -EINVAL on EC
   pools (the reference queues them as pending_async_reads; here a vector
   is either data-reading or mutating — metadata reads work in both);
 - CEPH_OSD_OP_ZERO never extends the object (the reference's behavior
-  with the default truncate_seq handling).
+  with the default truncate_seq handling);
+- ROLLBACK must be the only mutation in its vector.
 
 Ordering: mutating vectors take a per-object in-flight slot; any later op
 on the same object queues until the commit callback fires — the obc
@@ -42,9 +46,10 @@ from .osd_ops import (
     MOSDOp, MOSDOpReply, OP_APPEND, OP_CALL, OP_CMPEXT, OP_CMPXATTR,
     OP_CREATE, OP_DELETE, OP_GETXATTR, OP_GETXATTRS, OP_OMAPCLEAR,
     OP_OMAPGETHEADER, OP_OMAPGETKEYS, OP_OMAPGETVALS, OP_OMAPGETVALSBYKEYS,
-    OP_LIST_SNAPS, OP_OMAPRMKEYS, OP_OMAPSETHEADER, OP_OMAPSETVALS,
+    OP_LIST_SNAPS, OP_LIST_WATCHERS, OP_NOTIFY, OP_OMAPRMKEYS,
+    OP_OMAPSETHEADER, OP_OMAPSETVALS,
     OP_OMAP_CMP, OP_READ, OP_RMXATTR, OP_ROLLBACK, OP_SETXATTR,
-    OP_SPARSE_READ, OP_STAT, OP_TRUNCATE,
+    OP_SPARSE_READ, OP_STAT, OP_TRUNCATE, OP_UNWATCH, OP_WATCH,
     OP_WRITE, OP_WRITEFULL, OP_ZERO, OSDOp, WRITE_OPS,
 )
 
@@ -70,6 +75,18 @@ def clone_oid(oid: str, snapid: int) -> str:
 
 def is_clone_oid(oid: str) -> bool:
     return SNAP_SEP in oid
+
+
+def split_clone_oid(oid: str) -> tuple[str, int] | None:
+    """(head, snapid) for a clone oid, None for a head."""
+    if SNAP_SEP not in oid:
+        return None
+    head, _, cid = oid.rpartition(SNAP_SEP)
+    return head, int(cid)
+
+
+def empty_snapset() -> dict:
+    return {"seq": 0, "clones": [], "sizes": {}}
 # non-user attrs that share the "_" prefix (internal attrs otherwise use
 # non-"_" prefixes — e.g. the replicated backend's "@version" — so they
 # cannot collide with any user name)
@@ -146,6 +163,9 @@ class _ExecCtx:
     t: PGTransaction = field(default_factory=PGTransaction)
     mutated: bool = False
     user_modify: bool = False
+    # watch/unwatch effects staged until the vector SUCCEEDS (the
+    # reference's do_osd_op_effects runs only on success)
+    watch_effects: list = field(default_factory=list)
 
     # -- staged-state readers ---------------------------------------------
 
@@ -256,6 +276,9 @@ class PrimaryLogPG:
         self.user_version = 0
         self._busy: set[str] = set()
         self._waiting: dict[str, deque] = {}
+        # watch/notify state (the obc watchers map, src/osd/Watch.cc)
+        self.watchers: dict[str, dict[int, object]] = {}
+        self.notify_id = 0
 
     # -- entry -------------------------------------------------------------
 
@@ -286,13 +309,15 @@ class PrimaryLogPG:
             try:
                 return dict(store.getattr(gobj, SS_ATTR))
             except KeyError:
-                return {"seq": 0, "clones": [], "sizes": {}}
+                return empty_snapset()
         prefix = oid + SNAP_SEP
         clones = sorted(
             int(g.oid[len(prefix):]) for g in store.list_objects()
             if g.shard == self.backend.whoami and g.oid.startswith(prefix))
-        return {"seq": max(clones, default=0), "clones": clones,
-                "sizes": {}}
+        ss = empty_snapset()
+        ss["seq"] = max(clones, default=0)
+        ss["clones"] = clones
+        return ss
 
     def _resolve_snap(self, oid: str, snapid: int) -> str | None:
         """find_object_context's snap resolution: clone c covers snaps up
@@ -314,6 +339,12 @@ class PrimaryLogPG:
             # covering clone (or the head)
             if has_write:
                 on_reply(MOSDOpReply(EROFS, m.ops))
+                return
+            if any(op.op in (OP_WATCH, OP_UNWATCH, OP_NOTIFY)
+                   for op in m.ops):
+                # watches live on the HEAD; registering one under a
+                # resolved clone oid would leak an unreachable entry
+                on_reply(MOSDOpReply(EINVAL, m.ops))
                 return
             resolved = self._resolve_snap(m.oid, m.snapid)
             if resolved is None:        # object postdates the snap
@@ -397,6 +428,8 @@ class PrimaryLogPG:
         except OpError as e:
             result = e.rval
         if result != 0 or not ctx.mutated:
+            if result == 0:
+                self._apply_watch_effects(ctx)    # do_osd_op_effects
             self._finish(m, MOSDOpReply(result, m.ops), has_write, on_reply)
             return
         # prepare_transaction: persist object_info on every shard with the
@@ -410,11 +443,23 @@ class PrimaryLogPG:
                 "size": ctx.size, "version": self.version,
                 "user_version": self.user_version, "mtime": time.time()})
         version = self.version
+        deleted = not ctx.exists
 
         def _committed(tid):
+            if deleted:
+                # a deleted object loses its watchers (Watch.cc discard)
+                self.watchers.pop(m.oid, None)
+            self._apply_watch_effects(ctx)        # do_osd_op_effects
             self._finish(m, MOSDOpReply(0, m.ops, version=version),
                          has_write, on_reply)
         self.backend.submit_transaction(ctx.t, on_commit=_committed)
+
+    def _apply_watch_effects(self, ctx: _ExecCtx) -> None:
+        for eff in ctx.watch_effects:
+            if eff[0] == "watch":
+                self.watchers.setdefault(ctx.m.oid, {})[eff[1]] = eff[2]
+            else:
+                self.watchers.get(ctx.m.oid, {}).pop(eff[1], None)
 
     def _finish(self, m, reply, has_write, on_reply) -> None:
         if has_write:
@@ -578,6 +623,41 @@ class PrimaryLogPG:
             ctx.stage_attr(USER_PREFIX + p["name"], None)
             return 0
 
+        # ---- watch/notify (PrimaryLogPG::do_osd_op_effects + Watch.cc:
+        # watchers live on the primary; notifies fan to every watcher and
+        # collect acks.  In-process, a watcher is a callback.)
+        if kind == OP_WATCH:
+            self._require(ctx)
+            ctx.watch_effects.append(("watch", p["cookie"], p["on_notify"]))
+            return 0
+        if kind == OP_UNWATCH:
+            ws = dict(self.watchers.get(ctx.m.oid, {}))
+            for eff in ctx.watch_effects:     # staged view for validation
+                if eff[0] == "watch":
+                    ws[eff[1]] = eff[2]
+                else:
+                    ws.pop(eff[1], None)
+            if p["cookie"] not in ws:
+                raise OpError(ENOENT)
+            ctx.watch_effects.append(("unwatch", p["cookie"]))
+            return 0
+        if kind == OP_NOTIFY:
+            self._require(ctx)
+            self.notify_id += 1
+            acks = {}
+            for cookie, fn in sorted(self.watchers.get(ctx.m.oid,
+                                                       {}).items()):
+                try:
+                    acks[cookie] = fn(self.notify_id, cookie, p["payload"])
+                except Exception as e:      # one bad watcher can't block
+                    acks[cookie] = e        # the notify (timeout analog)
+            op.outdata = acks
+            return 0
+        if kind == OP_LIST_WATCHERS:
+            self._require(ctx)
+            op.outdata = sorted(self.watchers.get(ctx.m.oid, {}))
+            return 0
+
         # ---- snapshots
         if kind == OP_LIST_SNAPS:
             ss = self._load_snapset(ctx.m.oid)
@@ -603,10 +683,26 @@ class PrimaryLogPG:
                 ss = self._load_snapset(ctx.m.oid)
             cands = [c for c in sorted(ss["clones"]) if c >= p["snapid"]]
             if not cands:
-                # rolling back to the head state: no-op on an existing
-                # head, ENOENT when there is nothing to restore
                 self._require(ctx)
-                return 0
+                if p["snapid"] <= ss["seq"]:
+                    # the object did not exist at that snap (creation
+                    # postdates it): rollback REMOVES the head — exactly
+                    # what a read at that snap reports (the reference's
+                    # _rollback_to on ENOENT deletes the head)
+                    objop = ctx.objop()
+                    objop.delete_first = True
+                    objop.buffer_updates = []
+                    objop.truncate = None
+                    objop.attr_updates = {}
+                    ctx.exists = False
+                    ctx.size = 0
+                    ctx.attrs = {}
+                    ctx.attrs_cleared = True
+                    ctx.omap = {}
+                    ctx.omap_cleared = True
+                    ctx.mutated = ctx.user_modify = True
+                    return 0
+                return 0    # snap postdates the head state: no-op
             src = clone_oid(ctx.m.oid, cands[0])
             snap = cands[0]
             objop = ctx.objop()

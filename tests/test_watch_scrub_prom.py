@@ -1,0 +1,179 @@
+"""watch/notify, scheduled scrub+repair, prometheus exporter.
+
+Reference analogs: librados watch2/notify2 through PrimaryLogPG's
+watcher machinery (src/osd/Watch.cc); 'ceph pg deep-scrub' + repair
+via background scrub work; the mgr prometheus module's text format
+(src/pybind/mgr/prometheus/module.py).
+"""
+import numpy as np
+import pytest
+
+from ceph_tpu.cluster import MiniCluster
+from ceph_tpu.osd.osd_ops import ObjectOperation
+
+
+@pytest.fixture
+def cluster():
+    c = MiniCluster(n_osds=9, osds_per_host=3, chunk_size=512)
+    pid = c.create_ec_pool("p", {"k": "2", "m": "1", "device": "numpy"},
+                           pg_num=4)
+    yield c, pid
+    c.shutdown()
+
+
+class TestWatchNotify:
+    def test_watch_notify_roundtrip(self, cluster):
+        c, pid = cluster
+        c.operate(pid, "w", ObjectOperation().write_full(b"watched"))
+        got = []
+
+        def on_notify(notify_id, cookie, payload):
+            got.append((cookie, payload))
+            return b"ack-from-" + str(cookie).encode()
+        c.operate(pid, "w", ObjectOperation().watch(1, on_notify))
+        c.operate(pid, "w", ObjectOperation().watch(2, on_notify))
+        r = c.operate(pid, "w", ObjectOperation().notify(b"hello"))
+        assert got == [(1, b"hello"), (2, b"hello")]
+        assert r.outdata(0) == {1: b"ack-from-1", 2: b"ack-from-2"}
+        assert c.operate(pid, "w", ObjectOperation()
+                         .list_watchers()).outdata(0) == [1, 2]
+
+    def test_unwatch_stops_delivery(self, cluster):
+        c, pid = cluster
+        c.operate(pid, "u", ObjectOperation().write_full(b"x"))
+        got = []
+        c.operate(pid, "u", ObjectOperation().watch(
+            7, lambda n, ck, p: got.append(p)))
+        c.operate(pid, "u", ObjectOperation().unwatch(7))
+        c.operate(pid, "u", ObjectOperation().notify(b"gone"))
+        assert got == []
+        with pytest.raises(IOError):       # unknown cookie
+            c.operate(pid, "u", ObjectOperation().unwatch(7))
+
+    def test_broken_watcher_does_not_block_notify(self, cluster):
+        c, pid = cluster
+        c.operate(pid, "b", ObjectOperation().write_full(b"x"))
+
+        def bad(n, ck, p):
+            raise RuntimeError("watcher crashed")
+        c.operate(pid, "b", ObjectOperation().watch(1, bad))
+        c.operate(pid, "b", ObjectOperation().watch(
+            2, lambda n, ck, p: b"ok"))
+        r = c.operate(pid, "b", ObjectOperation().notify(b"ping"))
+        acks = r.outdata(0)
+        assert isinstance(acks[1], RuntimeError)
+        assert acks[2] == b"ok"
+
+    def test_delete_discards_watchers(self, cluster):
+        c, pid = cluster
+        c.operate(pid, "d", ObjectOperation().write_full(b"x"))
+        c.operate(pid, "d", ObjectOperation().watch(
+            1, lambda n, ck, p: b"a"))
+        c.operate(pid, "d", ObjectOperation().remove())
+        with pytest.raises(IOError):       # notify on a deleted object
+            c.operate(pid, "d", ObjectOperation().notify(b"?"))
+        c.operate(pid, "d", ObjectOperation().write_full(b"new"))
+        assert c.operate(pid, "d", ObjectOperation()
+                         .list_watchers()).outdata(0) == []
+
+
+class TestScrubScheduling:
+    def test_clean_pool_scrubs_clean(self, cluster):
+        c, pid = cluster
+        for i in range(6):
+            c.put(pid, f"s{i}", np.random.default_rng(i).integers(
+                0, 256, 1500, np.uint8).tobytes())
+        assert c.scrub_pool(pid) == {}
+
+    def test_scrub_detects_and_repairs_corruption(self, cluster):
+        from ceph_tpu.backend.memstore import GObject
+        c, pid = cluster
+        payload = np.random.default_rng(3).integers(
+            0, 256, 2000, np.uint8).tobytes()
+        c.put(pid, "victim", payload)
+        g = c.pg_group(pid, "victim")
+        # flip bytes in a NON-primary shard's stored chunk (bitrot)
+        shard = g.acting[1]
+        store = g.bus.handlers[shard].store
+        obj = GObject("victim", shard)
+        data = bytearray(store.read(obj))
+        data[0] ^= 0xFF
+        store.objects[obj].data[:] = data
+        report = c.scrub_pool(pid, repair=True)
+        assert any("victim" in bad for bad in report.values())
+        # repaired: a second scrub is clean and reads are intact
+        assert c.scrub_pool(pid) == {}
+        assert c.get(pid, "victim", 2000) == payload
+
+
+class TestPrometheus:
+    def test_render_format(self, cluster):
+        from ceph_tpu.mgr.prometheus import render
+        c, pid = cluster
+        c.put(pid, "m", b"metrics" * 100)
+        text = render(c.cct)
+        assert text.endswith("\n")
+        lines = text.splitlines()
+        assert any(line.startswith("# TYPE ceph_tpu_") for line in lines)
+        # counters carry the collection label and a numeric value
+        sample = next(line for line in lines
+                      if not line.startswith("#") and "collection=" in line)
+        name_and_labels, value = sample.rsplit(" ", 1)
+        float(value)
+        assert name_and_labels.startswith("ceph_tpu_")
+        # time averages render as summary sum/count pairs
+        assert any("_sum{" in line for line in lines)
+        assert any("_count{" in line for line in lines)
+
+
+class TestWatchAtomicity:
+    def test_failed_vector_does_not_register_watch(self, cluster):
+        """Watch effects apply only on vector success (regression: they
+        applied immediately inside the opcode switch)."""
+        c, pid = cluster
+        c.operate(pid, "wa", ObjectOperation().write_full(b"x"))
+        fired = []
+        with pytest.raises(IOError):
+            c.operate(pid, "wa", ObjectOperation()
+                      .watch(5, lambda n, ck, p: fired.append(p))
+                      .getxattr("missing"))       # fails the vector
+        c.operate(pid, "wa", ObjectOperation().notify(b"ping"))
+        assert fired == []
+        assert c.operate(pid, "wa", ObjectOperation()
+                         .list_watchers()).outdata(0) == []
+
+    def test_watch_rejected_on_snap_read(self, cluster):
+        c, pid = cluster
+        c.operate(pid, "ws", ObjectOperation().write_full(b"x"))
+        s1 = c.create_pool_snap(pid, "s")
+        c.operate(pid, "ws", ObjectOperation().write_full(b"y"))
+        with pytest.raises(IOError) as ei:
+            c.operate(pid, "ws", ObjectOperation().watch(
+                1, lambda n, ck, p: b""), snapid=s1)
+        assert ei.value.errno == -22
+
+
+class TestSnapEdges:
+    def test_read_at_removed_snap_is_enoent(self, cluster):
+        """A shared clone must not serve reads at a REMOVED snap id."""
+        c, pid = cluster
+        c.operate(pid, "rm", ObjectOperation().write_full(b"v1" * 300))
+        s1 = c.create_pool_snap(pid, "one")
+        s2 = c.create_pool_snap(pid, "two")
+        c.operate(pid, "rm", ObjectOperation().write_full(b"v2" * 300))
+        c.remove_pool_snap(pid, "one")
+        with pytest.raises(IOError) as ei:
+            c.operate(pid, "rm", ObjectOperation().read(0, 0), snapid=s1)
+        assert ei.value.errno == -2
+        # the surviving snap still reads v1 through the shared clone
+        r = c.operate(pid, "rm", ObjectOperation().read(0, 0), snapid=s2)
+        assert r.outdata(0)[:600] == b"v1" * 300
+
+    def test_rollback_to_precreation_snap_deletes_head(self, cluster):
+        c, pid = cluster
+        s1 = c.create_pool_snap(pid, "early")
+        c.operate(pid, "born-late", ObjectOperation().write_full(b"data"))
+        c.operate(pid, "born-late", ObjectOperation().rollback(s1))
+        with pytest.raises(IOError) as ei:
+            c.operate(pid, "born-late", ObjectOperation().stat())
+        assert ei.value.errno == -2
