@@ -92,16 +92,32 @@ class ECBackend(PGBackend):
     def _hinfo(self, oid: str) -> HashInfo:
         if oid not in self.hinfo_cache:
             n = self.ec_impl.get_chunk_count()
-            try:
-                stored = self.local_shard.store.getattr(
-                    GObject(oid, self.whoami), HINFO_KEY)
-                h = HashInfo(n)
+            stored = None
+            # hinfo replicates on every shard's copy: when the primary's
+            # own copy is gone (bitrot/lost shard object), any up peer's
+            # attr is the same authority — without this fallback a
+            # missing primary copy poisons scrub/size for the whole
+            # object (fresh version-0 hinfo marks every shard stale)
+            for shard in [self.whoami] + [s for s in self.acting
+                                          if s != self.whoami
+                                          and s not in self.bus.down]:
+                handler = self.bus.handlers.get(shard)
+                if handler is None:
+                    continue
+                store = handler.store if isinstance(handler, OSDShard) \
+                    else handler.local_shard.store
+                try:
+                    stored = store.getattr(GObject(oid, shard), HINFO_KEY)
+                    break
+                except (FileNotFoundError, KeyError):
+                    continue
+            h = HashInfo(n)
+            if stored is not None:
                 h.total_chunk_size = stored["total_chunk_size"]
-                h.cumulative_shard_hashes = list(stored["cumulative_shard_hashes"])
+                h.cumulative_shard_hashes = list(
+                    stored["cumulative_shard_hashes"])
                 h.projected_total_chunk_size = h.total_chunk_size
                 h.version = stored.get("version", 0)
-            except (FileNotFoundError, KeyError):
-                h = HashInfo(n)
             self.hinfo_cache[oid] = h
         return self.hinfo_cache[oid]
 

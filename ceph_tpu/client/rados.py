@@ -1,0 +1,160 @@
+"""librados facade: the Rados/IoCtx API surface.
+
+Analog of the reference's librados C++/Python bindings (reference:
+src/librados/librados_cxx.cc — IoCtx::operate :1482, rados_write in
+librados_c.cc:1111; src/pybind/rados/rados.pyx shapes the method names):
+a ``Rados`` handle opens ``IoCtx``s bound to pools; each IoCtx exposes
+whole-object I/O, op vectors, xattrs, omap, snapshots, and watch/notify,
+all routed through the Objecter's full client lifecycle (epoch-stamped
+targets, stale rejects, resend on map change).
+"""
+from __future__ import annotations
+
+from ..osd.osd_ops import ObjectOperation
+from .objecter import Objecter
+
+
+class ObjectNotFound(IOError):
+    pass
+
+
+def _raise(e: IOError):
+    if getattr(e, "errno", None) == -2:
+        err = ObjectNotFound(str(e))
+        err.errno = -2
+        raise err from None
+    raise e
+
+
+class Rados:
+    """The cluster handle (librados::Rados)."""
+
+    def __init__(self, cluster):
+        self.cluster = cluster
+        self.objecter = Objecter(cluster)
+        if getattr(cluster, "monitor", None) is not None:
+            self.objecter.attach(cluster.monitor)
+
+    def open_ioctx(self, pool_name: str) -> "IoCtx":
+        pool_id = self.cluster.pool_ids.get(pool_name)
+        if pool_id is None:
+            raise ValueError(f"no pool named {pool_name!r}")
+        return IoCtx(self, pool_id)
+
+    def pool_list(self) -> list[str]:
+        return sorted(self.cluster.pool_ids)
+
+    def cluster_stat(self) -> dict:
+        return self.cluster.status()
+
+
+class IoCtx:
+    """One pool's I/O context (librados::IoCtx)."""
+
+    def __init__(self, rados: Rados, pool_id: int):
+        self.rados = rados
+        self.pool_id = pool_id
+        self.snap_read: int | None = None     # set_read at a snap
+        self._next_cookie = 0
+
+    # -- op vectors (IoCtx::operate) ----------------------------------------
+
+    def operate(self, oid: str, op: ObjectOperation):
+        """Synchronous operate; returns the MOSDOpReply."""
+        try:
+            return self.rados.cluster.operate(
+                self.pool_id, oid, op, snapid=self.snap_read)
+        except IOError as e:
+            _raise(e)
+
+    # -- whole-object convenience -------------------------------------------
+
+    def write_full(self, oid: str, data: bytes) -> None:
+        self.operate(oid, ObjectOperation().write_full(data))
+
+    def write(self, oid: str, data: bytes, offset: int = 0) -> None:
+        self.operate(oid, ObjectOperation().write(offset, data))
+
+    def append(self, oid: str, data: bytes) -> None:
+        self.operate(oid, ObjectOperation().append(data))
+
+    def read(self, oid: str, length: int = 0, offset: int = 0) -> bytes:
+        r = self.operate(oid, ObjectOperation().read(offset, length))
+        return r.outdata(0)
+
+    def stat(self, oid: str) -> tuple[int, float]:
+        return self.operate(oid, ObjectOperation().stat()).outdata(0)
+
+    def remove_object(self, oid: str) -> None:
+        self.operate(oid, ObjectOperation().remove())
+
+    def list_objects(self) -> list[str]:
+        from ..osd.primary_log_pg import is_clone_oid
+        return sorted(o for o in
+                      self.rados.cluster.objects.get(self.pool_id, ())
+                      if not is_clone_oid(o))
+
+    # -- xattrs --------------------------------------------------------------
+
+    def get_xattr(self, oid: str, name: str):
+        return self.operate(oid, ObjectOperation().getxattr(name)).outdata(0)
+
+    def set_xattr(self, oid: str, name: str, value) -> None:
+        self.operate(oid, ObjectOperation().setxattr(name, value))
+
+    def rm_xattr(self, oid: str, name: str) -> None:
+        self.operate(oid, ObjectOperation().rmxattr(name))
+
+    def get_xattrs(self, oid: str) -> dict:
+        return self.operate(oid, ObjectOperation().getxattrs()).outdata(0)
+
+    # -- omap ---------------------------------------------------------------
+
+    def omap_set(self, oid: str, kvs: dict) -> None:
+        self.operate(oid, ObjectOperation().omap_set(kvs))
+
+    def omap_get_vals(self, oid: str, **kw) -> dict:
+        return self.operate(oid,
+                            ObjectOperation().omap_get_vals(**kw)).outdata(0)
+
+    # -- snapshots (IoCtx snap_create/remove/rollback/set_read) -------------
+
+    def snap_create(self, name: str) -> int:
+        return self.rados.cluster.create_pool_snap(self.pool_id, name)
+
+    def snap_remove(self, name: str) -> None:
+        self.rados.cluster.remove_pool_snap(self.pool_id, name)
+
+    def snap_list(self) -> dict[int, str]:
+        return dict(self.rados.cluster.pools[self.pool_id]["pool"].snaps)
+
+    def snap_lookup(self, name: str) -> int:
+        for sid, n in self.snap_list().items():
+            if n == name:
+                return sid
+        raise KeyError(name)
+
+    def snap_rollback(self, oid: str, name: str) -> None:
+        self.operate(oid, ObjectOperation().rollback(self.snap_lookup(name)))
+
+    def set_read(self, snapid: int | None) -> None:
+        """Subsequent reads resolve at this snap (SNAP_HEAD = None)."""
+        self.snap_read = snapid
+
+    # -- watch/notify --------------------------------------------------------
+
+    def watch(self, oid: str, on_notify, cookie: int | None = None) -> int:
+        if cookie is None:
+            # unique per IoCtx: the same callback watched twice must get
+            # two registrations, not silently overwrite one
+            self._next_cookie += 1
+            cookie = self._next_cookie
+        self.operate(oid, ObjectOperation().watch(cookie, on_notify))
+        return cookie
+
+    def unwatch(self, oid: str, cookie: int) -> None:
+        self.operate(oid, ObjectOperation().unwatch(cookie))
+
+    def notify(self, oid: str, payload: bytes = b"") -> dict:
+        return self.operate(oid,
+                            ObjectOperation().notify(payload)).outdata(0)

@@ -280,6 +280,10 @@ class MiniCluster:
             pool.snap_seq = p.get("snap_seq", 0)
             pool.snaps = dict(p.get("snaps", {}))
             pool.removed_snaps = set(p.get("removed_snaps", ()))
+        # re-persist: pool creation above rewrote the meta file BEFORE the
+        # snap fields were restored; without this, the next process would
+        # load a cluster whose pool snaps were silently wiped
+        c._save_meta()
         for pid, pool in c.pools.items():
             for g in pool["pgs"].values():
                 # crash recovery first: elect the authoritative log and
@@ -509,9 +513,38 @@ class MiniCluster:
             daemon = self.osds[g.backend.whoami]
 
             def scrub(g=g):
+                from .backend.memstore import GObject
+                from .backend.pg_backend import PG_META, OSDShard
+                # the scrub object list is the UNION over every up
+                # shard's store: an object whose primary copy is missing
+                # must still be scrubbed (the reference compares scrub
+                # maps from all shards)
+                oids: set[str] = set()
+                for shard in g.acting:
+                    if shard in g.bus.down:
+                        continue
+                    h = g.bus.handlers[shard]
+                    store = h.store if isinstance(h, OSDShard) \
+                        else h.local_shard.store
+                    oids.update(gobj.oid for gobj in store.list_objects()
+                                if gobj.shard == shard
+                                and gobj.oid != PG_META)
                 bad: dict[str, list[int]] = {}
-                for oid in sorted(g.backend._local_oids()):
-                    per_shard = g.backend.be_deep_scrub(oid)
+                for oid in sorted(oids):
+                    try:
+                        per_shard = g.backend.be_deep_scrub(oid)
+                    except (KeyError, FileNotFoundError):
+                        # authority state unreadable (e.g. the primary's
+                        # copy is gone): fall back to per-shard existence
+                        # so recovery still has its healthy sources
+                        per_shard = {}
+                        for ci, s in enumerate(g.acting):
+                            if s in g.bus.down:
+                                continue
+                            h = g.bus.handlers[s]
+                            st = h.store if isinstance(h, OSDShard) \
+                                else h.local_shard.store
+                            per_shard[ci] = st.exists(GObject(oid, s))
                     bads = sorted(s for s, ok in per_shard.items() if not ok)
                     if bads:
                         bad[oid] = bads

@@ -340,8 +340,8 @@ class PrimaryLogPG:
             if has_write:
                 on_reply(MOSDOpReply(EROFS, m.ops))
                 return
-            if any(op.op in (OP_WATCH, OP_UNWATCH, OP_NOTIFY)
-                   for op in m.ops):
+            if any(op.op in (OP_WATCH, OP_UNWATCH, OP_NOTIFY,
+                             OP_LIST_WATCHERS) for op in m.ops):
                 # watches live on the HEAD; registering one under a
                 # resolved clone oid would leak an unreachable entry
                 on_reply(MOSDOpReply(EINVAL, m.ops))
@@ -458,8 +458,19 @@ class PrimaryLogPG:
         for eff in ctx.watch_effects:
             if eff[0] == "watch":
                 self.watchers.setdefault(ctx.m.oid, {})[eff[1]] = eff[2]
-            else:
+            elif eff[0] == "unwatch":
                 self.watchers.get(ctx.m.oid, {}).pop(eff[1], None)
+            else:                                   # notify
+                _, payload, notify_op = eff
+                self.notify_id += 1
+                acks = {}
+                for cookie, fn in sorted(self.watchers.get(ctx.m.oid,
+                                                           {}).items()):
+                    try:
+                        acks[cookie] = fn(self.notify_id, cookie, payload)
+                    except Exception as e:  # one bad watcher can't block
+                        acks[cookie] = e    # the notify (timeout analog)
+                notify_op.outdata = acks
 
     def _finish(self, m, reply, has_write, on_reply) -> None:
         if has_write:
@@ -643,15 +654,10 @@ class PrimaryLogPG:
             return 0
         if kind == OP_NOTIFY:
             self._require(ctx)
-            self.notify_id += 1
-            acks = {}
-            for cookie, fn in sorted(self.watchers.get(ctx.m.oid,
-                                                       {}).items()):
-                try:
-                    acks[cookie] = fn(self.notify_id, cookie, p["payload"])
-                except Exception as e:      # one bad watcher can't block
-                    acks[cookie] = e        # the notify (timeout analog)
-            op.outdata = acks
+            # staged like watch/unwatch: a FAILED vector must not have
+            # delivered anything (do_osd_op_effects fires on success);
+            # the effect fills op.outdata before the reply is sent
+            ctx.watch_effects.append(("notify", p["payload"], op))
             return 0
         if kind == OP_LIST_WATCHERS:
             self._require(ctx)

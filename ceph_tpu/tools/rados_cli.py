@@ -1,0 +1,136 @@
+"""`rados` CLI over a durable cluster directory.
+
+Analog of the reference's `rados` tool (reference: src/tools/rados/
+rados.cc — put/get/ls/rm/stat/mksnap/rmsnap/lssnap/rollback/setxattr/
+getxattr/listxattr verbs): each invocation reopens the FileStore-backed
+MiniCluster under ``--data-dir`` (boot peering + log replay included),
+performs one operation through the librados facade, and checkpoints on
+exit — so consecutive shell commands observe each other's writes, the
+way the real tool's commands do through the cluster.
+
+    python -m ceph_tpu.tools.rados_cli --data-dir D mkpool data k=4 m=2
+    python -m ceph_tpu.tools.rados_cli --data-dir D put data obj ./file
+    python -m ceph_tpu.tools.rados_cli --data-dir D ls data
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="rados")
+    ap.add_argument("--data-dir", required=True,
+                    help="durable cluster directory")
+    ap.add_argument("--n-osds", type=int, default=9,
+                    help="cluster size when creating a new directory")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("mkpool")
+    p.add_argument("pool")
+    p.add_argument("profile", nargs="*",
+                   help="k=4 m=2 ... (EC); 'replicated size=3' for a "
+                        "replicated pool")
+    for verb in ("put", "get"):
+        p = sub.add_parser(verb)
+        p.add_argument("pool")
+        p.add_argument("oid")
+        p.add_argument("file", help="- for stdin/stdout")
+    for verb in ("rm", "stat", "listxattr", "lssnap"):
+        p = sub.add_parser(verb)
+        p.add_argument("pool")
+        if verb in ("rm", "stat", "listxattr"):
+            p.add_argument("oid")
+    p = sub.add_parser("ls")
+    p.add_argument("pool")
+    p = sub.add_parser("setxattr")
+    p.add_argument("pool"), p.add_argument("oid")
+    p.add_argument("name"), p.add_argument("value")
+    p = sub.add_parser("getxattr")
+    p.add_argument("pool"), p.add_argument("oid"), p.add_argument("name")
+    for verb in ("mksnap", "rmsnap"):
+        p = sub.add_parser(verb)
+        p.add_argument("pool"), p.add_argument("snap")
+    p = sub.add_parser("rollback")
+    p.add_argument("pool"), p.add_argument("oid"), p.add_argument("snap")
+    p = sub.add_parser("df")
+
+    args = ap.parse_args(argv)
+
+    import os
+    from ..client.rados import ObjectNotFound, Rados
+    from ..cluster import MiniCluster
+    fresh = not os.path.exists(os.path.join(args.data_dir,
+                                            "cluster_meta.pkl"))
+    if fresh:
+        c = MiniCluster(n_osds=args.n_osds, data_dir=args.data_dir)
+    else:
+        c = MiniCluster.load(args.data_dir)
+    try:
+        if args.cmd == "mkpool":
+            kv = dict(p.split("=", 1) for p in args.profile if "=" in p)
+            if "replicated" in args.profile:
+                c.create_replicated_pool(args.pool,
+                                         size=int(kv.get("size", 3)))
+            else:
+                kv.setdefault("device", "auto")
+                c.create_ec_pool(args.pool, kv)
+            print(f"pool {args.pool} created")
+            return 0
+
+        rados = Rados(c)
+        if args.cmd == "df":
+            st = rados.cluster_stat()
+            print(f"{st['pgmap']['num_pools']} pools, "
+                  f"{st['pgmap']['num_pgs']} pgs, "
+                  f"{st['osdmap']['num_up_osds']}/"
+                  f"{st['osdmap']['num_osds']} osds up")
+            return 0
+        io = rados.open_ioctx(args.pool)
+        if args.cmd == "put":
+            data = (sys.stdin.buffer.read() if args.file == "-"
+                    else open(args.file, "rb").read())
+            io.write_full(args.oid, data)
+        elif args.cmd == "get":
+            data = io.read(args.oid)     # object_info carries exact size
+            if args.file == "-":
+                sys.stdout.buffer.write(data)
+            else:
+                open(args.file, "wb").write(data)
+        elif args.cmd == "ls":
+            for oid in io.list_objects():
+                print(oid)
+        elif args.cmd == "rm":
+            io.remove_object(args.oid)
+        elif args.cmd == "stat":
+            size, mtime = io.stat(args.oid)
+            print(f"{args.pool}/{args.oid} size {size} mtime {mtime:.0f}")
+        elif args.cmd == "setxattr":
+            io.set_xattr(args.oid, args.name, args.value.encode())
+        elif args.cmd == "getxattr":
+            v = io.get_xattr(args.oid, args.name)
+            print(v.decode() if isinstance(v, bytes) else v)
+        elif args.cmd == "listxattr":
+            for name in sorted(io.get_xattrs(args.oid)):
+                print(name)
+        elif args.cmd == "mksnap":
+            sid = io.snap_create(args.snap)
+            print(f"created pool {args.pool} snap {args.snap} ({sid})")
+        elif args.cmd == "rmsnap":
+            io.snap_remove(args.snap)
+        elif args.cmd == "lssnap":
+            for sid, name in sorted(io.snap_list().items()):
+                print(f"{sid}\t{name}")
+        elif args.cmd == "rollback":
+            io.snap_rollback(args.oid, args.snap)
+            print(f"rolled back {args.pool}/{args.oid} to {args.snap}")
+        return 0
+    except (IOError, KeyError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    finally:
+        c.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -177,3 +177,36 @@ class TestSnapEdges:
         with pytest.raises(IOError) as ei:
             c.operate(pid, "born-late", ObjectOperation().stat())
         assert ei.value.errno == -2
+
+
+def test_failed_vector_does_not_notify(cluster):
+    """NOTIFY is a success-only effect (regression: it fired during
+    opcode execution even when the vector then failed)."""
+    c, pid = cluster
+    c.operate(pid, "nf", ObjectOperation().write_full(b"x"))
+    got = []
+    c.operate(pid, "nf", ObjectOperation().watch(
+        1, lambda n, ck, p: got.append(p)))
+    with pytest.raises(IOError):
+        c.operate(pid, "nf", ObjectOperation()
+                  .notify(b"leak").getxattr("missing"))
+    assert got == []
+    c.operate(pid, "nf", ObjectOperation().notify(b"real"))
+    assert got == [b"real"]
+
+
+def test_scrub_detects_missing_primary_copy(cluster):
+    """An object whose PRIMARY shard copy vanished must still be found
+    by scrub (regression: the object list came from the primary only)."""
+    from ceph_tpu.backend.memstore import GObject
+    c, pid = cluster
+    payload = np.random.default_rng(7).integers(
+        0, 256, 1800, np.uint8).tobytes()
+    c.put(pid, "halfgone", payload)
+    g = c.pg_group(pid, "halfgone")
+    del g.backend.local_shard.store.objects[
+        GObject("halfgone", g.backend.whoami)]
+    g.backend.hinfo_cache.clear()
+    report = c.scrub_pool(pid, repair=True)
+    assert any("halfgone" in bad for bad in report.values())
+    assert c.get(pid, "halfgone", 1800) == payload     # repaired
