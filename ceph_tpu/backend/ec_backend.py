@@ -37,6 +37,7 @@ from .extent_cache import ExtentCache
 from .memstore import GObject, Transaction
 from .messages import ECSubRead, ECSubReadReply, MessageBus
 from .pg_backend import (Op, OSDShard, PG_META, PGBackend, RecoveryOp,
+                         shard_store,
                          RecoveryState, RepairState, ShardRepairOp,
                          _slice_subchunks)
 from .transaction import get_write_plan
@@ -94,20 +95,20 @@ class ECBackend(PGBackend):
             n = self.ec_impl.get_chunk_count()
             stored = None
             # hinfo replicates on every shard's copy: when the primary's
-            # own copy is gone (bitrot/lost shard object), any up peer's
-            # attr is the same authority — without this fallback a
+            # own copy is gone (bitrot/lost shard object), any CURRENT
+            # peer's attr is the same authority — without this fallback a
             # missing primary copy poisons scrub/size for the whole
-            # object (fresh version-0 hinfo marks every shard stale)
-            for shard in [self.whoami] + [s for s in self.acting
-                                          if s != self.whoami
-                                          and s not in self.bus.down]:
-                handler = self.bus.handlers.get(shard)
-                if handler is None:
+            # object (fresh version-0 hinfo marks every shard stale).
+            # Stale revived shards are excluded: their hinfo may predate
+            # writes they missed (current_shards() semantics).
+            peers = [s for s in self.acting if s != self.whoami
+                     and s in self.current_shards()]
+            for shard in [self.whoami] + peers:
+                if shard not in self.bus.handlers:
                     continue
-                store = handler.store if isinstance(handler, OSDShard) \
-                    else handler.local_shard.store
                 try:
-                    stored = store.getattr(GObject(oid, shard), HINFO_KEY)
+                    stored = shard_store(self.bus, shard).getattr(
+                        GObject(oid, shard), HINFO_KEY)
                     break
                 except (FileNotFoundError, KeyError):
                     continue
@@ -623,9 +624,7 @@ class ECBackend(PGBackend):
         for chunk, shard in enumerate(self.acting):
             if shard in self.bus.down:
                 continue
-            handler = self.bus.handlers[shard]
-            store = handler.store if isinstance(handler, OSDShard) else \
-                handler.local_shard.store
+            store = shard_store(self.bus, shard)
             obj = GObject(oid, shard)
             try:
                 data = store.read(obj)

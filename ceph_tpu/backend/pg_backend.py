@@ -62,6 +62,14 @@ def _rb_key(version: int) -> str:
     return f"rb.{version:016d}"
 
 
+def shard_store(bus: MessageBus, shard: int):
+    """The store behind a bus handler — an OSDShard's own, or the
+    primary backend's local shard (ONE copy of this resolution)."""
+    handler = bus.handlers[shard]
+    return handler.store if isinstance(handler, OSDShard) \
+        else handler.local_shard.store
+
+
 class OSDShard:
     """One shard OSD: an ObjectStore plus the server side of the sub-ops
     (handle_sub_write ECBackend.cc:910-983, handle_sub_read :985-1031,

@@ -26,7 +26,7 @@ from __future__ import annotations
 
 from .memstore import GObject, Transaction
 from .messages import ECSubRead, ECSubReadReply, MessageBus
-from .pg_backend import Op, OSDShard, PGBackend, RecoveryOp
+from .pg_backend import Op, OSDShard, PGBackend, RecoveryOp, shard_store
 from ..osd.pg_log import OP_DELETE, OP_MODIFY
 
 VERSION_KEY = "@version"      # object_info_t::version analog; the "@"
@@ -235,9 +235,7 @@ class ReplicatedBackend(PGBackend):
         for chunk, shard in enumerate(self.acting):
             if shard in self.bus.down:
                 continue
-            handler = self.bus.handlers[shard]
-            store = handler.store if isinstance(handler, OSDShard) else \
-                handler.local_shard.store
+            store = shard_store(self.bus, shard)
             obj = GObject(oid, shard)
             try:
                 data = store.read(obj)

@@ -37,6 +37,7 @@ class _Op:
     data: bytes | None                    # None => read
     read_len: int = 0
     ops: list | None = None               # op VECTOR (IoCtx::operate path)
+    snapid: int | None = None             # read AT this snap
     on_complete: object = None
     target: tuple | None = None           # (ps, primary, acting) last sent
     attempts: int = 0
@@ -79,7 +80,7 @@ class Objecter:
         return op.tid
 
     def operate(self, pool_id: int, oid: str, op,
-                on_complete=None) -> int:
+                on_complete=None, snapid: int | None = None) -> int:
         """Submit a librados-style op VECTOR (ObjectOperation) through the
         full client lifecycle — epoch-stamped target, stale reject +
         resend on map change — landing in the primary's op engine
@@ -87,7 +88,7 @@ class Objecter:
         ``on_complete`` receives the MOSDOpReply."""
         self.next_tid += 1
         o = _Op(self.next_tid, pool_id, oid, None, ops=list(op.ops),
-                on_complete=on_complete)
+                snapid=snapid, on_complete=on_complete)
         self.inflight[o.tid] = o
         self._send_op(o)
         return o.tid
@@ -119,6 +120,7 @@ class Objecter:
         reply = self.cluster.osd_submit(
             op.pool_id, ps, primary, self.osdmap.epoch,
             oid=op.oid, data=op.data, read_len=op.read_len, ops=op.ops,
+            snapid=op.snapid,
             on_done=lambda result, _op=op: self._op_done(_op, result))
         if reply is not None:             # ("stale", current_map)
             _, newer = reply

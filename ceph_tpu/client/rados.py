@@ -10,8 +10,21 @@ targets, stale rejects, resend on map change).
 """
 from __future__ import annotations
 
-from ..osd.osd_ops import ObjectOperation
+import itertools
+
+from ..osd.osd_ops import (OP_CALL, OP_LIST_WATCHERS, OP_NOTIFY,
+                           OP_UNWATCH, OP_WATCH, ObjectOperation,
+                           WRITE_OPS)
 from .objecter import Objecter
+
+# ops that must always target the HEAD regardless of set_read (librados
+# snap_set_read affects READS only; watches live on the head)
+_HEAD_ONLY = WRITE_OPS | {OP_CALL, OP_WATCH, OP_UNWATCH, OP_NOTIFY,
+                          OP_LIST_WATCHERS}
+
+# watch cookies must be unique across ALL handles: the PG keys watchers
+# by cookie alone, so per-IoCtx counters would collide between clients
+_cookies = itertools.count(1)
 
 
 class ObjectNotFound(IOError):
@@ -55,17 +68,32 @@ class IoCtx:
         self.rados = rados
         self.pool_id = pool_id
         self.snap_read: int | None = None     # set_read at a snap
-        self._next_cookie = 0
 
     # -- op vectors (IoCtx::operate) ----------------------------------------
 
     def operate(self, oid: str, op: ObjectOperation):
-        """Synchronous operate; returns the MOSDOpReply."""
-        try:
-            return self.rados.cluster.operate(
-                self.pool_id, oid, op, snapid=self.snap_read)
-        except IOError as e:
-            _raise(e)
+        """Synchronous operate through the Objecter's full client
+        lifecycle (epoch/resend); returns the MOSDOpReply.  set_read's
+        snapid applies to pure-read vectors only — writes, cls calls,
+        and watch ops always target the head (librados snap_set_read
+        semantics)."""
+        snapid = (None if any(o.op in _HEAD_ONLY for o in op.ops)
+                  else self.snap_read)
+        out: list = []
+        self.rados.objecter.operate(self.pool_id, oid, op,
+                                    on_complete=out.append, snapid=snapid)
+        if not out:
+            raise IOError(f"op on {oid} blocked: PG inactive")
+        reply = out[0]
+        if isinstance(reply, Exception):
+            _raise(reply if isinstance(reply, IOError)
+                   else IOError(str(reply)))
+        if reply.result < 0:
+            err = IOError(f"op on {oid} failed: result {reply.result}")
+            err.errno = reply.result
+            err.reply = reply
+            _raise(err)
+        return reply
 
     # -- whole-object convenience -------------------------------------------
 
@@ -145,10 +173,7 @@ class IoCtx:
 
     def watch(self, oid: str, on_notify, cookie: int | None = None) -> int:
         if cookie is None:
-            # unique per IoCtx: the same callback watched twice must get
-            # two registrations, not silently overwrite one
-            self._next_cookie += 1
-            cookie = self._next_cookie
+            cookie = next(_cookies)       # unique across ALL handles
         self.operate(oid, ObjectOperation().watch(cookie, on_notify))
         return cookie
 

@@ -423,7 +423,15 @@ class MiniCluster:
         dispatch time would let a later backfill drop the acked object.
         Returns None when accepted, or ("stale", current_map)."""
         from .backend.memstore import GObject
-        from .osd.osd_ops import MOSDOp
+        from .osd.osd_ops import MOSDOp, MOSDOpReply
+        if snapid is not None and \
+                snapid not in self.pools[pool_id]["pool"].snaps:
+            # reads at a removed (or never-issued) pool snap are ENOENT
+            # even while a shared clone still covers the id for an older
+            # live snap (the reference validates against the pool first)
+            if on_done:
+                on_done(MOSDOpReply(-2, list(ops)))
+            return None
         daemon = self.osds[g.backend.whoami]
 
         def _done(reply):
@@ -453,15 +461,6 @@ class MiniCluster:
         op is only queued on the primary's daemon (returns None); the
         caller drains the daemon and delivers the bus itself — batch
         submission, like put(deliver=False)."""
-        if snapid is not None and \
-                snapid not in self.pools[pool_id]["pool"].snaps:
-            # reads at a removed (or never-issued) pool snap are ENOENT
-            # even while a shared clone still covers the id for an older
-            # live snap (the reference validates the snap against the
-            # pool before resolution)
-            err = IOError(f"snap {snapid} does not exist in pool {pool_id}")
-            err.errno = -2
-            raise err
         g = self.pg_group(pool_id, oid)
         out: list = []
         res = self._dispatch_op_vector(g, pool_id, oid, op.ops,
@@ -514,7 +513,7 @@ class MiniCluster:
 
             def scrub(g=g):
                 from .backend.memstore import GObject
-                from .backend.pg_backend import PG_META, OSDShard
+                from .backend.pg_backend import PG_META, shard_store
                 # the scrub object list is the UNION over every up
                 # shard's store: an object whose primary copy is missing
                 # must still be scrubbed (the reference compares scrub
@@ -523,9 +522,7 @@ class MiniCluster:
                 for shard in g.acting:
                     if shard in g.bus.down:
                         continue
-                    h = g.bus.handlers[shard]
-                    store = h.store if isinstance(h, OSDShard) \
-                        else h.local_shard.store
+                    store = shard_store(g.bus, shard)
                     oids.update(gobj.oid for gobj in store.list_objects()
                                 if gobj.shard == shard
                                 and gobj.oid != PG_META)
@@ -541,10 +538,8 @@ class MiniCluster:
                         for ci, s in enumerate(g.acting):
                             if s in g.bus.down:
                                 continue
-                            h = g.bus.handlers[s]
-                            st = h.store if isinstance(h, OSDShard) \
-                                else h.local_shard.store
-                            per_shard[ci] = st.exists(GObject(oid, s))
+                            per_shard[ci] = shard_store(g.bus, s).exists(
+                                GObject(oid, s))
                     bads = sorted(s for s, ok in per_shard.items() if not ok)
                     if bads:
                         bad[oid] = bads
@@ -646,7 +641,8 @@ class MiniCluster:
 
     def osd_submit(self, pool_id: int, ps: int, target_osd: int,
                    client_epoch: int, oid: str, data: bytes | None,
-                   read_len: int = 0, on_done=None, ops=None):
+                   read_len: int = 0, on_done=None, ops=None,
+                   snapid: int | None = None):
         """One client op arriving at an OSD.  Returns None when accepted
         (completion via ``on_done``), or ``("stale", current_map)`` when
         the client's map is too old for this PG — wrong primary, or an
@@ -660,7 +656,8 @@ class MiniCluster:
             return ("stale", self.osdmap)
         if ops is not None:
             res = self._dispatch_op_vector(g, pool_id, oid, ops,
-                                           client_epoch, on_done)
+                                           client_epoch, on_done,
+                                           snapid=snapid)
             if res is not None:
                 return ("stale", self.osdmap)
             return None
@@ -852,7 +849,21 @@ class MiniCluster:
     # -- cluster-wide status (ceph -s shape) -------------------------------
 
     def status(self) -> dict:
-        n_pgs = sum(len(p["pgs"]) for p in self.pools.values())
+        """ceph -s shape: osdmap summary + pgmap with per-state counts
+        (the PGMap the mon's stats service aggregates — active+clean /
+        active+degraded / inactive from each PG's shard availability)."""
+        n_pgs = 0
+        states = {"active+clean": 0, "active+degraded": 0, "inactive": 0}
+        for p in self.pools.values():
+            for g in p["pgs"].values():
+                n_pgs += 1
+                current = len(g.backend.current_shards())
+                if current < g.backend.min_size:
+                    states["inactive"] += 1
+                elif current < len(g.acting):
+                    states["active+degraded"] += 1
+                else:
+                    states["active+clean"] += 1
         return {
             "osdmap": {"epoch": self.osdmap.epoch,
                        "num_osds": self.osdmap.max_osd,
@@ -860,5 +871,7 @@ class MiniCluster:
                            1 for o in range(self.osdmap.max_osd)
                            if self.osdmap.is_up(o))},
             "pgmap": {"num_pgs": n_pgs,
-                      "num_pools": len(self.pools)},
+                      "num_pools": len(self.pools),
+                      "pgs_by_state": {k: v for k, v in states.items()
+                                       if v}},
         }

@@ -136,3 +136,32 @@ class TestRadosCli:
         rados_main(["--data-dir", d, "mkpool", "p", "k=2", "m=1",
                     "device=numpy"])
         assert rados_main(["--data-dir", d, "stat", "p", "ghost"]) == 2
+
+
+class TestReviewRegressions:
+    def test_set_read_does_not_block_writes(self, io):
+        """snap_set_read affects READS only: writes under set_read go to
+        the head (regression: they bounced EROFS)."""
+        io.write_full("sr", b"v1")
+        sid = io.snap_create("s")
+        io.set_read(sid)
+        io.write_full("sr", b"v2")        # must NOT raise
+        assert io.read("sr") == b"v1"     # read still at the snap
+        io.set_read(None)
+        assert io.read("sr") == b"v2"
+        io.snap_remove("s")
+
+    def test_cookies_unique_across_handles(self, io):
+        io.write_full("ck", b"x")
+        io2 = io.rados.open_ioctx("data")
+        fn = lambda n, ck, p: b"a"        # noqa: E731 — same callback
+        c1 = io.watch("ck", fn)
+        c2 = io2.watch("ck", fn)
+        assert c1 != c2                   # two registrations, two cookies
+        acks = io.notify("ck")
+        assert set(acks) == {c1, c2}
+
+    def test_operate_routes_through_objecter(self, io):
+        before = io.rados.objecter.next_tid
+        io.write_full("tid", b"x")
+        assert io.rados.objecter.next_tid > before

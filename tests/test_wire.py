@@ -168,3 +168,96 @@ def test_banner_split_across_reads():
     for i in range(0, len(payload), 3):
         a.receive(payload[i:i + 3])
     assert a.ready and a.peer_hello.entity == "osd.1"
+
+
+def test_cephx_session_key_secures_the_wire():
+    """End-to-end auth->transport integration: a cephx mutual-auth
+    session yields the service session key, and that key drives the
+    bus's SECURE (HMAC) wire mode — the reference's cephx + msgr v2
+    secure-mode pairing (ProtocolV2 auth -> crypto_onwire session
+    keys)."""
+    from ceph_tpu.auth.cephx import (CephxClient, CephxServiceHandler,
+                                     KeyServer)
+    from ceph_tpu.cluster import MiniCluster
+    import ceph_tpu.cluster as cluster_mod
+
+    ks = KeyServer()
+    ks.rotate("osd")
+    key = ks.create_entity("client.admin")
+    client = CephxClient("client.admin", key)
+    client.authenticate(ks, now=100.0)
+    ticket = client.get_ticket(ks, "osd", now=100.0)
+    authz = client.build_authorizer("osd", now=100.0)
+    osd_side = CephxServiceHandler("osd", ks)
+    entity, reply = osd_side.verify_authorizer(authz, now=100.0)
+    assert entity == "client.admin"
+    client.verify_reply("osd", reply, authz.nonce)   # mutual auth
+
+    session_key = ticket.session_key
+    orig = cluster_mod.MessageBus
+    cluster_mod.MessageBus = lambda: MessageBus(wire=True,
+                                                wire_secret=session_key)
+    try:
+        c = MiniCluster(n_osds=9, osds_per_host=3, chunk_size=512)
+        pid = c.create_ec_pool("sec", {"k": "2", "m": "1",
+                                       "device": "numpy"}, pg_num=4)
+        payload = np.random.default_rng(1).integers(
+            0, 256, 4000, np.uint8).tobytes()
+        c.put(pid, "obj", payload)
+        assert c.get(pid, "obj", 4000) == payload
+        g = c.pg_group(pid, "obj")
+        assert g.bus.wire_secret == session_key
+        assert g.bus.delivered > 0
+        c.shutdown()
+    finally:
+        cluster_mod.MessageBus = orig
+
+
+def test_thrash_composes_with_wire_and_faults():
+    """The thrasher's kill/revive churn runs over wire-mode buses WITH
+    reorder+dup injection: framing, dedup, and recovery compose."""
+    import ceph_tpu.cluster as cluster_mod
+    from ceph_tpu.backend.messages import FaultConfig
+    from ceph_tpu.cluster import MiniCluster
+
+    def bus_factory():
+        bus = MessageBus(wire=True)
+        bus.inject_faults(FaultConfig(seed=11, reorder=True, dup_prob=0.2))
+        return bus
+    orig = cluster_mod.MessageBus
+    cluster_mod.MessageBus = bus_factory
+    try:
+        c = MiniCluster(n_osds=8, osds_per_host=2, chunk_size=512)
+        pid = c.create_ec_pool("t", {"k": "2", "m": "2", "device": "numpy"},
+                               pg_num=4)
+        import random
+        rng = random.Random(5)
+        model = {}
+        for i in range(25):
+            oid = f"o{rng.randrange(8)}"
+            data = np.random.default_rng(i).integers(
+                0, 256, 1024, np.uint8).tobytes()
+            g = c.pg_group(pid, oid)
+            peers = [o for o in g.acting if o != g.backend.whoami]
+            if rng.random() < 0.3:
+                victim = rng.choice(peers)
+                if victim not in g.bus.down:
+                    g.bus.mark_down(victim)
+            try:
+                c.put(pid, oid, data)
+                model[oid] = data
+            except IOError:
+                pass                      # blocked on inactive PG: fine
+            if rng.random() < 0.5:
+                for o in list(g.bus.down):
+                    g.bus.mark_up(o)
+                g.bus.deliver_all()
+        for g in c.pools[pid]["pgs"].values():
+            for o in list(g.bus.down):
+                g.bus.mark_up(o)
+            g.bus.deliver_all()
+        for oid, want in model.items():
+            assert c.get(pid, oid, 1024) == want, oid
+        c.shutdown()
+    finally:
+        cluster_mod.MessageBus = orig
