@@ -80,10 +80,19 @@ class IoCtx:
         snapid = (None if any(o.op in _HEAD_ONLY for o in op.ops)
                   else self.snap_read)
         out: list = []
-        self.rados.objecter.operate(self.pool_id, oid, op,
-                                    on_complete=out.append, snapid=snapid)
+        tid = self.rados.objecter.operate(self.pool_id, oid, op,
+                                          on_complete=out.append,
+                                          snapid=snapid)
         if not out:
-            raise IOError(f"op on {oid} blocked: PG inactive")
+            # parked on an inactive PG: it stays queued at the OSD and
+            # commits when shards return (put()'s semantics) — but it
+            # must leave the objecter's inflight list NOW, or a map
+            # change would RESEND it and a non-idempotent op (append,
+            # omap mutation) could apply twice
+            self.rados.objecter.inflight.pop(tid, None)
+            from ..cluster import BlockedWriteError
+            raise BlockedWriteError(
+                f"op on {oid} blocked: PG inactive (queued, not lost)")
         reply = out[0]
         if isinstance(reply, Exception):
             _raise(reply if isinstance(reply, IOError)

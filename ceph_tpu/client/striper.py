@@ -1,0 +1,143 @@
+"""RadosStriper: large objects striped across RADOS objects.
+
+Analog of the reference's libradosstriper (reference:
+src/libradosstriper/RadosStriperImpl.cc — RAID-0 striping with
+stripe_unit/stripe_count/object_size layout, piece objects named
+"<soid>.%016x", and the layout+size stored as xattrs on the first
+piece).  SURVEY §2.4 lists striping as one of the reference's
+parallelism axes; here it is ALSO the TPU batching hook: a striped
+write produces many whole RADOS objects at once, which EC pools encode
+in ONE device dispatch via put_many's cross-PG coalescing
+(ecutil.encode_many — the restructuring SURVEY §3.2 stars).
+
+Layout semantics (Ceph file-layout striping): data advances in
+stripe_unit chunks round-robin over a SET of stripe_count objects;
+when every object of the set reaches object_size, the next set starts.
+"""
+from __future__ import annotations
+
+from ..osd.osd_ops import ObjectOperation
+from .rados import ObjectNotFound
+
+LAYOUT_ATTR = "striper.layout"      # {su, sc, os, size} on piece 0
+
+
+def piece_name(soid: str, idx: int) -> str:
+    return f"{soid}.{idx:016x}"
+
+
+class RadosStriper:
+    def __init__(self, ioctx, stripe_unit: int = 65536,
+                 stripe_count: int = 4, object_size: int = 1 << 20):
+        if object_size % stripe_unit:
+            raise ValueError("object_size must be a stripe_unit multiple")
+        self.io = ioctx
+        self.su = stripe_unit
+        self.sc = stripe_count
+        self.os = object_size
+
+    # -- layout math --------------------------------------------------------
+
+    def _piece_extents(self, length: int) -> list[tuple[int, list]]:
+        """[(piece idx, [(piece off, logical off, n)])] covering length."""
+        per_set = self.os * self.sc          # bytes per object set
+        pieces: dict[int, list] = {}
+        off = 0
+        while off < length:
+            set_no, set_off = divmod(off, per_set)
+            row, row_off = divmod(set_off, self.su * self.sc)
+            col, unit_off = divmod(row_off, self.su)
+            idx = set_no * self.sc + col
+            n = min(self.su - unit_off, length - off)
+            pieces.setdefault(idx, []).append(
+                (row * self.su + unit_off, off, n))
+            off += n
+        return sorted(pieces.items())
+
+    # -- I/O -----------------------------------------------------------------
+
+    def _existing_pieces(self, soid: str) -> list[str]:
+        """Piece objects of ``soid`` from the pool's listing — GROUND
+        TRUTH, independent of any (possibly stale) layout attr."""
+        prefix = f"{soid}."
+        out = []
+        for oid in self.io.list_objects():
+            tail = oid[len(prefix):]
+            if oid.startswith(prefix) and len(tail) == 16 and \
+                    all(ch in "0123456789abcdef" for ch in tail):
+                out.append(oid)
+        return out
+
+    def write_full(self, soid: str, data: bytes) -> int:
+        """Stripe ``data`` over piece objects; EC pools encode the whole
+        batch in one device dispatch.  Returns the piece count.  A
+        shrinking rewrite deletes the stale trailing pieces (the
+        reference truncates/removes them on shrink)."""
+        data = bytes(data)
+        pieces = self._piece_extents(len(data))
+        bufs: dict[str, bytearray] = {}
+        for idx, extents in pieces:
+            buf = bufs.setdefault(piece_name(soid, idx), bytearray())
+            for p_off, l_off, n in extents:
+                if len(buf) < p_off + n:
+                    buf.extend(b"\0" * (p_off + n - len(buf)))
+                buf[p_off:p_off + n] = data[l_off:l_off + n]
+        cluster = self.io.rados.cluster
+        # ONE batched device encode for every piece (cross-PG coalescing)
+        cluster.put_many(self.io.pool_id,
+                         {oid: bytes(b) for oid, b in bufs.items()})
+        self.io.operate(piece_name(soid, 0), ObjectOperation().setxattr(
+            LAYOUT_ATTR, {"su": self.su, "sc": self.sc, "os": self.os,
+                          "size": len(data)}))
+        for stale in set(self._existing_pieces(soid)) - set(bufs):
+            self.io.remove_object(stale)
+        return len(bufs)
+
+    def _layout(self, soid: str) -> dict:
+        return self.io.get_xattr(piece_name(soid, 0), LAYOUT_ATTR)
+
+    def stat(self, soid: str) -> int:
+        return int(self._layout(soid)["size"])
+
+    def read(self, soid: str, length: int | None = None,
+             offset: int = 0) -> bytes:
+        lay = self._layout(soid)
+        su, sc, osz = int(lay["su"]), int(lay["sc"]), int(lay["os"])
+        size = int(lay["size"])
+        if length is None:
+            length = size - offset
+        end = min(offset + length, size)
+        if end <= offset:
+            return b""
+        # reassemble with the WRITER's layout (it may differ from ours),
+        # reading only the WINDOWED byte range of each piece — a small
+        # read must not pull whole megabyte pieces through the decode
+        reader = RadosStriper(self.io, su, sc, osz)
+        out = bytearray(end - offset)
+        for idx, extents in reader._piece_extents(size):
+            wanted = []                   # (piece off, logical start, n)
+            for p_off, l_off, n in extents:
+                s = max(l_off, offset)
+                e = min(l_off + n, end)
+                if s < e:
+                    wanted.append((p_off + (s - l_off), s, e - s))
+            if not wanted:
+                continue
+            lo = min(w[0] for w in wanted)
+            hi = max(w[0] + w[2] for w in wanted)
+            data = self.io.read(piece_name(soid, idx), hi - lo, offset=lo)
+            for p_off, s, n in wanted:
+                out[s - offset:s - offset + n] = \
+                    data[p_off - lo:p_off - lo + n].ljust(n, b"\0")
+        return bytes(out)
+
+    def remove(self, soid: str) -> int:
+        """Delete every piece by pool-listing ground truth (layout-derived
+        sets would orphan pieces left by an older, larger layout).
+        Piece 0 goes last: the layout must outlive the rest."""
+        pieces = sorted(self._existing_pieces(soid), reverse=True)
+        if not pieces:
+            raise ObjectNotFound(f"no striped object {soid!r}")
+        for oid in pieces:
+            self.io.remove_object(oid)
+        return len(pieces)
