@@ -38,6 +38,7 @@ class _Op:
     read_len: int = 0
     ops: list | None = None               # op VECTOR (IoCtx::operate path)
     snapid: int | None = None             # read AT this snap
+    drain: bool = True                    # False = aio: queue, don't pump
     on_complete: object = None
     target: tuple | None = None           # (ps, primary, acting) last sent
     attempts: int = 0
@@ -80,7 +81,8 @@ class Objecter:
         return op.tid
 
     def operate(self, pool_id: int, oid: str, op,
-                on_complete=None, snapid: int | None = None) -> int:
+                on_complete=None, snapid: int | None = None,
+                drain: bool = True) -> int:
         """Submit a librados-style op VECTOR (ObjectOperation) through the
         full client lifecycle — epoch-stamped target, stale reject +
         resend on map change — landing in the primary's op engine
@@ -88,7 +90,7 @@ class Objecter:
         ``on_complete`` receives the MOSDOpReply."""
         self.next_tid += 1
         o = _Op(self.next_tid, pool_id, oid, None, ops=list(op.ops),
-                snapid=snapid, on_complete=on_complete)
+                snapid=snapid, drain=drain, on_complete=on_complete)
         self.inflight[o.tid] = o
         self._send_op(o)
         return o.tid
@@ -120,7 +122,7 @@ class Objecter:
         reply = self.cluster.osd_submit(
             op.pool_id, ps, primary, self.osdmap.epoch,
             oid=op.oid, data=op.data, read_len=op.read_len, ops=op.ops,
-            snapid=op.snapid,
+            snapid=op.snapid, drain=op.drain,
             on_done=lambda result, _op=op: self._op_done(_op, result))
         if reply is not None:             # ("stale", current_map)
             _, newer = reply

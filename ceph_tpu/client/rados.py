@@ -39,6 +39,55 @@ def _raise(e: IOError):
     raise e
 
 
+class Completion:
+    """An in-flight async op (librados::AioCompletion): poll
+    ``is_complete`` or ``wait_for_complete`` (pumping the cluster's
+    queues), then read ``result``/``reply``."""
+
+    def __init__(self, cluster, pg_group):
+        self._cluster = cluster
+        self._g = pg_group
+        self.reply = None
+        self._callbacks: list = []
+
+    @property
+    def is_complete(self) -> bool:
+        return self.reply is not None
+
+    @property
+    def result(self) -> int:
+        """The op's result; raises while incomplete — defaulting to 0
+        here would report success for a write that never applied."""
+        if self.reply is None:
+            raise ValueError("op not complete; poll is_complete")
+        return self.reply.result
+
+    def set_complete_callback(self, fn) -> None:
+        if self.reply is not None:
+            fn(self)
+        else:
+            self._callbacks.append(fn)
+
+    def _done(self, reply) -> None:
+        self.reply = reply
+        for fn in self._callbacks:
+            fn(self)
+        self._callbacks.clear()
+
+    def wait_for_complete(self) -> int:
+        """Drive the daemon + bus until the op completes.  An op parked
+        on an inactive PG cannot complete yet: raises BlockedWriteError
+        (queued, not lost — it commits when the PG reactivates) instead
+        of faking a success code."""
+        daemon = self._cluster.osds[self._g.backend.whoami]
+        daemon.drain()
+        self._g.bus.deliver_all()
+        if not self.is_complete:
+            from ..cluster import BlockedWriteError
+            raise BlockedWriteError("op parked on an inactive PG")
+        return self.result
+
+
 class Rados:
     """The cluster handle (librados::Rados)."""
 
@@ -59,6 +108,9 @@ class Rados:
 
     def cluster_stat(self) -> dict:
         return self.cluster.status()
+
+    def health(self) -> dict:
+        return self.cluster.health()
 
 
 class IoCtx:
@@ -103,6 +155,22 @@ class IoCtx:
             err.reply = reply
             _raise(err)
         return reply
+
+    def aio_operate(self, oid: str, op: ObjectOperation) -> Completion:
+        """Async operate (librados aio_operate): the op is QUEUED on the
+        primary's daemon without draining; the returned Completion fires
+        when the reply lands (wait_for_complete pumps the queues).
+        Shares operate()'s snap_read/head-only logic and the Objecter's
+        epoch-stamped lifecycle."""
+        cluster = self.rados.cluster
+        g = cluster.pg_group(self.pool_id, oid)
+        comp = Completion(cluster, g)
+        snapid = (None if any(o.op in _HEAD_ONLY for o in op.ops)
+                  else self.snap_read)
+        self.rados.objecter.operate(self.pool_id, oid, op,
+                                    on_complete=comp._done,
+                                    snapid=snapid, drain=False)
+        return comp
 
     # -- whole-object convenience -------------------------------------------
 

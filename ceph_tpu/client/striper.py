@@ -89,9 +89,12 @@ class RadosStriper:
         self.io.operate(piece_name(soid, 0), ObjectOperation().setxattr(
             LAYOUT_ATTR, {"su": self.su, "sc": self.sc, "os": self.os,
                           "size": len(data)}))
-        for stale in set(self._existing_pieces(soid)) - set(bufs):
+        # piece 0 always survives the sweep: an EMPTY object has no data
+        # pieces but its layout piece was just written above
+        for stale in (set(self._existing_pieces(soid)) - set(bufs)
+                      - {piece_name(soid, 0)}):
             self.io.remove_object(stale)
-        return len(bufs)
+        return max(len(bufs), 1)
 
     def _layout(self, soid: str) -> dict:
         return self.io.get_xattr(piece_name(soid, 0), LAYOUT_ATTR)

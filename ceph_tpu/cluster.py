@@ -497,6 +497,26 @@ class MiniCluster:
             for g in p["pgs"].values():
                 g.bus.deliver_all()
 
+    def health(self) -> dict:
+        """'ceph health detail' shape: HEALTH_OK / HEALTH_WARN /
+        HEALTH_ERR with the reference's check keys (OSD_DOWN,
+        PG_DEGRADED, PG_AVAILABILITY — src/mon/health_check.h)."""
+        checks: dict[str, str] = {}
+        st = self.status()
+        down = st["osdmap"]["num_osds"] - st["osdmap"]["num_up_osds"]
+        if down:
+            checks["OSD_DOWN"] = f"{down} osds down"
+        by_state = st["pgmap"]["pgs_by_state"]
+        if by_state.get("active+degraded"):
+            checks["PG_DEGRADED"] = \
+                f"{by_state['active+degraded']} pgs degraded"
+        if by_state.get("inactive"):
+            checks["PG_AVAILABILITY"] = \
+                f"{by_state['inactive']} pgs inactive"
+        status = ("HEALTH_ERR" if "PG_AVAILABILITY" in checks
+                  else "HEALTH_WARN" if checks else "HEALTH_OK")
+        return {"status": status, "checks": checks}
+
     # -- scrub (PG::scrub scheduling through the daemons' op queues) --------
 
     def scrub_pool(self, pool_id: int, repair: bool = True) -> dict:
@@ -642,7 +662,7 @@ class MiniCluster:
     def osd_submit(self, pool_id: int, ps: int, target_osd: int,
                    client_epoch: int, oid: str, data: bytes | None,
                    read_len: int = 0, on_done=None, ops=None,
-                   snapid: int | None = None):
+                   snapid: int | None = None, drain: bool = True):
         """One client op arriving at an OSD.  Returns None when accepted
         (completion via ``on_done``), or ``("stale", current_map)`` when
         the client's map is too old for this PG — wrong primary, or an
@@ -657,7 +677,7 @@ class MiniCluster:
         if ops is not None:
             res = self._dispatch_op_vector(g, pool_id, oid, ops,
                                            client_epoch, on_done,
-                                           snapid=snapid)
+                                           snapid=snapid, drain=drain)
             if res is not None:
                 return ("stale", self.osdmap)
             return None

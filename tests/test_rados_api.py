@@ -165,3 +165,64 @@ class TestReviewRegressions:
         before = io.rados.objecter.next_tid
         io.write_full("tid", b"x")
         assert io.rados.objecter.next_tid > before
+
+
+class TestHealthAndAio:
+    def test_health_transitions(self, io):
+        r = io.rados
+        c = r.cluster
+        assert r.health() == {"status": "HEALTH_OK", "checks": {}}
+        io.write_full("h", b"x")
+        g = c.pg_group(io.pool_id, "h")
+        peers = [o for o in g.acting if o != g.backend.whoami]
+        g.bus.mark_down(peers[0])
+        h = r.health()          # 2/3 shards, min_size 3: inactive -> ERR
+        assert h["status"] == "HEALTH_ERR"
+        assert "PG_AVAILABILITY" in h["checks"]
+        g.bus.mark_up(peers[0])
+        g.bus.deliver_all()
+        assert r.health()["status"] == "HEALTH_OK"
+
+    def test_aio_operate(self, io):
+        comps = [io.aio_operate(f"a{i}", ObjectOperation()
+                                .write_full(f"v{i}".encode()))
+                 for i in range(4)]
+        fired = []
+        comps[0].set_complete_callback(lambda c: fired.append(c.result))
+        assert not any(c.is_complete for c in comps)    # still queued
+        for c in comps:
+            assert c.wait_for_complete() == 0
+        assert fired == [0]
+        for i in range(4):
+            assert io.read(f"a{i}") == f"v{i}".encode()
+
+    def test_aio_parked_completes_on_revival(self, io):
+        from ceph_tpu.cluster import BlockedWriteError
+        io.write_full("ap", b"v1")
+        c = io.rados.cluster
+        g = c.pg_group(io.pool_id, "ap")
+        peers = [o for o in g.acting if o != g.backend.whoami]
+        for o in peers:
+            g.bus.mark_down(o)
+        comp = io.aio_operate("ap", ObjectOperation().write_full(b"v2"))
+        with pytest.raises(BlockedWriteError):
+            comp.wait_for_complete()          # parked != success
+        assert not comp.is_complete
+        with pytest.raises(ValueError):
+            comp.result                       # no fake success code
+        for o in peers:
+            g.bus.mark_up(o)
+        g.bus.deliver_all()
+        assert comp.is_complete and comp.result == 0
+        assert io.read("ap") == b"v2"
+
+    def test_aio_honors_set_read(self, io):
+        io.write_full("as", b"v1")
+        sid = io.snap_create("s")
+        io.write_full("as", b"v2")
+        io.set_read(sid)
+        comp = io.aio_operate("as", ObjectOperation().read(0, 0))
+        comp.wait_for_complete()
+        assert comp.reply.outdata(0) == b"v1"     # snap, not head
+        io.set_read(None)
+        io.snap_remove("s")

@@ -156,3 +156,35 @@ def test_explorer_catches_planted_race():
     assert not res.ok, "explorer missed the planted race"
     with pytest.raises(AssertionError, match="quorum raced"):
         replay(scenario, res.failure_trace)
+
+
+def scenario_peering_vs_writes(bus):
+    """The peering statechart restarted mid-write-storm: in EVERY
+    delivery interleaving of GetInfo replies, activation acks, sub-ops
+    and repair traffic, the PG ends Active with all acked writes
+    readable and the statechart history well-formed."""
+    from ceph_tpu.osd.peering import PeeringCoordinator, PState
+    backend = _mk_backend(bus)
+    coord = PeeringCoordinator(backend)
+    a, b = _payload(8), _payload(9)
+    commits = []
+    backend.submit_transaction(PGTransaction().write("obj", 0, a),
+                               on_commit=lambda t: commits.append("a"))
+    coord.advance_map(epoch=3)      # peer while the write is in flight
+    backend.submit_transaction(PGTransaction().write("obj", 0, b),
+                               on_commit=lambda t: commits.append("b"))
+    bus.run_to_quiescence()
+    assert coord.state is PState.ACTIVE, coord.state
+    assert commits == ["a", "b"], commits
+    assert _read(backend, bus, "obj") == b
+    # the history never skips states within one epoch
+    seq = [s for e, s in coord.history if e == 3]
+    assert seq[0] == PState.GET_INFO.value
+    assert seq[-1] == PState.ACTIVE.value
+
+
+def test_peering_vs_writes_schedules():
+    res = explore_random(scenario_peering_vs_writes, schedules=30)
+    assert res.ok, f"trace {res.failure_trace}: {res.failure}"
+    res = explore_dfs(scenario_peering_vs_writes, max_runs=60)
+    assert res.ok, f"trace {res.failure_trace}: {res.failure}"

@@ -125,3 +125,14 @@ def test_blocked_op_leaves_no_ghost_resend(io):
         g.bus.mark_up(o)
     g.bus.deliver_all()
     assert io.read("gh") == b"v1X"            # queued op still committed
+
+
+def test_empty_object(io):
+    """write_full(b'') keeps its layout piece: stat 0, read b''
+    (regression: the stale-piece sweep deleted piece 0)."""
+    st = RadosStriper(io, stripe_unit=512, stripe_count=2,
+                      object_size=1024)
+    assert st.write_full("empty", b"") == 1
+    assert st.stat("empty") == 0
+    assert st.read("empty") == b""
+    assert st.remove("empty") == 1
