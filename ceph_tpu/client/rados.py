@@ -121,6 +121,12 @@ class IoCtx:
         self.pool_id = pool_id
         self.snap_read: int | None = None     # set_read at a snap
 
+    def _effective_snapid(self, op: ObjectOperation) -> int | None:
+        """set_read's snapid for pure-read vectors; head otherwise
+        (ONE copy of the librados snap_set_read rule)."""
+        return (None if any(o.op in _HEAD_ONLY for o in op.ops)
+                else self.snap_read)
+
     # -- op vectors (IoCtx::operate) ----------------------------------------
 
     def operate(self, oid: str, op: ObjectOperation):
@@ -129,12 +135,10 @@ class IoCtx:
         snapid applies to pure-read vectors only — writes, cls calls,
         and watch ops always target the head (librados snap_set_read
         semantics)."""
-        snapid = (None if any(o.op in _HEAD_ONLY for o in op.ops)
-                  else self.snap_read)
         out: list = []
         tid = self.rados.objecter.operate(self.pool_id, oid, op,
                                           on_complete=out.append,
-                                          snapid=snapid)
+                                          snapid=self._effective_snapid(op))
         if not out:
             # parked on an inactive PG: it stays queued at the OSD and
             # commits when shards return (put()'s semantics) — but it
@@ -165,11 +169,16 @@ class IoCtx:
         cluster = self.rados.cluster
         g = cluster.pg_group(self.pool_id, oid)
         comp = Completion(cluster, g)
-        snapid = (None if any(o.op in _HEAD_ONLY for o in op.ops)
-                  else self.snap_read)
-        self.rados.objecter.operate(self.pool_id, oid, op,
-                                    on_complete=comp._done,
-                                    snapid=snapid, drain=False)
+        tid = self.rados.objecter.operate(
+            self.pool_id, oid, op, on_complete=comp._done,
+            snapid=self._effective_snapid(op), drain=False)
+        # A queued op must NOT stay resendable: with no OSD-side reqid
+        # dedup, a map change while it sits undrained would double-apply
+        # a non-idempotent vector (same queued-not-lost choice the sync
+        # path makes for parked ops).  It also pins the op to the PG
+        # group captured in the Completion — the one wait_for_complete
+        # pumps.
+        self.rados.objecter.inflight.pop(tid, None)
         return comp
 
     # -- whole-object convenience -------------------------------------------

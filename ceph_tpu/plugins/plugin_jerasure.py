@@ -40,6 +40,7 @@ _TECHNIQUE_MAP = {
     "cauchy_good": "cauchy",
 }
 _BITMATRIX = ("liberation", "blaum_roth", "liber8tion")
+_WIDE = ("reed_sol_van", "cauchy_orig", "cauchy_good")   # at w in {16,32}
 DEFAULT_PACKETSIZE = "2048"     # ErasureCodeJerasure.h:139
 
 
@@ -61,7 +62,17 @@ class ErasureCodeJerasureCompat(ErasureCodeJaxRS):
 
 
 class ErasureCodeJerasureBitmatrix(DeviceRouting, ErasureCode):
-    """liberation / blaum_roth / liber8tion over packets on the MXU."""
+    """Packet-layout GF(2) bitmatrix codes on the MXU.
+
+    Two families share this machinery:
+    - the RAID-6 bitmatrix techniques (liberation/blaum_roth/liber8tion,
+      m forced to 2, their own w envelopes);
+    - the WIDE-word scalar techniques (reed_sol_van/cauchy at w in
+      {16, 32}): the GF(2^w) coding matrix expands to a [w*m, w*k]
+      GF(2) bitmatrix (gf/gfw.py) and the data path is identical —
+      word size only changes how many packets a chunk splits into,
+      the MXU kernel never sees it.
+    """
 
     DEFAULT_K = "2"             # ErasureCodeJerasure.h:202-204
     # The reference's blaum_roth inherits DEFAULT_W="7" from Liberation and
@@ -70,7 +81,9 @@ class ErasureCodeJerasureBitmatrix(DeviceRouting, ErasureCode):
     # UNDECODABLE.  Defaulting a RAID-6 pool to a non-MDS profile loses
     # data; here the default is the nearest valid w (w+1=7 prime) and w=7
     # stays accept-on-explicit-request for profile compat only.
-    DEFAULT_W = {"liberation": "7", "blaum_roth": "6", "liber8tion": "8"}
+    DEFAULT_W = {"liberation": "7", "blaum_roth": "6", "liber8tion": "8",
+                 "reed_sol_van": "16", "cauchy_orig": "16",
+                 "cauchy_good": "16"}
 
     def __init__(self, technique: str):
         super().__init__()
@@ -97,20 +110,31 @@ class ErasureCodeJerasureBitmatrix(DeviceRouting, ErasureCode):
                                       DEFAULT_PACKETSIZE)
         self.parse_device_routing(profile)
         self.sanity_check_k_m(self.k, self.m)
-        if self.m != 2:
-            raise ValueError(
-                f"m={self.m}: {technique} is a RAID-6 code, m must be 2")
         if self.packetsize <= 0:
             raise ValueError("packetsize must be set")
         if self.packetsize % 4:
             raise ValueError(
                 f"packetsize={self.packetsize} must be a multiple of 4")
-        if technique == "liberation":
-            self.coding = bm.liberation_bitmatrix(self.k, self.w)
-        elif technique == "blaum_roth":
-            self.coding = bm.blaum_roth_bitmatrix(self.k, self.w)
+        if technique in _WIDE:
+            from ..gf.gfw import GFW
+            if self.w not in (16, 32):
+                raise ValueError(f"w={self.w} must be 16 or 32 here "
+                                 f"(w=8 {technique} runs the byte codec)")
+            gf = GFW(self.w)
+            mat = (gf.vandermonde(self.k, self.m)
+                   if technique == "reed_sol_van"
+                   else gf.cauchy(self.k, self.m))
+            self.coding = gf.expand_bitmatrix(mat)
         else:
-            self.coding = bm.liber8tion_bitmatrix(self.k)
+            if self.m != 2:
+                raise ValueError(f"m={self.m}: {technique} is a RAID-6 "
+                                 f"code, m must be 2")
+            if technique == "liberation":
+                self.coding = bm.liberation_bitmatrix(self.k, self.w)
+            elif technique == "blaum_roth":
+                self.coding = bm.blaum_roth_bitmatrix(self.k, self.w)
+            else:
+                self.coding = bm.liber8tion_bitmatrix(self.k)
         if self.chunk_mapping and len(self.chunk_mapping) != self.k + self.m:
             raise ValueError(
                 f"mapping maps {len(self.chunk_mapping)} chunks "
@@ -172,7 +196,8 @@ class ErasureCodePluginJerasure(ErasureCodePlugin):
     def factory(self, directory: str,
                 profile: ErasureCodeProfile) -> ErasureCode:
         technique = profile.get("technique") or "reed_sol_van"
-        if technique in _BITMATRIX:
+        w = int(profile.get("w", "8") or "8")
+        if technique in _BITMATRIX or (technique in _WIDE and w != 8):
             instance: ErasureCode = ErasureCodeJerasureBitmatrix(technique)
         else:
             instance = ErasureCodeJerasureCompat()

@@ -226,3 +226,12 @@ class TestHealthAndAio:
         assert comp.reply.outdata(0) == b"v1"     # snap, not head
         io.set_read(None)
         io.snap_remove("s")
+
+    def test_aio_leaves_no_resendable_ghost(self, io):
+        """A queued aio op must leave inflight immediately: a map change
+        in the submit-to-wait window would resend and double-apply a
+        non-idempotent vector (regression)."""
+        comp = io.aio_operate("ag", ObjectOperation().write_full(b"v"))
+        assert not io.rados.objecter.inflight
+        assert comp.wait_for_complete() == 0
+        assert io.read("ag") == b"v"

@@ -44,6 +44,10 @@ PROFILES = [
                   "w": "6", "packetsize": "8"}),
     ("jerasure", {"k": "6", "m": "2", "technique": "liber8tion",
                   "packetsize": "8"}),
+    ("jerasure", {"k": "4", "m": "3", "technique": "reed_sol_van",
+                  "w": "16", "packetsize": "8"}),
+    ("jerasure", {"k": "4", "m": "2", "technique": "cauchy_good",
+                  "w": "32", "packetsize": "4"}),
     ("cpp_rs", {"k": "4", "m": "2", "technique": "reed_sol_van"}),
     ("cpp_rs", {"k": "8", "m": "4", "technique": "cauchy"}),
     ("xor", {"k": "3", "m": "1"}),
