@@ -828,3 +828,94 @@ def _hello_record_hello(ctx: ClsContext):
 ClsRegistry.register("hello", "say_hello", _hello_say_hello, mutates=False)
 ClsRegistry.register("hello", "record_hello", _hello_record_hello,
                      mutates=True)
+
+
+# -- cls_lock: advisory object locks (the reference's src/cls/lock, the
+# -- coordination primitive RBD/RGW build on).  Lock state lives in an
+# -- object xattr and mutates atomically with the op vector.
+
+LOCK_ATTR = "lock"              # per-object lock table xattr
+LOCK_EXCLUSIVE = "exclusive"
+LOCK_SHARED = "shared"
+EBUSY = -16
+
+
+def _locks(ctx: ClsContext) -> dict:
+    """DEEP copy of the lock table: the stored xattr's inner dicts must
+    never leak — in-place mutation would bypass the transaction (a failed
+    vector would still release locks) and get_info callers could corrupt
+    committed state through the returned aliases."""
+    try:
+        stored = ctx.getxattr(LOCK_ATTR)
+    except KeyError:
+        return {}
+    return {name: {"type": lk["type"], "holders": list(lk["holders"])}
+            for name, lk in stored.items()}
+
+
+def _lock_lock(ctx: ClsContext):
+    """indata: {name, cookie, type} — take/renew the lock.  EBUSY when an
+    exclusive holder exists, or on a shared lock being taken exclusively
+    (cls_lock lock_obj semantics; re-locking your own cookie renews)."""
+    import pickle
+    req = pickle.loads(ctx.indata)
+    name, cookie = req["name"], req["cookie"]
+    ltype = req.get("type", LOCK_EXCLUSIVE)
+    locks = _locks(ctx)
+    lk = locks.get(name)
+    if lk is not None:
+        if cookie in lk["holders"]:
+            if lk["type"] != ltype:
+                # no silent up/downgrade: an exclusive request against a
+                # shared hold must not report success while the lock
+                # stays shared (cls_lock refuses conflicting types)
+                return EBUSY, b""
+            # renewal: state unchanged
+        else:
+            if lk["type"] == LOCK_EXCLUSIVE or ltype == LOCK_EXCLUSIVE:
+                return EBUSY, b""
+            lk = {"type": lk["type"],
+                  "holders": sorted(set(lk["holders"]) | {cookie})}
+    else:
+        lk = {"type": ltype, "holders": [cookie]}
+    locks[name] = lk
+    ctx.setxattr(LOCK_ATTR, locks)
+    return 0, b""
+
+
+def _lock_unlock(ctx: ClsContext):
+    """indata: {name, cookie} — release; ENOENT when not held."""
+    import pickle
+    req = pickle.loads(ctx.indata)
+    locks = _locks(ctx)
+    lk = locks.get(req["name"])
+    if lk is None or req["cookie"] not in lk["holders"]:
+        return ENOENT, b""
+    lk["holders"] = [h for h in lk["holders"] if h != req["cookie"]]
+    if lk["holders"]:
+        locks[req["name"]] = lk
+    else:
+        del locks[req["name"]]
+    ctx.setxattr(LOCK_ATTR, locks)
+    return 0, b""
+
+
+def _lock_break(ctx: ClsContext):
+    """indata: {name, cookie} — forcibly evict another client's cookie
+    (cls_lock break_lock: recovery from dead lockers)."""
+    return _lock_unlock(ctx)
+
+
+def _lock_info(ctx: ClsContext):
+    import pickle
+    req = pickle.loads(ctx.indata) if ctx.indata else {}
+    locks = _locks(ctx)          # deep copy: safe to hand to the caller
+    if "name" in req:
+        return 0, locks.get(req["name"])
+    return 0, locks
+
+
+ClsRegistry.register("lock", "lock", _lock_lock, mutates=True)
+ClsRegistry.register("lock", "unlock", _lock_unlock, mutates=True)
+ClsRegistry.register("lock", "break_lock", _lock_break, mutates=True)
+ClsRegistry.register("lock", "get_info", _lock_info, mutates=False)

@@ -40,7 +40,8 @@ _TECHNIQUE_MAP = {
     "cauchy_good": "cauchy",
 }
 _BITMATRIX = ("liberation", "blaum_roth", "liber8tion")
-_WIDE = ("reed_sol_van", "cauchy_orig", "cauchy_good")   # at w in {16,32}
+# scalar techniques that run the wide (w=16/32) bitmatrix path
+_WIDE = ("reed_sol_van", "reed_sol_r6_op", "cauchy_orig", "cauchy_good")
 DEFAULT_PACKETSIZE = "2048"     # ErasureCodeJerasure.h:139
 
 
@@ -82,8 +83,8 @@ class ErasureCodeJerasureBitmatrix(DeviceRouting, ErasureCode):
     # data; here the default is the nearest valid w (w+1=7 prime) and w=7
     # stays accept-on-explicit-request for profile compat only.
     DEFAULT_W = {"liberation": "7", "blaum_roth": "6", "liber8tion": "8",
-                 "reed_sol_van": "16", "cauchy_orig": "16",
-                 "cauchy_good": "16"}
+                 "reed_sol_van": "16", "reed_sol_r6_op": "16",
+                 "cauchy_orig": "16", "cauchy_good": "16"}
 
     def __init__(self, technique: str):
         super().__init__()
@@ -120,9 +121,11 @@ class ErasureCodeJerasureBitmatrix(DeviceRouting, ErasureCode):
             if self.w not in (16, 32):
                 raise ValueError(f"w={self.w} must be 16 or 32 here "
                                  f"(w=8 {technique} runs the byte codec)")
+            if technique == "reed_sol_r6_op":
+                self.m = 2          # RAID6 (ErasureCodeJerasure.h:111-140)
             gf = GFW(self.w)
             mat = (gf.vandermonde(self.k, self.m)
-                   if technique == "reed_sol_van"
+                   if technique.startswith("reed_sol")
                    else gf.cauchy(self.k, self.m))
             self.coding = gf.expand_bitmatrix(mat)
         else:

@@ -120,3 +120,17 @@ def test_plugin_rejects_unsupported_w(registry):
     with pytest.raises(ValueError):
         registry.factory("jerasure", "", {"technique": "reed_sol_van",
                                           "k": "4", "m": "2", "w": "12"})
+
+
+def test_plugin_wide_r6(registry):
+    """reed_sol_r6_op at w=16 is a reference-valid profile (m forced 2)."""
+    ec = registry.factory("jerasure", "",
+                          {"technique": "reed_sol_r6_op", "k": "4",
+                           "m": "5", "w": "16", "packetsize": "8",
+                           "device": "numpy"})
+    assert ec.get_coding_chunk_count() == 2
+    data = np.random.default_rng(11).integers(
+        0, 256, 20000, dtype=np.uint8).tobytes()
+    enc = ec.encode(set(range(6)), data)
+    avail = {i: enc[i] for i in range(6) if i not in (1, 5)}
+    assert ec.decode_concat(avail)[:20000] == data

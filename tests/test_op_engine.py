@@ -390,3 +390,85 @@ def test_write_slot_taken_before_async_hop(cluster):
     assert [x[0] for x in replies] == ["m1", "m2"]       # ordered commits
     assert c.operate(ec, "slot", ObjectOperation()
                      .read(0, 0)).outdata(0)[:2] == b"v2"
+
+
+class TestClsLock:
+    """cls_lock: advisory object locks (src/cls/lock semantics)."""
+
+    @staticmethod
+    def _call(c, pid, oid, method, **req):
+        import pickle
+        from ceph_tpu.osd.osd_ops import ObjectOperation
+        return c.operate(pid, oid, ObjectOperation().call(
+            "lock", method, pickle.dumps(req) if req else b""))
+
+    def test_exclusive_lock_lifecycle(self, cluster):
+        c, ec, _ = cluster
+        c.operate(ec, "lk", ObjectOperation().create())
+        self._call(c, ec, "lk", "lock", name="l", cookie="A")
+        # a second client is refused; the holder renews fine
+        with pytest.raises(IOError) as ei:
+            self._call(c, ec, "lk", "lock", name="l", cookie="B")
+        assert ei.value.errno == -16              # EBUSY
+        self._call(c, ec, "lk", "lock", name="l", cookie="A")
+        info = self._call(c, ec, "lk", "get_info", name="l").outdata(0)
+        assert info == {"type": "exclusive", "holders": ["A"]}
+        self._call(c, ec, "lk", "unlock", name="l", cookie="A")
+        self._call(c, ec, "lk", "lock", name="l", cookie="B")  # now free
+
+    def test_shared_locks_and_break(self, cluster):
+        c, ec, _ = cluster
+        c.operate(ec, "sh", ObjectOperation().create())
+        self._call(c, ec, "sh", "lock", name="s", cookie="A", type="shared")
+        self._call(c, ec, "sh", "lock", name="s", cookie="B", type="shared")
+        with pytest.raises(IOError):              # excl vs shared holders
+            self._call(c, ec, "sh", "lock", name="s", cookie="C",
+                       type="exclusive")
+        info = self._call(c, ec, "sh", "get_info", name="s").outdata(0)
+        assert info["holders"] == ["A", "B"]
+        # A dies; another client breaks its lock
+        self._call(c, ec, "sh", "break_lock", name="s", cookie="A")
+        self._call(c, ec, "sh", "unlock", name="s", cookie="B")
+        assert self._call(c, ec, "sh", "get_info").outdata(0) == {}
+
+    def test_unlock_not_held(self, cluster):
+        c, ec, _ = cluster
+        c.operate(ec, "nh", ObjectOperation().create())
+        with pytest.raises(IOError) as ei:
+            self._call(c, ec, "nh", "unlock", name="x", cookie="Z")
+        assert ei.value.errno == ENOENT
+
+    def test_failed_vector_does_not_release_lock(self, cluster):
+        """cls_lock mutations ride the transaction: an aborted vector
+        must not release locks (regression: in-place xattr aliasing)."""
+        import pickle
+        c, ec, _ = cluster
+        c.operate(ec, "lat", ObjectOperation().create())
+        self._call(c, ec, "lat", "lock", name="l", cookie="A")
+        with pytest.raises(IOError):
+            c.operate(ec, "lat", ObjectOperation()
+                      .call("lock", "unlock",
+                            pickle.dumps({"name": "l", "cookie": "A"}))
+                      .getxattr("missing"))
+        info = self._call(c, ec, "lat", "get_info", name="l").outdata(0)
+        assert info == {"type": "exclusive", "holders": ["A"]}
+
+    def test_get_info_returns_copies(self, cluster):
+        c, ec, _ = cluster
+        c.operate(ec, "cp", ObjectOperation().create())
+        self._call(c, ec, "cp", "lock", name="l", cookie="A")
+        info = self._call(c, ec, "cp", "get_info", name="l").outdata(0)
+        info["holders"].append("EVIL")      # must not corrupt the store
+        again = self._call(c, ec, "cp", "get_info", name="l").outdata(0)
+        assert again["holders"] == ["A"]
+
+    def test_no_silent_type_upgrade(self, cluster):
+        c, ec, _ = cluster
+        c.operate(ec, "up", ObjectOperation().create())
+        self._call(c, ec, "up", "lock", name="l", cookie="A", type="shared")
+        with pytest.raises(IOError) as ei:    # upgrade attempt refused
+            self._call(c, ec, "up", "lock", name="l", cookie="A",
+                       type="exclusive")
+        assert ei.value.errno == -16
+        info = self._call(c, ec, "up", "get_info", name="l").outdata(0)
+        assert info["type"] == "shared"
