@@ -3,12 +3,49 @@ framework with the capabilities of Ceph's ErasureCodePlugin registry and
 CRUSH placement engine (reference: /root/reference, v15 octopus dev).
 
 Subpackages:
-  gf        GF(2^8) tables + RS matrix algebra (host, exact)
-  ops       jit'd device kernels + RSCodec
-  plugins   ErasureCodeInterface / plugin registry (jax_rs, xor, lrc, ...)
-  crush     bit-exact CRUSH: rjenkins hash, straw2, choose, OSDMap chain
-  backend   ECBackend-shaped batching pipeline + in-memory shard store
+  gf        GF(2^8)/GF(2^16)/GF(2^32) tables, RS + bitmatrix algebra
+  ops       jit'd device kernels (pallas/XLA) + RSCodec
+  plugins   ErasureCodeInterface / registry (jax_rs, jerasure, isa, shec,
+            lrc, clay, xor + native .so plugins)
+  crush     bit-exact CRUSH: rjenkins hash, straw2, do_rule, compiler,
+            vmapped bulk mapper
+  osdmap    pg->up/acting chain, epochs, incrementals, bulk mapping
+  backend   PGBackend abstraction: ECBackend + ReplicatedBackend, stores
+            (MemStore/FileStore), wire protocol, message bus
+  osd       OSD daemon shell, PrimaryLogPG op engine (snapshots, watch/
+            notify, cls), peering statechart, PG log, dmClock
+  mon/mgr   monitor + Paxos quorum, heartbeats; balancer, autoscaler,
+            prometheus exporter
+  client    Objecter, librados facade (Rados/IoCtx), RadosStriper
+  cluster   MiniCluster (vstart analog) with durable mode
+  tools     crushtool / osdmaptool / rados CLIs
   parallel  device-mesh sharding of codec batches
   bench     ceph_erasure_code_benchmark-compatible CLI
+  utils     deterministic schedule explorer (the race-detection axis)
+
+Quick start:
+    from ceph_tpu import MiniCluster, Rados
+    c = MiniCluster(n_osds=12)
+    c.create_ec_pool("data", {"k": "4", "m": "2"})
+    io = Rados(c).open_ioctx("data")
+    io.write_full("obj", b"hello")
 """
 __version__ = "0.1.0"
+
+
+def __getattr__(name):
+    # lazy top-level conveniences (importing the cluster pulls jax;
+    # keep `import ceph_tpu` light for tooling)
+    if name == "MiniCluster":
+        from .cluster import MiniCluster
+        return MiniCluster
+    if name == "Rados":
+        from .client.rados import Rados
+        return Rados
+    if name == "RadosStriper":
+        from .client.striper import RadosStriper
+        return RadosStriper
+    if name == "ObjectOperation":
+        from .osd.osd_ops import ObjectOperation
+        return ObjectOperation
+    raise AttributeError(name)

@@ -192,3 +192,47 @@ def gf_apply_pallas(mat: jax.Array, data: jax.Array,
         interpret=interpret,
     )(bmat, data)
     return out[:, :n] if n_pad != n else out
+
+
+def _xor_kernel(w_ref, data_ref, out_ref):
+    """Binary-matrix XOR-matmul tile: the shared bit-plane core with the
+    bitmatrix as the operand directly — no coefficient expansion (cf.
+    _gf_kernel); inflation stays in VMEM."""
+    from .rs_kernels import bitplane_xor_matmul
+    out_ref[:] = bitplane_xor_matmul(w_ref[:],
+                                     data_ref[:].astype(jnp.int32))
+
+
+@functools.partial(jax.jit, static_argnames=("tile_n", "interpret"))
+def xor_apply_pallas(W: jax.Array, packets: jax.Array,
+                     tile_n: int = 16384,
+                     interpret: bool = False) -> jax.Array:
+    """Fused packet-layout bitmatrix apply: W [R, K] 0/1, packets [K, P]
+    uint8 -> [R, P].  The data path of the bitmatrix techniques and the
+    wide-word (w=16/32) codes: bit-plane inflation stays in VMEM.  Row
+    counts ride full blocks, so any (R, K) — e.g. liberation's [14, 28]
+    or w=32 reed_sol's [64, 128] — lowers without padding games."""
+    from jax.experimental import pallas as pl
+
+    W = jnp.asarray(W, dtype=jnp.int8)
+    packets = jnp.asarray(packets, dtype=jnp.uint8)
+    r, k = W.shape
+    kk, p = packets.shape
+    assert kk == k
+    n_tiles = max(1, -(-p // tile_n))
+    tile = max(128, (-(-p // n_tiles) + 127) // 128 * 128)
+    p_pad = n_tiles * tile
+    if p_pad != p:
+        packets = jnp.pad(packets, ((0, 0), (0, p_pad - p)))
+    out = pl.pallas_call(
+        _xor_kernel,
+        out_shape=jax.ShapeDtypeStruct((r, p_pad), jnp.uint8),
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec((r, k), lambda i: (0, 0)),
+            pl.BlockSpec((k, tile), lambda i: (0, i)),
+        ],
+        out_specs=pl.BlockSpec((r, tile), lambda i: (0, i)),
+        interpret=interpret,
+    )(W, packets)
+    return out[:, :p] if p_pad != p else out

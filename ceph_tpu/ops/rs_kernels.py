@@ -186,10 +186,11 @@ def gf_apply(mat, data, variant: str = "auto"):
     raise ValueError(f"unknown variant {variant!r}")
 
 
-@jax.jit
-def xor_apply(W, packets):
+def xor_apply(W, packets, variant: str = "auto"):
     """GF(2) XOR-matmul on the MXU: out[r] = XOR over i with W[r,i]==1 of
-    packets[i], bytewise.
+    packets[i], bytewise.  variant: 'pallas' (fused kernel — honoured
+    unconditionally, like gf_apply), 'xla', or 'auto' (pallas on TPU for
+    wide rows, XLA elsewhere).
 
     W: [R, K] 0/1 uint8, packets: [K, P] uint8 -> [R, P] uint8.  The device
     path for bitmatrix codes (liberation/blaum_roth/liber8tion — see
@@ -199,13 +200,31 @@ def xor_apply(W, packets):
     """
     W = jnp.asarray(W, dtype=jnp.int8)
     packets = jnp.asarray(packets, dtype=jnp.uint8)
-    P = packets.shape[1]
+    if variant == "pallas" or (variant == "auto" and _runs_on_tpu(packets)
+                               and packets.shape[1] >= 1024):
+        from .pallas_kernels import xor_apply_pallas
+        return xor_apply_pallas(W, packets)
+    if variant not in ("auto", "xla"):
+        raise ValueError(f"unknown variant {variant!r}")
+    return _xor_apply_xla(W, packets)
+
+
+def bitplane_xor_matmul(W, d):
+    """The shared core: uint8 columns -> 8 bit-planes -> ONE int8 matmul
+    -> mod 2 -> repacked bytes.  Used by the jitted XLA path AND the
+    pallas kernel body (both operate on plain jnp values)."""
+    p = d.shape[1]
     planes = jnp.concatenate(
-        [(packets >> b) & 1 for b in range(8)], axis=1).astype(jnp.int8)
+        [(d >> b) & 1 for b in range(8)], axis=1).astype(jnp.int8)
     acc = jax.lax.dot_general(
         W, planes, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.int32) & 1            # [R, 8P]
-    out = acc[:, :P]
+    out = acc[:, :p]
     for b in range(1, 8):
-        out = out | (acc[:, b * P:(b + 1) * P] << b)
+        out = out | (acc[:, b * p:(b + 1) * p] << b)
     return out.astype(jnp.uint8)
+
+
+@jax.jit
+def _xor_apply_xla(W, packets):
+    return bitplane_xor_matmul(W, packets)

@@ -220,3 +220,16 @@ def test_blaum_roth_plugin_default_w_is_mds(registry):
                                            "k": "4", "packetsize": "8",
                                            "device": "numpy"})
     assert ec.w == 6        # NOT the reference's non-MDS w=7 legacy
+
+
+def test_pallas_xor_apply_matches_host():
+    """The fused pallas bitmatrix kernel (interpret mode on CPU)
+    bit-matches the host XOR apply on awkward shapes."""
+    from ceph_tpu.ops.pallas_kernels import xor_apply_pallas
+    rng = np.random.default_rng(17)
+    for R, K in ((14, 28), (16, 48), (64, 128)):   # liberation/w16/w32
+        W = rng.integers(0, 2, (R, K), dtype=np.uint8)
+        packets = rng.integers(0, 256, (K, 700), dtype=np.uint8)
+        got = np.asarray(xor_apply_pallas(W, packets, tile_n=256,
+                                          interpret=True))
+        assert np.array_equal(got, bm.xor_apply_host(W, packets)), (R, K)
