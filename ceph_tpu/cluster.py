@@ -497,6 +497,17 @@ class MiniCluster:
             for g in p["pgs"].values():
                 g.bus.deliver_all()
 
+    @staticmethod
+    def pg_state(g: PGGroup) -> str:
+        """ONE classification of a PG's serving state, shared by
+        status(), health(), and 'ceph pg dump'."""
+        current = len(g.backend.current_shards())
+        if current < g.backend.min_size:
+            return "inactive"
+        if current < len(g.acting):
+            return "active+degraded"
+        return "active+clean"
+
     def health(self) -> dict:
         """'ceph health detail' shape: HEALTH_OK / HEALTH_WARN /
         HEALTH_ERR with the reference's check keys (OSD_DOWN,
@@ -877,13 +888,7 @@ class MiniCluster:
         for p in self.pools.values():
             for g in p["pgs"].values():
                 n_pgs += 1
-                current = len(g.backend.current_shards())
-                if current < g.backend.min_size:
-                    states["inactive"] += 1
-                elif current < len(g.acting):
-                    states["active+degraded"] += 1
-                else:
-                    states["active+clean"] += 1
+                states[self.pg_state(g)] += 1
         return {
             "osdmap": {"epoch": self.osdmap.epoch,
                        "num_osds": self.osdmap.max_osd,

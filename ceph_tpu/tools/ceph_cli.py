@@ -1,0 +1,142 @@
+"""`ceph` CLI: the admin command surface over a durable cluster.
+
+Analog of the reference's `ceph` tool verbs (reference: src/ceph.in →
+mon/mgr command handlers): `-s`/`status`, `health [detail]`,
+`osd tree` (the CRUSH hierarchy with weights/status, OSDMonitor's
+'osd tree' dump shape), `osd df`, `pg dump` (PGMap's per-PG table:
+state, objects, log version, up/acting), `df`.  Like the rados CLI,
+every invocation reopens the FileStore-backed cluster under
+``--data-dir`` — boot peering and log replay included — so the admin
+view reflects exactly what is durable.
+
+    python -m ceph_tpu.tools.ceph_cli --data-dir D status
+    python -m ceph_tpu.tools.ceph_cli --data-dir D osd tree
+    python -m ceph_tpu.tools.ceph_cli --data-dir D pg dump
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def render_osd_tree(cluster) -> str:
+    """The 'ceph osd tree' table from the live CRUSH map + OSDMap:
+    WEIGHT is the CRUSH weight everywhere (leaves sum to their bucket),
+    REWEIGHT is the osdmap 16.16 override — the reference's two columns."""
+    cmap = cluster.osdmap.crush
+    lines = ["ID    WEIGHT    REWEIGHT  TYPE NAME                 STATUS"]
+    roots = [bid for bid in cmap.buckets
+             if not any(bid in b.items for b in cmap.buckets.values())]
+
+    def walk(item: int, depth: int, crush_w: float) -> None:
+        indent = "    " * depth
+        if item >= 0:
+            st = "up" if cluster.osdmap.is_up(item) else "down"
+            if cluster.osdmap.is_out(item):
+                st += "/out"
+            rw = cluster.osdmap.osd_weight[item] / 0x10000
+            lines.append(f"{item:>4}  {crush_w:8.5f}  {rw:8.5f}  "
+                         f"{indent}osd.{item:<12} {st}")
+            return
+        b = cmap.buckets[item]
+        tname = cmap.type_names.get(b.type, str(b.type))
+        name = cmap.item_names.get(item, f"{tname}-{-item}")
+        weight = sum(b.item_weights) / 0x10000
+        lines.append(f"{item:>4}  {weight:8.5f}  {'-':>8}  "
+                     f"{indent}{tname} {name}")
+        for child, w in zip(b.items, b.item_weights):
+            walk(child, depth + 1, w / 0x10000)
+
+    for root in sorted(roots, reverse=True):
+        walk(root, 0, 0.0)
+    return "\n".join(lines)
+
+
+def render_pg_dump(cluster) -> str:
+    """PGMap's per-PG table (the 'ceph pg dump' brief shape)."""
+    lines = ["PG_ID     STATE             OBJECTS  LOG   UP/ACTING  PRIMARY"]
+    for pid, pool in sorted(cluster.pools.items()):
+        for ps, g in sorted(pool["pgs"].items()):
+            state = cluster.pg_state(g)
+            n_obj = len(g.backend._local_oids())
+            lines.append(
+                f"{pid}.{ps:<7} {state:<17} {n_obj:>7}  "
+                f"{g.backend.pg_log.head:<5} {str(g.acting):<10} "
+                f"{g.backend.whoami}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    # '-s' is the classic status alias; argparse would eat it as an
+    # unknown option before the positional, so translate it up front
+    argv = ["status" if a == "-s" else a
+            for a in (sys.argv[1:] if argv is None else list(argv))]
+    ap = argparse.ArgumentParser(prog="ceph")
+    ap.add_argument("--data-dir", required=True)
+    ap.add_argument("cmd", nargs="+",
+                    help="status | -s | health [detail] | osd tree | "
+                         "osd df | pg dump | df")
+    args = ap.parse_args(argv)
+
+    import os
+    from ..cluster import MiniCluster
+    if not os.path.exists(os.path.join(args.data_dir, "cluster_meta.pkl")):
+        print(f"error: no cluster at {args.data_dir}", file=sys.stderr)
+        return 2
+    c = MiniCluster.load(args.data_dir)
+    try:
+        cmd = " ".join(args.cmd)
+        if cmd in ("status", "-s"):
+            st = c.status()
+            h = c.health()
+            states = ", ".join(f"{n} {s}" for s, n in
+                               sorted(st["pgmap"]["pgs_by_state"].items()))
+            print(f"  cluster:\n    health: {h['status']}\n"
+                  f"  services:\n"
+                  f"    osd: {st['osdmap']['num_osds']} osds: "
+                  f"{st['osdmap']['num_up_osds']} up "
+                  f"(epoch {st['osdmap']['epoch']})\n"
+                  f"  data:\n"
+                  f"    pools:   {st['pgmap']['num_pools']} pools, "
+                  f"{st['pgmap']['num_pgs']} pgs\n"
+                  f"    pgs:     {states}")
+        elif cmd in ("health", "health detail"):
+            h = c.health()
+            print(h["status"])
+            if cmd == "health detail":
+                for key, msg in sorted(h["checks"].items()):
+                    print(f"[{key}] {msg}")
+        elif cmd == "osd tree":
+            print(render_osd_tree(c))
+        elif cmd == "osd df":
+            from ..backend.pg_backend import shard_store
+            for o in range(c.n_osds):
+                n_obj = 0
+                for p in c.pools.values():
+                    for g in p["pgs"].values():
+                        if o not in g.bus.handlers:
+                            continue
+                        n_obj += sum(1 for gobj in
+                                     shard_store(g.bus, o).list_objects()
+                                     if gobj.shard == o)
+                st = "up" if c.osdmap.is_up(o) else "down"
+                print(f"osd.{o:<4} {st:<6} {n_obj} shard objects")
+        elif cmd == "pg dump":
+            print(render_pg_dump(c))
+        elif cmd == "df":
+            st = c.status()
+            for name, pid in sorted(c.pool_ids.items()):
+                n = len(c.objects.get(pid, ()))
+                print(f"pool {name:<12} id {pid}  objects {n}")
+            print(f"total: {st['pgmap']['num_pgs']} pgs on "
+                  f"{st['osdmap']['num_osds']} osds")
+        else:
+            print(f"error: unknown command {cmd!r}", file=sys.stderr)
+            return 2
+        return 0
+    finally:
+        c.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

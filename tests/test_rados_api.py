@@ -235,3 +235,61 @@ class TestHealthAndAio:
         assert not io.rados.objecter.inflight
         assert comp.wait_for_complete() == 0
         assert io.read("ag") == b"v"
+
+
+class TestCephCli:
+    def test_ceph_cli_verbs(self, tmp_path, capsys):
+        from ceph_tpu.tools.ceph_cli import main as ceph_main
+        d = str(tmp_path / "cl")
+        rados_main(["--data-dir", d, "mkpool", "data", "k=2", "m=1",
+                    "device=numpy"])
+        src = tmp_path / "f"
+        src.write_bytes(b"x" * 2000)
+        rados_main(["--data-dir", d, "put", "data", "obj", str(src)])
+        capsys.readouterr()
+
+        assert ceph_main(["--data-dir", d, "status"]) == 0
+        out = capsys.readouterr().out
+        assert "health: HEALTH_OK" in out and "8 pgs" in out
+
+        assert ceph_main(["--data-dir", d, "health"]) == 0
+        assert capsys.readouterr().out.strip() == "HEALTH_OK"
+
+        assert ceph_main(["--data-dir", d, "osd", "tree"]) == 0
+        out = capsys.readouterr().out
+        assert "root default" in out and "osd.0" in out and "host" in out
+        assert out.count("up") >= 9
+
+        assert ceph_main(["--data-dir", d, "pg", "dump"]) == 0
+        out = capsys.readouterr().out
+        assert "active+clean" in out and "1.0" in out
+
+        assert ceph_main(["--data-dir", d, "osd", "df"]) == 0
+        out = capsys.readouterr().out
+        assert "osd.0" in out
+
+        assert ceph_main(["--data-dir", d, "df"]) == 0
+        assert "pool data" in capsys.readouterr().out
+
+        assert ceph_main(["--data-dir", d, "bogus"]) == 2
+
+    def test_ceph_cli_no_cluster(self, tmp_path, capsys):
+        from ceph_tpu.tools.ceph_cli import main as ceph_main
+        assert ceph_main(["--data-dir", str(tmp_path / "none"),
+                          "status"]) == 2
+
+    def test_ceph_cli_s_alias_and_reweight_column(self, tmp_path, capsys):
+        from ceph_tpu.tools.ceph_cli import main as ceph_main
+        d = str(tmp_path / "al")
+        rados_main(["--data-dir", d, "mkpool", "p", "k=2", "m=1",
+                    "device=numpy"])
+        capsys.readouterr()
+        assert ceph_main(["--data-dir", d, "-s"]) == 0
+        assert "health:" in capsys.readouterr().out
+        assert ceph_main(["--data-dir", d, "osd", "tree"]) == 0
+        out = capsys.readouterr().out
+        assert "REWEIGHT" in out
+        # leaf CRUSH weights sum to their host bucket's weight
+        lines = [line for line in out.splitlines() if "osd." in line]
+        w = float(lines[0].split()[1])
+        assert abs(w - 1.0) < 1e-6
