@@ -109,7 +109,7 @@ def main(argv=None) -> int:
         elif cmd == "osd tree":
             print(render_osd_tree(c))
         elif cmd == "osd df":
-            from ..backend.pg_backend import shard_store
+            from ..backend.pg_backend import PG_META, shard_store
             for o in range(c.n_osds):
                 n_obj = 0
                 for p in c.pools.values():
@@ -118,15 +118,20 @@ def main(argv=None) -> int:
                             continue
                         n_obj += sum(1 for gobj in
                                      shard_store(g.bus, o).list_objects()
-                                     if gobj.shard == o)
+                                     if gobj.shard == o
+                                     and gobj.oid != PG_META)
                 st = "up" if c.osdmap.is_up(o) else "down"
                 print(f"osd.{o:<4} {st:<6} {n_obj} shard objects")
         elif cmd == "pg dump":
             print(render_pg_dump(c))
         elif cmd == "df":
+            from ..osd.primary_log_pg import is_clone_oid
             st = c.status()
             for name, pid in sorted(c.pool_ids.items()):
-                n = len(c.objects.get(pid, ()))
+                # user objects only: after a reload the bookkeeping also
+                # carries snapshot clone oids (same filter rados ls uses)
+                n = sum(1 for oid in c.objects.get(pid, ())
+                        if not is_clone_oid(oid))
                 print(f"pool {name:<12} id {pid}  objects {n}")
             print(f"total: {st['pgmap']['num_pgs']} pgs on "
                   f"{st['osdmap']['num_osds']} osds")

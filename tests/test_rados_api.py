@@ -293,3 +293,24 @@ class TestCephCli:
         lines = [line for line in out.splitlines() if "osd." in line]
         w = float(lines[0].split()[1])
         assert abs(w - 1.0) < 1e-6
+
+    def test_ceph_cli_counts_user_objects_only(self, tmp_path, capsys):
+        """osd df excludes _pgmeta_; df excludes snapshot clones
+        (regressions: both inflated the counts)."""
+        from ceph_tpu.tools.ceph_cli import main as ceph_main
+        d = str(tmp_path / "cnt")
+        rados_main(["--data-dir", d, "mkpool", "p", "k=2", "m=1",
+                    "device=numpy"])
+        src = tmp_path / "f"
+        src.write_bytes(b"z" * 1500)
+        rados_main(["--data-dir", d, "put", "p", "obj", str(src)])
+        rados_main(["--data-dir", d, "mksnap", "p", "s"])
+        rados_main(["--data-dir", d, "put", "p", "obj", str(src)])  # COW
+        capsys.readouterr()
+        assert ceph_main(["--data-dir", d, "df"]) == 0
+        assert "objects 1" in capsys.readouterr().out
+        assert ceph_main(["--data-dir", d, "osd", "df"]) == 0
+        out = capsys.readouterr().out
+        # 1 object + 1 clone over k+m=3 shards = 6 shard objects total
+        total = sum(int(line.split()[-3]) for line in out.splitlines())
+        assert total == 6, out
