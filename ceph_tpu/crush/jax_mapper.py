@@ -385,6 +385,7 @@ class BulkMapper:
 
     def map_rule(self, ruleno: int, xs, reweights=None, result_max: int = 0,
                  choose_args: dict | None = None):
+        import jax
         import jax.numpy as jnp
         rule = self.cmap.rules[ruleno]
         steps = rule.steps
@@ -418,10 +419,17 @@ class BulkMapper:
         if reweights is None:
             reweights = np.full(self.cm.max_devices, 0x10000, dtype=np.int64)
         reweights = jnp.asarray(np.asarray(reweights, dtype=np.int64))
-        xs = jnp.asarray(np.asarray(xs, dtype=np.uint32))
+        # tracer-friendly: inside jit/shard_map (the distributed
+        # ParallelPGMapper, parallel/mesh.sharded_placement_step) xs is a
+        # traced array and results stay on device; host callers get numpy
+        traced = isinstance(xs, jax.core.Tracer)
+        xs = (xs.astype(jnp.uint32) if traced
+              else jnp.asarray(np.asarray(xs, dtype=np.uint32)))
         n_pos, ws_arr, ids_arr = self._compile_choose_args(choose_args)
         bulk = self._kernel(kind, root, int(numrep), int(out_size),
                             int(arg2), leaf, int(n_pos))
         out, placed = bulk(xs, reweights, jnp.asarray(ws_arr),
                            jnp.asarray(ids_arr))
+        if traced:
+            return out, placed
         return np.asarray(out), np.asarray(placed)

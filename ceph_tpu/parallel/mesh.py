@@ -135,3 +135,44 @@ def sharded_decode_step(mesh: Mesh):
             chunks = jnp.pad(chunks, ((0, pad), (0, 0)))
         return jitted(D, chunks)
     return step
+
+
+def sharded_placement_step(mesh: Mesh, bulk, ruleno: int, n_osds: int,
+                           reweights=None, result_max: int = 0):
+    """Distributed bulk placement: the multi-chip ParallelPGMapper.
+
+    The reference maps every PG of every pool on a host thread pool
+    (reference: src/osd/OSDMapMapping.h:18 ParallelPGMapper); here the
+    placement-seed vector shards over the ``dp`` axis, every device runs
+    the jitted CRUSH kernel on its block, and the per-OSD utilization
+    histogram — what the mon's mapping job exists to produce — reduces
+    over the ICI ring with ONE psum.  Returns
+    ``step(xs [N]) -> (out [N, numrep] dp-sharded, hist [n_osds]
+    replicated)``.
+    """
+    CRUSH_ITEM_NONE = 0x7FFFFFFF
+
+    def local(xs_blk):
+        out, placed = bulk.map_rule(ruleno, xs_blk,
+                                    reweights=reweights,
+                                    result_max=result_max)
+        # holes are CRUSH_ITEM_NONE (a positive int32): mask them like
+        # every host consumer does, or they corrupt the scatter index
+        valid = (out >= 0) & (out != CRUSH_ITEM_NONE)
+        hist = jnp.zeros((n_osds,), jnp.int32).at[
+            jnp.where(valid, out, 0)].add(valid.astype(jnp.int32))
+        hist = jax.lax.psum(hist, axis_name="dp")     # ICI all-reduce
+        return out, hist
+
+    # Disable the replication/varying-axes checker: the CRUSH kernel's
+    # bounded-retry loops initialise carries from literals (unvarying)
+    # and update them from the dp-varying seeds — sound, but unprovable
+    # for the checker.  The kwarg is check_vma on jax >= 0.8 and
+    # check_rep on the experimental fallback import.
+    import inspect
+    kw = ("check_vma" if "check_vma" in
+          inspect.signature(_shard_map).parameters else "check_rep")
+    return jax.jit(_shard_map(local, mesh=mesh,
+                              in_specs=(P("dp"),),
+                              out_specs=(P("dp"), P(None)),
+                              **{kw: False}))

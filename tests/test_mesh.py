@@ -61,3 +61,65 @@ def test_decode_rejects_mismatched_shapes(mesh):
     with pytest.raises(ValueError):
         step(np.zeros((2, 5), dtype=np.uint8),
              np.zeros((6, 128 * mesh.shape["sp"]), dtype=np.uint8))
+
+
+def test_sharded_placement_step():
+    """Distributed ParallelPGMapper: seeds shard over dp, the per-OSD
+    histogram psums over the ring, outputs bit-match the scalar host
+    interpreter."""
+    import numpy as np
+    from ceph_tpu.crush import (CRUSH_BUCKET_STRAW2,
+                                CRUSH_RULE_CHOOSELEAF_FIRSTN,
+                                CRUSH_RULE_EMIT, CRUSH_RULE_TAKE, CrushMap)
+    from ceph_tpu.crush.jax_mapper import BulkMapper
+    from ceph_tpu.crush.mapper import crush_do_rule
+    from ceph_tpu.parallel.mesh import make_mesh, sharded_placement_step
+
+    cmap = CrushMap()
+    cmap.set_type_name(1, "host")
+    hosts = [cmap.add_bucket(CRUSH_BUCKET_STRAW2, 1, [2 * h, 2 * h + 1],
+                             [0x10000, 0x10000]) for h in range(4)]
+    root = cmap.add_bucket(CRUSH_BUCKET_STRAW2, 2, hosts, [0x20000] * 4)
+    ruleno = cmap.add_rule([(CRUSH_RULE_TAKE, root, 0),
+                            (CRUSH_RULE_CHOOSELEAF_FIRSTN, 3, 1),
+                            (CRUSH_RULE_EMIT, 0, 0)])
+    cmap.finalize()
+    mesh = make_mesh(8)
+    dp = mesh.shape["dp"]
+    pstep = sharded_placement_step(mesh, BulkMapper(cmap), ruleno, 8)
+    xs = np.arange(8 * dp, dtype=np.uint32)
+    out, hist = map(np.asarray, pstep(xs))
+    for x in range(0, len(xs), 7):
+        np.testing.assert_array_equal(out[x],
+                                      crush_do_rule(cmap, ruleno, x, 3))
+    np.testing.assert_array_equal(
+        hist, np.bincount(out[out >= 0].ravel(), minlength=8))
+
+
+def test_sharded_placement_masks_holes():
+    """Placement holes (CRUSH_ITEM_NONE) must not corrupt the histogram
+    (regression: the positive sentinel passed the valid mask)."""
+    import numpy as np
+    from ceph_tpu.crush import (CRUSH_BUCKET_STRAW2,
+                                CRUSH_RULE_CHOOSELEAF_INDEP,
+                                CRUSH_RULE_EMIT, CRUSH_RULE_TAKE, CrushMap)
+    from ceph_tpu.crush.jax_mapper import BulkMapper
+    from ceph_tpu.parallel.mesh import make_mesh, sharded_placement_step
+
+    # ask INDEP for 3 leaves from only 2 hosts: position 3 stays a hole
+    cmap = CrushMap()
+    cmap.set_type_name(1, "host")
+    hosts = [cmap.add_bucket(CRUSH_BUCKET_STRAW2, 1, [2 * h, 2 * h + 1],
+                             [0x10000, 0x10000]) for h in range(2)]
+    root = cmap.add_bucket(CRUSH_BUCKET_STRAW2, 2, hosts, [0x20000] * 2)
+    ruleno = cmap.add_rule([(CRUSH_RULE_TAKE, root, 0),
+                            (CRUSH_RULE_CHOOSELEAF_INDEP, 3, 1),
+                            (CRUSH_RULE_EMIT, 0, 0)])
+    cmap.finalize()
+    mesh = make_mesh(8)
+    pstep = sharded_placement_step(mesh, BulkMapper(cmap), ruleno, 4)
+    xs = np.arange(8 * mesh.shape["dp"], dtype=np.uint32)
+    out, hist = map(np.asarray, pstep(xs))
+    assert (out == 0x7FFFFFFF).any()          # holes really occurred
+    valid = out[(out >= 0) & (out != 0x7FFFFFFF)]
+    np.testing.assert_array_equal(hist, np.bincount(valid, minlength=4))
