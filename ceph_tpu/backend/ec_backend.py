@@ -221,12 +221,19 @@ class ECBackend(PGBackend):
             objop = op.plan.t.ops[oid]
             if objop.clone_to:
                 # snapshot COW: clone the PRE-op shard chunks (+ attrs,
-                # incl. hinfo — a chunk-wise clone is exact for EC)
+                # incl. hinfo — a chunk-wise clone is exact for EC).
+                # Each clone gets its OWN log entry: a shard that missed
+                # this transaction must replay the clone too, or log
+                # repair would resurrect the head and silently drop the
+                # snapshot state (observed: revived shards lost clones).
                 for shard in self.acting:
                     src = GObject(oid, shard)
                     for clone_oid in objop.clone_to:
                         shard_txns[shard].clone(src, GObject(clone_oid,
                                                              shard))
+                for clone_oid in objop.clone_to:
+                    log_entries.append(self.pg_log.append(clone_oid,
+                                                          OP_MODIFY))
             if objop.rollback_from is not None:
                 # replace head wholesale with the clone's shard state;
                 # the cached head hinfo is now stale — the cloned attrs
@@ -619,8 +626,14 @@ class ECBackend(PGBackend):
 
     def be_deep_scrub(self, oid: str) -> dict[int, bool]:
         """Recompute each up shard's cumulative crc vs its stored HashInfo;
-        True = clean."""
+        True = clean.  When overwrites have CLEARED the chunk hashes, fall
+        back to parity-consistency checking: the code itself is the
+        checksum (m redundant equations over the chunks), so silent bitrot
+        is still detectable — and with a leave-one-out scan, locatable —
+        without any stored digest."""
         out: dict[int, bool] = {}
+        chunks_read: dict[int, bytes] = {}
+        hash_cleared = False
         for chunk, shard in enumerate(self.acting):
             if shard in self.bus.down:
                 continue
@@ -640,11 +653,59 @@ class ECBackend(PGBackend):
                 continue
             hashes = stored.get("cumulative_shard_hashes") or []
             if not hashes:
-                out[chunk] = True  # hash cleared by overwrite; version matched
+                hash_cleared = True
+                chunks_read[chunk] = data
+                out[chunk] = True          # provisional; parity check below
                 continue
             out[chunk] = crc32c(0xFFFFFFFF, data) == hashes[chunk] and \
                 len(data) == stored["total_chunk_size"]
+        k = self.ec_impl.get_data_chunk_count()
+        if hash_cleared and len(chunks_read) > k:
+            # any spare equation suffices for DETECTION, even degraded
+            self._parity_consistency_scrub(oid, chunks_read, out)
         return out
+
+    def _parity_consistency_scrub(self, oid: str,
+                                  chunks: dict[int, bytes],
+                                  out: dict[int, bool]) -> None:
+        """No stored digests (overwrites cleared them): the CODE is the
+        checksum.  A chunk set with > k members is consistent iff every
+        member is reproducible from k of the others; on inconsistency,
+        leave-one-out localisation accepts a candidate only when it is
+        UNIQUE (single rot with m >= 2).  Ambiguous rot — m=1, multi-chunk,
+        or too-degraded-to-localise — flags every scanned chunk so the
+        report surfaces it; repair skips such unrecoverable sets."""
+        k = self.ec_impl.get_data_chunk_count()
+        length = max(len(b) for b in chunks.values())
+        stack = {c: np.frombuffer(b.ljust(length, b"\0"), dtype=np.uint8)
+                 for c, b in chunks.items()}
+
+        def consistent(ids) -> bool:
+            ids = sorted(ids)
+            if len(ids) <= k:
+                return True          # no redundancy: vacuously consistent
+            for target in ids:
+                others = {i: stack[i] for i in ids if i != target}
+                try:
+                    rec = self.ec_impl.decode({target}, others, length)
+                except Exception:
+                    return False
+                if not np.array_equal(
+                        np.asarray(rec[target], dtype=np.uint8),
+                        stack[target]):
+                    return False
+            return True
+
+        present = sorted(stack)
+        if consistent(present):
+            return
+        cands = [c for c in present
+                 if consistent([i for i in present if i != c])]
+        if len(cands) == 1:
+            out[cands[0]] = False
+        else:
+            for c in present:        # detected but unlocatable
+                out[c] = False
 
 
 def make_cluster(ec_impl, chunk_size: int = 4096, cct=None):

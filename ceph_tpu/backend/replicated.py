@@ -78,6 +78,11 @@ class ReplicatedBackend(PGBackend):
             entry = self.pg_log.append(
                 oid, OP_DELETE if is_delete else OP_MODIFY)
             log_entries.append(entry)
+            for clone_oid in objop.clone_to:
+                # clones replay independently on log repair (see the EC
+                # backend's clone_to note)
+                log_entries.append(self.pg_log.append(clone_oid,
+                                                      OP_MODIFY))
             for shard in self.acting:
                 obj = GObject(oid, shard)
                 t = shard_txns[shard]

@@ -558,6 +558,7 @@ class MiniCluster:
                                 if gobj.shard == shard
                                 and gobj.oid != PG_META)
                 bad: dict[str, list[int]] = {}
+                scanned: dict[str, int] = {}
                 for oid in sorted(oids):
                     try:
                         per_shard = g.backend.be_deep_scrub(oid)
@@ -574,14 +575,21 @@ class MiniCluster:
                     bads = sorted(s for s, ok in per_shard.items() if not ok)
                     if bads:
                         bad[oid] = bads
+                        scanned[oid] = len(per_shard)
                 if bad:
                     report[repr(g.pgid)] = bad
                     if repair:
                         # object-level recovery, not log repair: scrub
                         # finds BITROT, which the logs cannot see — the
                         # bad chunks reconstruct from healthy shards and
-                        # re-push (be_deep_scrub keys by chunk index)
+                        # re-push (be_deep_scrub keys by chunk index).
+                        # An UNRECOVERABLE set (every scanned chunk
+                        # flagged: ambiguous/multi-chunk rot) stays in
+                        # the report — recovery with zero healthy
+                        # sources would just park a dead op forever.
                         for oid, chunks in sorted(bad.items()):
+                            if len(chunks) >= scanned[oid]:
+                                continue
                             g.backend.recover_object(oid, set(chunks))
                         g.bus.deliver_all()
             daemon.queue_background(g.pgid, scrub, op_class=BG_SCRUB)

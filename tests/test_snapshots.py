@@ -292,3 +292,39 @@ def test_backfill_preserves_clones():
     r = c.operate(pid, "snapped", ObjectOperation().read(0, 0), snapid=s1)
     assert r.outdata(0)[:800] == v1      # clone survived the move
     c.shutdown()
+
+
+def test_cow_survives_shard_death_via_log_repair():
+    """A COW committed while a shard was down must reach that shard on
+    revival through LOG repair — clones have their own log entries
+    (regression: repair replayed only the head and the revived shard
+    lost the clone forever; found by the soak campaign)."""
+    from ceph_tpu.backend.memstore import GObject
+    from ceph_tpu.backend.pg_backend import shard_store
+    from ceph_tpu.osd.primary_log_pg import clone_oid
+    c = MiniCluster(n_osds=8, osds_per_host=2, chunk_size=512)
+    pid = c.create_ec_pool("p", {"k": "2", "m": "2", "device": "numpy"},
+                           pg_num=4)
+    v1 = _data(1400, 40)
+    c.operate(pid, "cowd", ObjectOperation().write_full(v1))
+    g = c.pg_group(pid, "cowd")
+    victim = next(o for o in g.acting if o != g.backend.whoami)
+    g.bus.mark_down(victim)
+    s1 = c.create_pool_snap(pid, "s")
+    c.operate(pid, "cowd", ObjectOperation().write_full(b"n" * 1000))
+    g.bus.mark_up(victim)
+    g.bus.deliver_all()
+    cl = clone_oid("cowd", s1)
+    assert shard_store(g.bus, victim).exists(GObject(cl, victim)), \
+        "revived shard missing the clone"
+    # the snap reads clean even with OTHER shards down (needs the
+    # revived shard's clone chunk)
+    others = [o for o in g.acting if o not in (victim, g.backend.whoami)]
+    g.bus.mark_down(others[0])
+    try:
+        r = c.operate(pid, "cowd", ObjectOperation().read(0, 0), snapid=s1)
+        assert r.outdata(0)[:1400] == v1
+    finally:
+        g.bus.mark_up(others[0])
+    c.remove_pool_snap(pid, "s")
+    c.shutdown()
