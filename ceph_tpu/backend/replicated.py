@@ -229,13 +229,15 @@ class ReplicatedBackend(PGBackend):
     # -- deep scrub ----------------------------------------------------------
 
     def be_deep_scrub(self, oid: str) -> dict[int, bool]:
-        """Compare every up replica's bytes and version against the
-        primary's copy (the authority); True = clean."""
-        try:
-            want = self.local_shard.store.read(GObject(oid, self.whoami))
-            want_v = self._object_version(oid)
-        except FileNotFoundError:
-            want, want_v = None, None
+        """MAJORITY-vote scrub: replicas group by (bytes, version); the
+        largest group is the authority and the minority is flagged.
+        Trusting the primary's copy blindly would MISLOCATE rot on the
+        primary itself — flagging every healthy replica and letting a
+        repair push the rotten copy over them (the reference's scrub
+        likewise compares maps across replicas and picks an
+        authoritative object, PG::scrub_compare_maps).  A tie (e.g.
+        size 2) flags everyone: detected, honestly unlocatable."""
+        copies: dict[int, tuple] = {}
         out: dict[int, bool] = {}
         for chunk, shard in enumerate(self.acting):
             if shard in self.bus.down:
@@ -243,13 +245,21 @@ class ReplicatedBackend(PGBackend):
             store = shard_store(self.bus, shard)
             obj = GObject(oid, shard)
             try:
-                data = store.read(obj)
-                version = store.getattr(obj, VERSION_KEY)
+                copies[chunk] = (bytes(store.read(obj)),
+                                 store.getattr(obj, VERSION_KEY))
             except (FileNotFoundError, KeyError):
-                out[chunk] = want is None
-                continue
-            out[chunk] = (want is not None and data == want
-                          and version == want_v)
+                copies[chunk] = None
+        groups: dict = {}
+        for chunk, ident in copies.items():
+            groups.setdefault(ident, []).append(chunk)
+        best = max(groups.values(), key=len)
+        if len(groups) > 1 and \
+                sum(1 for g in groups.values() if len(g) == len(best)) > 1:
+            return {c: False for c in copies}      # tie: flag everything
+        authority = next(ident for ident, cs in groups.items()
+                         if cs is best)
+        for chunk, ident in copies.items():
+            out[chunk] = ident == authority and ident is not None
         return out
 
 

@@ -255,3 +255,68 @@ class TestReplicatedThrash:
             g = cluster.pg_group(pid, oid)
             report = g.backend.be_deep_scrub(oid)
             assert all(report.values()), f"{oid}: dirty replicas {report}"
+
+
+class TestMajorityScrub:
+    """Majority-vote deep scrub (regression: the primary's copy was
+    blind authority — rot ON the primary flagged every healthy replica
+    and repair would have pushed the rotten copy over them)."""
+
+    def _cluster(self):
+        from ceph_tpu.cluster import MiniCluster
+        c = MiniCluster(n_osds=9, osds_per_host=3, chunk_size=512)
+        pid = c.create_replicated_pool("r", size=3, pg_num=4)
+        return c, pid
+
+    def test_primary_rot_located_and_repaired(self):
+        import numpy as np
+        from ceph_tpu.backend.memstore import GObject
+        from ceph_tpu.backend.pg_backend import shard_store
+        from ceph_tpu.osd.osd_ops import ObjectOperation
+        c, pid = self._cluster()
+        payload = np.random.default_rng(50).integers(
+            0, 256, 2000, np.uint8).tobytes()
+        c.operate(pid, "pr", ObjectOperation().write_full(payload))
+        g = c.pg_group(pid, "pr")
+        primary = g.backend.whoami
+        st = shard_store(g.bus, primary)
+        st.objects[GObject("pr", primary)].data[7] ^= 0xAA
+        report = c.scrub_pool(pid, repair=True)
+        [bad] = [b["pr"] for b in report.values() if "pr" in b]
+        assert bad == [0], f"mislocated: {report}"     # the PRIMARY
+        assert c.scrub_pool(pid) == {}
+        assert c.operate(pid, "pr", ObjectOperation()
+                         .read(0, 0)).outdata(0)[:2000] == payload
+        c.shutdown()
+
+    def test_replica_rot_still_located(self):
+        from ceph_tpu.backend.memstore import GObject
+        from ceph_tpu.backend.pg_backend import shard_store
+        from ceph_tpu.osd.osd_ops import ObjectOperation
+        c, pid = self._cluster()
+        c.operate(pid, "rr", ObjectOperation().write_full(b"q" * 1500))
+        g = c.pg_group(pid, "rr")
+        replica = g.acting[2]
+        shard_store(g.bus, replica).objects[
+            GObject("rr", replica)].data[0] ^= 0x11
+        report = c.scrub_pool(pid, repair=True)
+        [bad] = [b["rr"] for b in report.values() if "rr" in b]
+        assert bad == [2]
+        assert c.scrub_pool(pid) == {}
+        c.shutdown()
+
+    def test_two_way_tie_flags_all(self):
+        from ceph_tpu.backend.memstore import GObject
+        from ceph_tpu.backend.pg_backend import shard_store
+        from ceph_tpu.cluster import MiniCluster
+        from ceph_tpu.osd.osd_ops import ObjectOperation
+        c = MiniCluster(n_osds=8, osds_per_host=2, chunk_size=512)
+        pid = c.create_replicated_pool("r2", size=2, pg_num=4)
+        c.operate(pid, "tie", ObjectOperation().write_full(b"t" * 900))
+        g = c.pg_group(pid, "tie")
+        shard_store(g.bus, g.acting[1]).objects[
+            GObject("tie", g.acting[1])].data[0] ^= 1
+        report = c.scrub_pool(pid, repair=False)
+        [bad] = [b["tie"] for b in report.values() if "tie" in b]
+        assert bad == [0, 1]          # detected, honestly unlocatable
+        c.shutdown()
