@@ -28,8 +28,9 @@ N_OSDS = 12
 STEPS = 300
 
 
+@pytest.mark.parametrize("pool_type", ["ec", "rep"])
 @pytest.mark.parametrize("seed", [1, 7, 20260730])
-def test_soak_campaign(seed):
+def test_soak_campaign(seed, pool_type):
     rng = random.Random(seed)
     drng = np.random.default_rng(seed)
 
@@ -44,8 +45,11 @@ def test_soak_campaign(seed):
         cct = Context(overrides={"mon_osd_down_out_interval": 10_000})
         c = MiniCluster(n_osds=N_OSDS, osds_per_host=3, chunk_size=512,
                         cct=cct)
-        pid = c.create_ec_pool("soak", {"k": str(K), "m": str(M),
-                                        "device": "numpy"}, pg_num=8)
+        if pool_type == "ec":
+            pid = c.create_ec_pool("soak", {"k": str(K), "m": str(M),
+                                            "device": "numpy"}, pg_num=8)
+        else:
+            pid = c.create_replicated_pool("soak", size=3, pg_num=8)
         mon = c.attach_monitor()
 
         oids = [f"obj{i}" for i in range(10)]
@@ -72,8 +76,8 @@ def test_soak_campaign(seed):
         for step in range(STEPS):
             action = rng.choices(
                 ["write", "read", "snap", "snapread", "kill", "revive",
-                 "scrub", "rot", "delete"],
-                weights=[30, 20, 5, 10, 10, 12, 5, 3, 5])[0]
+                 "scrub", "rot", "delete", "omap"],
+                weights=[30, 20, 5, 10, 10, 12, 5, 3, 5, 5])[0]
             oid = rng.choice(oids)
             try:
                 if action == "write":
@@ -142,6 +146,12 @@ def test_soak_campaign(seed):
                     c.operate(pid, oid, ObjectOperation().remove())
                     del model[oid]
                     del attrs[oid]
+                elif action == "omap" and pool_type == "rep":
+                    c.operate(pid, oid, ObjectOperation().omap_set(
+                        {f"k{step}": f"v{step}".encode()}))
+                    r = c.operate(pid, oid, ObjectOperation()
+                                  .omap_get_vals_by_keys([f"k{step}"]))
+                    assert r.outdata(0) == {f"k{step}": f"v{step}".encode()}
             except BlockedWriteError:
                 # inactive PG: revive everything so the parked op commits,
                 # then the model write IS durable
