@@ -610,7 +610,7 @@ class ECBackend(PGBackend):
                 sub_chunk_count=self.ec_impl.get_sub_chunk_count()))
 
     def _recovery_push_payloads(self, rop: RecoveryOp
-                                ) -> dict[int, tuple[bytes, dict]]:
+                                ) -> dict[int, tuple]:
         # reconstruct the missing chunks; chunk_size tells sub-chunk codes
         # (clay) the helpers are fractional
         available = {c: np.frombuffer(v, dtype=np.uint8)
@@ -619,7 +619,8 @@ class ECBackend(PGBackend):
         rec = decode_shards(self.sinfo, self.ec_impl, available,
                             rop.missing_shards,
                             chunk_size=hinfo.get_total_chunk_size())
-        return {chunk: (bytes(rec[chunk]), {HINFO_KEY: hinfo.to_dict()})
+        return {chunk: (bytes(rec[chunk]), {HINFO_KEY: hinfo.to_dict()},
+                        None, b"")
                 for chunk in rop.missing_shards}
 
     # -- deep scrub (ECBackend.cc:2461-2546) -------------------------------

@@ -80,6 +80,7 @@ class ECSubRead:
     # oid -> list of (chunk-space offset, length, subchunk_runs|None)
     to_read: dict[str, list[tuple]] = field(default_factory=dict)
     attrs_to_read: set[str] = field(default_factory=set)
+    include_omap: bool = False     # replicated recovery moves omap too
     # denominator for subchunk_runs (codec's get_sub_chunk_count(); the
     # reference ships it inside the run offsets, ECMsgTypes.h:105-116)
     sub_chunk_count: int = 1
@@ -92,6 +93,8 @@ class ECSubReadReply:
     tid: int
     buffers_read: dict[str, list[tuple[int, bytes]]] = field(default_factory=dict)
     attrs_read: dict[str, dict] = field(default_factory=dict)
+    # oid -> (omap kvs, omap header) when include_omap was set
+    omap_read: dict[str, tuple] = field(default_factory=dict)
     errors: dict[str, int] = field(default_factory=dict)
 
 
@@ -104,6 +107,9 @@ class PushOp:
     data: bytes
     attrs: dict = field(default_factory=dict)
     version: int = 0
+    # None = leave omap alone (EC chunks have none); dict = replace
+    omap: dict | None = None
+    omap_header: bytes = b""
 
 
 @dataclass

@@ -26,7 +26,9 @@ Subclass hooks (see :class:`~ceph_tpu.backend.ec_backend.ECBackend` and
 ``_op_blocked(op)``     ordering block against in-flight overlapping writes
 ``_generate_transactions(op)``  per-shard transactions + pg_log entries
 ``_recovery_issue_reads(rop)``  start the READING phase (may raise IOError)
-``_recovery_push_payloads(rop)``  chunk -> (bytes, attrs) to push
+``_recovery_push_payloads(rop)``  chunk -> (bytes, attrs, omap|None,
+                                  omap_header) to push (omap None =
+                                  target keeps its own)
 ``_handle_other_read_reply(r)``  non-recovery ECSubReadReply routing
 ``object_size(oid)``    logical object size
 ``be_deep_scrub(oid)``  per-shard consistency check
@@ -291,15 +293,31 @@ class OSDShard:
                             a: self.store.getattr(obj, a)
                             for a in msg.attrs_to_read
                             if a in self.store.objects[obj].xattrs}
+                    if msg.include_omap:
+                        reply.omap_read[oid] = (
+                            self.store.get_omap(obj),
+                            self.store.get_omap_header(obj))
                 except FileNotFoundError:
                     reply.errors[oid] = -2  # ENOENT
             self.bus.send(msg.from_shard, reply)
         elif isinstance(msg, PushOp):
             t = Transaction()
             obj = GObject(msg.oid, self.shard)
+            # the remove wipes everything, so omap=None ("leave alone")
+            # must re-apply the PRE-push omap to honour its contract
+            if msg.omap is not None:
+                keep_omap, keep_header = dict(msg.omap), msg.omap_header
+            elif self.store.exists(obj):
+                keep_omap = self.store.get_omap(obj)
+                keep_header = self.store.get_omap_header(obj)
+            else:
+                keep_omap, keep_header = {}, b""
             t.remove(obj).write(obj, 0, msg.data)
             for name, value in msg.attrs.items():
                 t.setattr(obj, name, value)
+            if keep_omap or keep_header:
+                t.omap_setkeys(obj, keep_omap)
+                t.omap_setheader(obj, keep_header)
             self.store.queue_transaction(t)
             self.bus.send(msg.from_shard, PushReply(self.shard, msg.oid))
         else:
@@ -524,7 +542,7 @@ class PGBackend:
         raise NotImplementedError
 
     def _recovery_push_payloads(self, rop: RecoveryOp
-                                ) -> dict[int, tuple[bytes, dict]]:
+                                ) -> dict[int, tuple[bytes, dict, dict | None, bytes]]:
         raise NotImplementedError
 
     def _handle_other_read_reply(self, reply: ECSubReadReply) -> None:
@@ -911,6 +929,7 @@ class PGBackend:
             rop.at_version = self.pg_log.last_version_of(rop.oid)
             rop._read_results = {}
             rop._read_attrs = {}
+            rop._read_omap = {}            # chunk -> (omap kvs, header)
             self._recovery_issue_reads(rop)   # may raise IOError (parked)
             rop.state = RecoveryState.READING
             self._recovery_read_tids[rop.read_tid] = rop
@@ -932,6 +951,8 @@ class PGBackend:
             rop._read_results[chunk] = b"".join(b for _, b in bufs)
         for oid, attrs in reply.attrs_read.items():
             rop._read_attrs[chunk] = attrs
+        for oid, om in reply.omap_read.items():
+            rop._read_omap[chunk] = om     # keyed like _read_results
         rop._pending.discard(reply.from_shard)
         if rop._pending:
             return
@@ -955,10 +976,11 @@ class PGBackend:
                 # on_shard_down fails an already-sent push (_failed_push)
                 rop.failed = True
                 continue
-            data, attrs = payloads[chunk]
+            data, attrs, omap, header = payloads[chunk]
             rop.pending_pushes.add(shard)
             self.bus.send(shard, PushOp(self.whoami, rop.oid, data,
-                                        attrs=attrs))
+                                        attrs=attrs, omap=omap,
+                                        omap_header=header))
         if not rop.pending_pushes:
             self._finish_recovery_op(rop, failed=rop.failed)
 

@@ -219,12 +219,15 @@ class ReplicatedBackend(PGBackend):
         rop._pending = {src_shard}
         self.bus.send(src_shard, ECSubRead(
             self.whoami, rop.read_tid,
-            {rop.oid: [(0, None)]}, attrs_to_read={VERSION_KEY}))
+            {rop.oid: [(0, None)]}, attrs_to_read={VERSION_KEY},
+            include_omap=True))
 
     def _recovery_push_payloads(self, rop: RecoveryOp):
         (data,) = rop._read_results.values()
         attrs = next(iter(rop._read_attrs.values()), {}) or {}
-        return {chunk: (data, dict(attrs)) for chunk in rop.missing_shards}
+        omap, header = next(iter(rop._read_omap.values()), ({}, b""))
+        return {chunk: (data, dict(attrs), dict(omap), header)
+                for chunk in rop.missing_shards}
 
     # -- deep scrub ----------------------------------------------------------
 
@@ -245,8 +248,12 @@ class ReplicatedBackend(PGBackend):
             store = shard_store(self.bus, shard)
             obj = GObject(oid, shard)
             try:
+                # identity covers omap too: replicated pools serve omap
+                # reads, so a diverged omap is user-visible corruption
                 copies[chunk] = (bytes(store.read(obj)),
-                                 store.getattr(obj, VERSION_KEY))
+                                 store.getattr(obj, VERSION_KEY),
+                                 tuple(sorted(store.get_omap(obj).items())),
+                                 store.get_omap_header(obj))
             except (FileNotFoundError, KeyError):
                 copies[chunk] = None
         groups: dict = {}

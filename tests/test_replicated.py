@@ -320,3 +320,26 @@ class TestMajorityScrub:
         [bad] = [b["tie"] for b in report.values() if "tie" in b]
         assert bad == [0, 1]          # detected, honestly unlocatable
         c.shutdown()
+
+    def test_omap_divergence_detected_and_repaired(self):
+        """Scrub's vote covers omap, and recovery pushes omap+header with
+        the data (regression: detection was data/version-only and the
+        push would have dropped the omap)."""
+        from ceph_tpu.backend.memstore import GObject
+        from ceph_tpu.backend.pg_backend import shard_store
+        from ceph_tpu.osd.osd_ops import ObjectOperation
+        c, pid = self._cluster()
+        c.operate(pid, "om", ObjectOperation().write_full(b"body")
+                  .omap_set({"idx": b"7"}).omap_set_header(b"H"))
+        g = c.pg_group(pid, "om")
+        replica = g.acting[1]
+        st = shard_store(g.bus, replica)
+        st.objects[GObject("om", replica)].omap["idx"] = b"CORRUPT"
+        report = c.scrub_pool(pid, repair=True)
+        [bad] = [b["om"] for b in report.values() if "om" in b]
+        assert bad == [1], report
+        assert c.scrub_pool(pid) == {}
+        # the repaired replica carries the correct omap AND header
+        assert st.get_omap(GObject("om", replica)) == {"idx": b"7"}
+        assert st.get_omap_header(GObject("om", replica)) == b"H"
+        c.shutdown()
