@@ -610,7 +610,8 @@ class ECBackend(PGBackend):
                 sub_chunk_count=self.ec_impl.get_sub_chunk_count()))
 
     def _recovery_push_payloads(self, rop: RecoveryOp
-                                ) -> dict[int, tuple]:
+                                ) -> dict[
+            int, tuple[bytes, dict, dict | None, bytes]]:
         # reconstruct the missing chunks; chunk_size tells sub-chunk codes
         # (clay) the helpers are fractional
         available = {c: np.frombuffer(v, dtype=np.uint8)

@@ -951,8 +951,8 @@ class PGBackend:
             rop._read_results[chunk] = b"".join(b for _, b in bufs)
         for oid, attrs in reply.attrs_read.items():
             rop._read_attrs[chunk] = attrs
-        for oid, om in reply.omap_read.items():
-            rop._read_omap[chunk] = om     # keyed like _read_results
+        if rop.oid in reply.omap_read:     # recovery reads ONE oid
+            rop._read_omap[chunk] = reply.omap_read[rop.oid]
         rop._pending.discard(reply.from_shard)
         if rop._pending:
             return

@@ -85,10 +85,28 @@ class PGGroup:
         # the peering statechart (acting-set negotiation on map changes)
         from .osd.peering import PeeringCoordinator
         self.peering = PeeringCoordinator(self.backend)
+        # admin-socket observability for the PG-level subsystems
+        # (the reference's 'dump_watchers' and pg-state query commands)
+        name = self.backend.instance_name
+        for cmd, fn in (
+                (f"dump_watchers.{name}",
+                 lambda **kw: {oid: sorted(ws) for oid, ws in
+                               self.engine.watchers.items() if ws}),
+                (f"peering_history.{name}",
+                 lambda **kw: {"state": self.peering.state.value,
+                               "last_epoch_started":
+                                   self.peering.last_epoch_started,
+                               "history": list(self.peering.history)})):
+            # names are unique (cluster-id + epoch salted), so a duplicate
+            # registration is a LIFECYCLE BUG — let the guard raise
+            cct.admin_socket.register(cmd, fn)
 
     def shutdown(self, discard_stores: bool = False) -> None:
         # closes the primary's store too; discard skips the final
         # checkpoint when the directories are about to be deleted
+        name = self.backend.instance_name
+        for cmd in (f"dump_watchers.{name}", f"peering_history.{name}"):
+            self.backend.cct.admin_socket.unregister(cmd)
         self.backend.shutdown(checkpoint_store=not discard_stores)
         for h in self.bus.handlers.values():
             if isinstance(h, OSDShard) and h is not self.backend.local_shard \

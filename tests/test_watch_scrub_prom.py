@@ -300,3 +300,21 @@ class TestParityConsistencyScrub:
         finally:
             g.bus.mark_up(down)
         c.shutdown()
+
+
+def test_admin_socket_pg_commands(cluster):
+    """dump_watchers + peering_history over the admin socket (the
+    reference's daemon observability commands)."""
+    c, pid = cluster
+    c.operate(pid, "aw", ObjectOperation().write_full(b"x"))
+    c.operate(pid, "aw", ObjectOperation().watch(3, lambda n, ck, p: b""))
+    g = c.pg_group(pid, "aw")
+    name = g.backend.instance_name
+    ws = c.cct.admin_socket.call(f"dump_watchers.{name}")
+    assert ws == {"aw": [3]}
+    g.peering.advance_map(epoch=31)
+    g.bus.deliver_all()
+    hist = c.cct.admin_socket.call(f"peering_history.{name}")
+    assert hist["state"].endswith("Active")
+    assert hist["last_epoch_started"] == 31
+    assert any(s.endswith("GetInfo") for _, s in hist["history"])
