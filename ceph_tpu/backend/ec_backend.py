@@ -280,6 +280,16 @@ class ECBackend(PGBackend):
                 # (PrimaryLogPG rejects with -EOPNOTSUPP before it gets
                 # here; this is the backend's own guard)
                 raise ValueError("EC pools do not support omap operations")
+            wholesale = objop.delete_first or (
+                objop.truncate is not None and any(
+                    off == 0 and len(d) >= objop.truncate[0]
+                    for off, d in objop.buffer_updates))
+            if wholesale:
+                # WHOLESALE replacement re-derives every chunk from fresh
+                # data: a damaged object is exonerated (operator restore).
+                # A partial truncate+write is NOT enough — chunks below
+                # the boundary could still hold laundered rot.
+                self.inconsistent_objects.discard(oid)
             if objop.attr_updates and not is_delete:
                 # object attrs replicate to every shard (the reference
                 # stores xattrs on each shard's ghobject, PGTransaction.h).
@@ -597,8 +607,20 @@ class ECBackend(PGBackend):
         minimum = self.ec_impl.minimum_to_decode(rop.missing_shards, avail)
         hinfo = self._hinfo(rop.oid)
         c_len = hinfo.get_total_chunk_size()
+        # VERIFIED recovery: when the hinfo hashes are gone (overwrites
+        # clear them) the reconstruction sources cannot be crc-checked —
+        # a silently rotten source would bake its rot into the rebuilt
+        # chunk and the new parity would make the corruption
+        # SELF-CONSISTENT (observed via the soak: repair of a revived
+        # shard laundered bitrot past every later scrub).  Reading every
+        # available full chunk restores the spare equations, and the
+        # payload step cross-checks before pushing.
+        verify = (not hinfo.has_chunk_hash() and len(avail) > len(minimum)
+                  and self.ec_impl.get_sub_chunk_count() == 1)
+        want = ({c: [(0, self.ec_impl.get_sub_chunk_count())]
+                 for c in sorted(avail)} if verify else minimum)
         per_shard = {}
-        for chunk, subchunks in minimum.items():
+        for chunk, subchunks in want.items():
             shard = self.acting[chunk]
             runs = None if subchunks == [(0, self.ec_impl.get_sub_chunk_count())] \
                 else subchunks
@@ -617,11 +639,42 @@ class ECBackend(PGBackend):
         available = {c: np.frombuffer(v, dtype=np.uint8)
                      for c, v in rop._read_results.items()}
         hinfo = self._hinfo(rop.oid)
+        k = self.ec_impl.get_data_chunk_count()
+        if not hinfo.has_chunk_hash() and len(available) > k \
+                and self.ec_impl.get_sub_chunk_count() == 1:
+            # verified recovery (see _recovery_issue_reads): cross-check
+            # the sources with the spare equations and DROP a located
+            # rotten source instead of baking it into the rebuilt chunk
+            out_map = {c: True for c in available}
+            self._parity_consistency_scrub(
+                rop.oid, {c: v.tobytes() for c, v in available.items()},
+                out_map)
+            bad = [c for c, ok in out_map.items() if not ok]
+            if len(bad) == 1 and len(available) - 1 >= k:
+                rop.missing_shards = set(rop.missing_shards) | set(bad)
+                del available[bad[0]]
+            elif bad:
+                # inconsistent but unlocatable (one spare equation can
+                # DETECT rot, never place it): the rebuild may launder
+                # corruption — record the object as damaged
+                self.inconsistent_objects.add(rop.oid)
         rec = decode_shards(self.sinfo, self.ec_impl, available,
                             rop.missing_shards,
                             chunk_size=hinfo.get_total_chunk_size())
-        return {chunk: (bytes(rec[chunk]), {HINFO_KEY: hinfo.to_dict()},
-                        None, b"")
+        # pushes REPLACE the target object, so the replicated attrs
+        # (user xattrs, object_info, snapset — identical on every shard)
+        # must travel too, from the primary's authoritative copy;
+        # without them, repairing a located rotten source would WIPE the
+        # xattrs that shard held correctly
+        attrs = {HINFO_KEY: hinfo.to_dict()}
+        try:
+            base = self.local_shard.store.getattrs(
+                GObject(rop.oid, self.whoami))
+            attrs = {**{a: v for a, v in base.items() if a != HINFO_KEY},
+                     **attrs}
+        except FileNotFoundError:
+            pass
+        return {chunk: (bytes(rec[chunk]), dict(attrs), None, b"")
                 for chunk in rop.missing_shards}
 
     # -- deep scrub (ECBackend.cc:2461-2546) -------------------------------

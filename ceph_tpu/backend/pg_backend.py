@@ -457,6 +457,10 @@ class PGBackend:
         # the authority (the reference elects the authoritative log during
         # peering; the primary's own is the single-primary analog) — half-
         # applied writes it logged roll FORWARD by repairing the peers.
+        # objects with detected-but-unlocatable inconsistency (see the EC
+        # backend's verified recovery; replicated majority-vote ties could
+        # populate it too): surfaced by scrub/health until exonerated
+        self.inconsistent_objects: set[str] = set()
         self.pg_log = PGLog()
         self.pg_log.tail = self.local_shard.pg_log.tail
         self.pg_log.head = self.local_shard.pg_log.tail
@@ -947,11 +951,14 @@ class PGBackend:
             return
         chunk_of_shard = {s: c for c, s in enumerate(self.acting)}
         chunk = chunk_of_shard[reply.from_shard]
-        for oid, bufs in reply.buffers_read.items():
-            rop._read_results[chunk] = b"".join(b for _, b in bufs)
-        for oid, attrs in reply.attrs_read.items():
-            rop._read_attrs[chunk] = attrs
-        if rop.oid in reply.omap_read:     # recovery reads ONE oid
+        # recovery reads exactly ONE oid: key every slot by rop.oid so a
+        # hypothetical multi-oid reply cannot last-oid-wins overwrite
+        if rop.oid in reply.buffers_read:
+            rop._read_results[chunk] = b"".join(
+                b for _, b in reply.buffers_read[rop.oid])
+        if rop.oid in reply.attrs_read:
+            rop._read_attrs[chunk] = reply.attrs_read[rop.oid]
+        if rop.oid in reply.omap_read:
             rop._read_omap[chunk] = reply.omap_read[rop.oid]
         rop._pending.discard(reply.from_shard)
         if rop._pending:

@@ -542,6 +542,12 @@ class MiniCluster:
         if by_state.get("inactive"):
             checks["PG_AVAILABILITY"] = \
                 f"{by_state['inactive']} pgs inactive"
+        damaged = sum(len(getattr(g.backend, "inconsistent_objects", ()))
+                      for p in self.pools.values()
+                      for g in p["pgs"].values())
+        if damaged:
+            checks["OBJECT_DAMAGED"] = \
+                f"{damaged} objects with unlocatable inconsistency"
         status = ("HEALTH_ERR" if "PG_AVAILABILITY" in checks
                   else "HEALTH_WARN" if checks else "HEALTH_OK")
         return {"status": status, "checks": checks}
@@ -577,6 +583,15 @@ class MiniCluster:
                                 and gobj.oid != PG_META)
                 bad: dict[str, list[int]] = {}
                 scanned: dict[str, int] = {}
+                # damaged objects (inconsistent recovery sources) stay in
+                # the report until an operator-grade overwrite clears
+                # them — a laundered object can scrub "clean" wrongly
+                for oid in sorted(getattr(g.backend,
+                                          "inconsistent_objects", ())):
+                    bad[oid] = sorted(
+                        ci for ci, s in enumerate(g.acting)
+                        if s not in g.bus.down)
+                    scanned[oid] = len(bad[oid])
                 for oid in sorted(oids):
                     try:
                         per_shard = g.backend.be_deep_scrub(oid)
@@ -591,7 +606,7 @@ class MiniCluster:
                             per_shard[ci] = shard_store(g.bus, s).exists(
                                 GObject(oid, s))
                     bads = sorted(s for s, ok in per_shard.items() if not ok)
-                    if bads:
+                    if bads and oid not in bad:
                         bad[oid] = bads
                         scanned[oid] = len(per_shard)
                 if bad:
@@ -773,6 +788,7 @@ class MiniCluster:
         reads reconstruct), re-encode into a fresh group (the reference's
         backfill)."""
         old = self.pools[pool_id]["pgs"][ps]
+        damaged = set(getattr(old.backend, "inconsistent_objects", ()))
         # read everything out of the old layout FIRST: in durable mode the
         # new group reopens the same per-(osd, pg) directories, so the old
         # stores must be drained and closed before the new ones open
@@ -834,6 +850,10 @@ class MiniCluster:
                 objop.omap_ops.append(("header", header))
             new.backend.submit_transaction(t)
             new.bus.deliver_all()
+        # damaged-object state survives the move: the copied bytes may
+        # BE the laundered rot, and dropping the flag would let it scrub
+        # clean forever without an operator restore
+        new.backend.inconsistent_objects |= damaged
         self.pools[pool_id]["pgs"][ps] = new
         # re-home the PG on its (possibly new) primary's daemon
         if old.backend.whoami != new.backend.whoami:

@@ -318,3 +318,64 @@ def test_admin_socket_pg_commands(cluster):
     assert hist["state"].endswith("Active")
     assert hist["last_epoch_started"] == 31
     assert any(s.endswith("GetInfo") for _, s in hist["history"])
+
+
+class TestDamagedObjects:
+    def test_unlocatable_rot_pins_health_until_restore(self):
+        """Recovery from inconsistent sources with one spare equation:
+        detect-only -> OBJECT_DAMAGED sticks through clean-looking
+        scrubs until a WHOLESALE overwrite exonerates (partial
+        truncate+write must NOT)."""
+        c = MiniCluster(n_osds=8, osds_per_host=2, chunk_size=512)
+        pid = c.create_ec_pool("p", {"k": "2", "m": "2",
+                                     "device": "numpy"}, pg_num=4)
+        from ceph_tpu.backend.memstore import GObject
+        from ceph_tpu.backend.pg_backend import shard_store
+        payload = np.random.default_rng(1).integers(
+            0, 256, 2000, np.uint8).tobytes()
+        c.operate(pid, "v", ObjectOperation().write_full(b"old"))
+        g = c.pg_group(pid, "v")
+        victim = g.acting[3]
+        g.bus.mark_down(victim)
+        c.operate(pid, "v", ObjectOperation().write_full(payload))
+        rot = g.acting[1]
+        shard_store(g.bus, rot).objects[GObject("v", rot)].data[0] ^= 0xFF
+        g.bus.mark_up(victim)
+        g.bus.deliver_all()
+        assert "v" in g.backend.inconsistent_objects
+        assert "OBJECT_DAMAGED" in c.health()["checks"]
+        assert any("v" in b for b in c.scrub_pool(pid).values())
+        # a PARTIAL truncate+write does not exonerate
+        c.operate(pid, "v", ObjectOperation().truncate(512)
+                  .write(512, b"tail"))
+        assert "v" in g.backend.inconsistent_objects
+        # wholesale restore does
+        c.operate(pid, "v", ObjectOperation().write_full(payload))
+        assert "v" not in g.backend.inconsistent_objects
+        assert c.scrub_pool(pid) == {}
+        assert c.health()["status"] == "HEALTH_OK"
+        c.shutdown()
+
+    def test_verified_repair_preserves_user_xattrs(self):
+        """Repairing a LOCATED rotten source replaces the whole shard
+        object: the replicated attrs must travel with the push
+        (regression: only hinfo was pushed, wiping the xattrs)."""
+        c = MiniCluster(n_osds=8, osds_per_host=2, chunk_size=512)
+        pid = c.create_ec_pool("p", {"k": "2", "m": "2",
+                                     "device": "numpy"}, pg_num=4)
+        from ceph_tpu.backend.memstore import GObject
+        from ceph_tpu.backend.pg_backend import shard_store
+        c.operate(pid, "x", ObjectOperation().write_full(b"a" * 1800))
+        c.operate(pid, "x", ObjectOperation().write_full(b"b" * 1700)
+                  .setxattr("tag", b"keep"))
+        g = c.pg_group(pid, "x")
+        rot = g.acting[1]
+        shard_store(g.bus, rot).objects[GObject("x", rot)].data[0] ^= 0xFF
+        assert any("x" in b for b in c.scrub_pool(pid, repair=True).values())
+        assert c.scrub_pool(pid) == {}
+        # the repaired shard still has the user xattr
+        assert shard_store(g.bus, rot).getattr(
+            GObject("x", rot), "_tag") == b"keep"
+        assert c.operate(pid, "x", ObjectOperation()
+                         .getxattr("tag")).outdata(0) == b"keep"
+        c.shutdown()
