@@ -640,6 +640,23 @@ class ECBackend(PGBackend):
                      for c, v in rop._read_results.items()}
         hinfo = self._hinfo(rop.oid)
         k = self.ec_impl.get_data_chunk_count()
+        if hinfo.has_chunk_hash() and \
+                self.ec_impl.get_sub_chunk_count() == 1:
+            # the reference CRC-verifies recovery reads against the
+            # hinfo before reconstructing (ECBackend handle_recovery_
+            # read_complete checks the cumulative hash): a source whose
+            # crc mismatches is itself rotten — drop it and rebuild it
+            # too rather than bake its rot into the new chunk
+            rotten = [c for c, v in available.items()
+                      if crc32c(0xFFFFFFFF, v) != hinfo.get_chunk_hash(c)]
+            if rotten and len(available) - len(rotten) >= k:
+                for c in rotten:
+                    del available[c]
+                rop.missing_shards = set(rop.missing_shards) | set(rotten)
+            elif rotten:
+                # not enough clean sources to rebuild everything: the
+                # reconstruction would embed rot — record damage
+                self.inconsistent_objects.add(rop.oid)
         if not hinfo.has_chunk_hash() and len(available) > k \
                 and self.ec_impl.get_sub_chunk_count() == 1:
             # verified recovery (see _recovery_issue_reads): cross-check

@@ -379,3 +379,30 @@ class TestDamagedObjects:
         assert c.operate(pid, "x", ObjectOperation()
                          .getxattr("tag")).outdata(0) == b"keep"
         c.shutdown()
+
+    def test_recovery_crc_verifies_sources(self):
+        """With hinfo hashes present, recovery CRC-checks its sources
+        and drops+rebuilds a rotten one instead of baking its rot into
+        the reconstructed chunk (the reference's recovery-read check)."""
+        c = MiniCluster(n_osds=8, osds_per_host=2, chunk_size=512)
+        pid = c.create_ec_pool("p", {"k": "2", "m": "2",
+                                     "device": "numpy"}, pg_num=4)
+        from ceph_tpu.backend.memstore import GObject
+        from ceph_tpu.backend.pg_backend import shard_store
+        payload = np.random.default_rng(9).integers(
+            0, 256, 1900, np.uint8).tobytes()
+        c.put(pid, "cv", payload)          # append-path: hashes PRESENT
+        g = c.pg_group(pid, "cv")
+        victim = g.acting[3]
+        g.bus.mark_down(victim)
+        rot = g.acting[0]                  # rot the PRIMARY's data chunk
+        shard_store(g.bus, rot).objects[GObject("cv", rot)].data[0] ^= 0xFF
+        # force a recovery of the downed shard's chunk
+        g.bus.mark_up(victim)
+        g.backend.recover_object("cv", {3})
+        g.bus.deliver_all()
+        # the rotten source was dropped AND healed as an extra target
+        assert c.get(pid, "cv", 1900) == payload
+        assert c.scrub_pool(pid) == {}
+        assert "cv" not in g.backend.inconsistent_objects
+        c.shutdown()
