@@ -615,7 +615,10 @@ class ECBackend(PGBackend):
         # shard laundered bitrot past every later scrub).  Reading every
         # available full chunk restores the spare equations, and the
         # payload step cross-checks before pushing.
-        verify = (not hinfo.has_chunk_hash() and len(avail) > len(minimum)
+        # Reading all spares also serves the HASH-PRESENT path: a source
+        # failing its crc check is dropped and rebuilt, which needs a
+        # replacement source in hand.
+        verify = (len(avail) > len(minimum)
                   and self.ec_impl.get_sub_chunk_count() == 1)
         want = ({c: [(0, self.ec_impl.get_sub_chunk_count())]
                  for c in sorted(avail)} if verify else minimum)
