@@ -82,8 +82,16 @@ def test_soak_campaign(seed, pool_type):
         def check(oid):
             if oid not in model or oid in dirty_rot:
                 return
-            got = c.operate(pid, oid, ObjectOperation().read(0, 0)
-                            .getxattr("tag"))
+            try:
+                got = c.operate(pid, oid, ObjectOperation().read(0, 0)
+                                .getxattr("tag"))
+            except IOError:
+                # unreadable is LEGITIMATE only while the PG is degraded
+                # (fewer than k chunks reachable); with everything up a
+                # read failure is a real bug
+                assert c.pg_group(pid, oid).bus.down, \
+                    f"read of {oid} failed on a healthy PG"
+                return
             assert got.outdata(0)[:len(model[oid])] == model[oid], oid
             assert got.outdata(1) == attrs[oid]
 
@@ -121,9 +129,14 @@ def test_soak_campaign(seed, pool_type):
                             and oid not in dirty_rot:
                         # (a dirty head serves snap reads until a COW or
                         # scrub — same visibility rule as plain reads)
-                        r = c.operate(pid, oid,
-                                      ObjectOperation().read(0, 0),
-                                      snapid=sid)
+                        try:
+                            r = c.operate(pid, oid,
+                                          ObjectOperation().read(0, 0),
+                                          snapid=sid)
+                        except IOError:
+                            assert c.pg_group(pid, oid).bus.down, \
+                                f"snap read of {oid} failed healthy"
+                            continue
                         assert r.outdata(0)[:len(old[oid])] == old[oid], \
                             (oid, sid)
                 elif action == "kill":
