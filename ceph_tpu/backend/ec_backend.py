@@ -234,6 +234,12 @@ class ECBackend(PGBackend):
                 for clone_oid in objop.clone_to:
                     log_entries.append(self.pg_log.append(clone_oid,
                                                           OP_MODIFY))
+                if oid in self.inconsistent_objects:
+                    # COW copies the DAMAGED state under a new name: the
+                    # clone inherits the flag, or the snapshot would
+                    # serve laundered corruption while the head's
+                    # wholesale-overwrite exoneration erases all trace
+                    self.inconsistent_objects.update(objop.clone_to)
             if objop.rollback_from is not None:
                 # replace head wholesale with the clone's shard state;
                 # the cached head hinfo is now stale — the cloned attrs
@@ -244,6 +250,14 @@ class ECBackend(PGBackend):
                     shard_txns[shard].clone(
                         GObject(objop.rollback_from, shard),
                         GObject(oid, shard))
+                # rollback REPLACES the head with the source's state —
+                # including its damage status: restoring from a damaged
+                # clone flags the head (the COW-laundering fix's mirror
+                # direction), restoring from a clean one exonerates it
+                if objop.rollback_from in self.inconsistent_objects:
+                    self.inconsistent_objects.add(oid)
+                else:
+                    self.inconsistent_objects.discard(oid)
                 self._apply_attr_updates(oid, objop, shard_txns)
                 log_entries.append(self.pg_log.append(oid, OP_MODIFY))
                 self.hinfo_cache.pop(oid, None)

@@ -83,6 +83,21 @@ class ReplicatedBackend(PGBackend):
                 # backend's clone_to note)
                 log_entries.append(self.pg_log.append(clone_oid,
                                                       OP_MODIFY))
+            if objop.clone_to and oid in self.inconsistent_objects:
+                # damaged state COWs into the clone (see EC note)
+                self.inconsistent_objects.update(objop.clone_to)
+            if objop.rollback_from is not None:
+                # head state replaced by the source's — flag included
+                if objop.rollback_from in self.inconsistent_objects:
+                    self.inconsistent_objects.add(oid)
+                else:
+                    self.inconsistent_objects.discard(oid)
+            elif is_delete or (objop.truncate is not None and any(
+                    off == 0 and len(d) >= objop.truncate[0]
+                    for off, d in objop.buffer_updates)):
+                # wholesale replacement exonerates (mirrors the EC rule;
+                # also covers snaptrim's clone deletes)
+                self.inconsistent_objects.discard(oid)
             for shard in self.acting:
                 obj = GObject(oid, shard)
                 t = shard_txns[shard]

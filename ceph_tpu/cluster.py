@@ -690,6 +690,8 @@ class MiniCluster:
                             keep.append(c)
                             continue
                         t.delete(f"{head}{SNAP_SEP}{c}")
+                        # (the delete's wholesale exoneration in the
+                        # backend drops any damage flag with the clone)
                     if keep != clones:
                         hobj = GObject(head, whoami)
                         if store.exists(hobj):

@@ -328,3 +328,81 @@ def test_cow_survives_shard_death_via_log_repair():
         g.bus.mark_up(others[0])
     c.remove_pool_snap(pid, "s")
     c.shutdown()
+
+
+def test_cow_of_damaged_head_marks_clone_damaged():
+    """The seed-113 chain, shrunk to its 5 essential beats: a shard
+    misses writes; another shard rots silently; revival rebuilds the
+    missed chunk from sources including the rot (detect-only -> head
+    DAMAGED); the next write COWs that laundered state into a snapshot
+    clone and wholesale-exonerates the head.  The clone must inherit
+    the damage flag, or the snapshot serves corruption forever while
+    every trace of the problem is erased."""
+    import numpy as np
+    from ceph_tpu.backend.memstore import GObject
+    from ceph_tpu.backend.pg_backend import shard_store
+    from ceph_tpu.osd.primary_log_pg import clone_oid
+    c = MiniCluster(n_osds=8, osds_per_host=2, chunk_size=512)
+    pid = c.create_ec_pool("p", {"k": "2", "m": "2", "device": "numpy"},
+                           pg_num=4)
+    g = c.pg_group(pid, "obj")
+    absent = g.acting[3]
+    g.bus.mark_down(absent)                      # misses the writes
+    c.operate(pid, "obj", ObjectOperation().write_full(b"a" * 1700))
+    v = np.random.default_rng(0).integers(0, 256, 1187,
+                                          np.uint8).tobytes()
+    c.operate(pid, "obj", ObjectOperation().write_full(v))  # clears hashes
+    s1 = c.create_pool_snap(pid, "s1")
+    rot = g.acting[1]
+    shard_store(g.bus, rot).objects[GObject("obj", rot)].data[0] ^= 0xFF
+    g.bus.mark_up(absent)                        # rebuild from rotten set
+    g.bus.deliver_all()
+    assert "obj" in g.backend.inconsistent_objects   # detect-only damage
+    c.operate(pid, "obj", ObjectOperation().write_full(b"fresh" * 300))
+    # the wholesale write exonerates the HEAD...
+    assert "obj" not in g.backend.inconsistent_objects
+    # ...but the clone inherited the damage and stays pinned
+    cl = clone_oid("obj", s1)
+    assert cl in g.backend.inconsistent_objects
+    rep = c.scrub_pool(pid)
+    assert any(cl in b for b in rep.values())
+    assert "OBJECT_DAMAGED" in c.health()["checks"]
+    # operator retires the broken snapshot: snaptrim deletes the clone
+    # AND its damage flag -> clean
+    c.remove_pool_snap(pid, "s1")
+    assert cl not in g.backend.inconsistent_objects
+    assert c.scrub_pool(pid) == {}
+    c.shutdown()
+
+
+def test_rollback_carries_damage_both_directions():
+    """Rollback replaces the head with the source's state INCLUDING its
+    damage flag: restoring from a damaged clone flags the head (the COW
+    laundering fix's mirror), restoring from a clean clone exonerates a
+    damaged head (the operator's natural remediation)."""
+    from ceph_tpu.osd.primary_log_pg import clone_oid
+    c = MiniCluster(n_osds=8, osds_per_host=2, chunk_size=512)
+    pid = c.create_ec_pool("p", {"k": "2", "m": "2", "device": "numpy"},
+                           pg_num=4)
+    # clean clone, damaged head -> rollback exonerates
+    c.operate(pid, "a", ObjectOperation().write_full(b"clean" * 200))
+    s1 = c.create_pool_snap(pid, "s1")
+    c.operate(pid, "a", ObjectOperation().write_full(b"x" * 900))
+    g = c.pg_group(pid, "a")
+    g.backend.inconsistent_objects.add("a")       # damage strikes the head
+    c.operate(pid, "a", ObjectOperation().rollback(s1))
+    assert "a" not in g.backend.inconsistent_objects
+    assert c.operate(pid, "a", ObjectOperation()
+                     .read(0, 0)).outdata(0)[:5] == b"clean"
+    # damaged clone, clean head -> rollback flags the head
+    g2 = c.pg_group(pid, "b")
+    c.operate(pid, "b", ObjectOperation().write_full(b"v1" * 300))
+    c.operate(pid, "b", ObjectOperation().write_full(b"v2" * 300))
+    cl = clone_oid("b", c.create_pool_snap(pid, "s2"))
+    c.operate(pid, "b", ObjectOperation().write_full(b"v3" * 300))
+    g2.backend.inconsistent_objects.add(cl)       # the clone is damaged
+    c.operate(pid, "b", ObjectOperation().rollback(
+        next(s for s, n in c.pools[pid]["pool"].snaps.items()
+             if n == "s2")))
+    assert "b" in g2.backend.inconsistent_objects
+    c.shutdown()

@@ -11,14 +11,14 @@ with reorder/dup faults — all interleaved by one seeded RNG, with the
 model asserting after every step that acked state is exactly
 observable state.
 
-The sweep found (and the fixes below closed) real bugs: scrub blindness
-to post-overwrite bitrot, clones lost to log repair, recovery
-laundering rot into parity.  Known open corner (tracked): seed 113's
-snapread@4 diverges after a COW-under-churn whose clone scrub
-localised+repaired chunk 3 — the repaired clone reads differently than
-the model's snapshot copy; under investigation whether the scrub's
-version-check/parity interplay mislocalises when the rotted chunk is
-ALSO version-stale.
+The sweep found (and the fixes closed) real bugs: scrub blindness to
+post-overwrite bitrot, clones lost to log repair, recovery laundering
+rot into parity, and — via an action-trace shrinker on seed 113 — a
+COW of a damage-flagged head copying laundered corruption into a
+snapshot clone while the head's wholesale-overwrite exoneration erased
+every trace (clones now inherit the damage flag; see
+test_snapshots.test_cow_of_damaged_head_marks_clone_damaged for the
+13-action chain reduced to its 5 essential beats).
 """
 import random
 
@@ -38,7 +38,7 @@ STEPS = 300
 
 
 @pytest.mark.parametrize("pool_type", ["ec", "rep"])
-@pytest.mark.parametrize("seed", [1, 7, 106, 110, 114, 20260730])
+@pytest.mark.parametrize("seed", [1, 7, 106, 110, 113, 114, 20260730])
 def test_soak_campaign(seed, pool_type):
     rng = random.Random(seed)
     drng = np.random.default_rng(seed)
