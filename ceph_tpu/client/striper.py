@@ -56,24 +56,36 @@ class RadosStriper:
 
     # -- I/O -----------------------------------------------------------------
 
-    def _existing_pieces(self, soid: str) -> list[str]:
-        """Piece objects of ``soid`` from the pool's listing — GROUND
-        TRUTH, independent of any (possibly stale) layout attr."""
-        prefix = f"{soid}."
-        out = []
-        for oid in self.io.list_objects():
-            tail = oid[len(prefix):]
-            if oid.startswith(prefix) and len(tail) == 16 and \
-                    all(ch in "0123456789abcdef" for ch in tail):
-                out.append(oid)
-        return out
+    def _layout_pieces(self, soid: str, lay: dict) -> set[str]:
+        """Piece names implied by a recorded layout — the reference derives
+        piece sets from the layout/size xattr (RadosStriperImpl.cc
+        truncate/remove), never from a pool-wide name scan, because user
+        objects may legitimately be named '<soid>.<16 hex>'.  A staged
+        ``pending`` sub-layout (write_full's crash window between piece
+        writes and the final xattr) contributes its piece set too, so an
+        interrupted write can never orphan pieces."""
+        pend = lay.get("pending") or []
+        if isinstance(pend, dict):
+            pend = [pend]
+        names = {piece_name(soid, 0)}       # layout piece always exists
+        for sub in (lay, *pend):
+            if not sub:
+                continue
+            reader = RadosStriper(self.io, int(sub["su"]), int(sub["sc"]),
+                                  int(sub["os"]))
+            names |= {piece_name(soid, idx)
+                      for idx, _ in reader._piece_extents(int(sub["size"]))}
+        return names
 
     def write_full(self, soid: str, data: bytes) -> int:
         """Stripe ``data`` over piece objects; EC pools encode the whole
         batch in one device dispatch.  Returns the piece count.  A
         shrinking rewrite deletes the stale trailing pieces (the
-        reference truncates/removes them on shrink)."""
+        reference truncates/removes them on shrink) — the stale set is
+        derived from the PREVIOUS layout xattr, so unrelated user objects
+        whose names merely match the piece pattern are never touched."""
         data = bytes(data)
+        old = self._load_layout(soid)        # None = no prior object
         pieces = self._piece_extents(len(data))
         bufs: dict[str, bytearray] = {}
         for idx, extents in pieces:
@@ -82,22 +94,86 @@ class RadosStriper:
                 if len(buf) < p_off + n:
                     buf.extend(b"\0" * (p_off + n - len(buf)))
                 buf[p_off:p_off + n] = data[l_off:l_off + n]
+        new_lay = {"su": self.su, "sc": self.sc, "os": self.os,
+                   "size": len(data)}
+        # STAGE the incoming layout before touching any other piece: if
+        # the batched piece write (or this process) dies mid-way, the
+        # layout on piece 0 still enumerates every piece either layout
+        # could have produced, so the next write's sweep — and remove() —
+        # reclaim the partial state instead of orphaning it
+        staged = dict(old) if old is not None else dict(new_lay, size=0)
+        prior_pend = staged.get("pending") or []
+        if isinstance(prior_pend, dict):
+            prior_pend = [prior_pend]
+        # earlier interrupted writes keep their pending entries until THIS
+        # write's commit point sweeps their pieces
+        staged["pending"] = [new_lay, *prior_pend]
+        p0 = piece_name(soid, 0)
+        op0 = ObjectOperation()
+        if p0 in bufs:
+            # piece 0's data rides the SAME atomic vector as the staged
+            # layout: the op engine keeps its object_info in sync (a
+            # below-engine overwrite would leave a stale size on the
+            # engine-created object and truncate reads to it)
+            op0.write_full(bytes(bufs[p0]))
+        op0.setxattr(LAYOUT_ATTR, staged)
+        self.io.operate(p0, op0)
         cluster = self.io.rados.cluster
-        # ONE batched device encode for every piece (cross-PG coalescing)
-        cluster.put_many(self.io.pool_id,
-                         {oid: bytes(b) for oid, b in bufs.items()})
-        self.io.operate(piece_name(soid, 0), ObjectOperation().setxattr(
-            LAYOUT_ATTR, {"su": self.su, "sc": self.sc, "os": self.os,
-                          "size": len(data)}))
+        # ONE batched device encode for all remaining pieces
+        # (cross-PG coalescing)
+        rest = {oid: bytes(b) for oid, b in bufs.items() if oid != p0}
+        if rest:
+            cluster.put_many(self.io.pool_id, rest)
+        # switch the RECORDED layout to the new one BEFORE sweeping: the
+        # base layout must never enumerate pieces the sweep has deleted
+        # (a crash mid-sweep would otherwise leave reads dereferencing
+        # removed trailing pieces).  The old layout — whose pieces the
+        # sweep is about to reclaim — moves into pending until the sweep
+        # finishes, so a crash mid-sweep stays reclaimable.
+        old_pend = ([{f: old[f] for f in ("su", "sc", "os", "size")}]
+                    if old is not None else []) + prior_pend
+        mid = dict(new_lay)
+        if old_pend:
+            mid["pending"] = old_pend
+            self.io.operate(p0, ObjectOperation().setxattr(
+                LAYOUT_ATTR, mid))
+        else:
+            # fresh object, nothing to sweep: the staged write above is
+            # superseded by this single clean commit
+            self.io.operate(p0, ObjectOperation().setxattr(
+                LAYOUT_ATTR, new_lay))
+            return max(len(bufs), 1)
         # piece 0 always survives the sweep: an EMPTY object has no data
-        # pieces but its layout piece was just written above
-        for stale in (set(self._existing_pieces(soid)) - set(bufs)
-                      - {piece_name(soid, 0)}):
-            self.io.remove_object(stale)
+        # pieces but its layout piece holds the xattr
+        stale = (self._layout_pieces(soid, staged) - set(bufs)
+                 - {piece_name(soid, 0)})
+        for oid in stale:
+            try:
+                self.io.remove_object(oid)
+            except ObjectNotFound:
+                pass                         # already gone — idempotent
+        # the COMMIT point: sweep done, pending dropped
+        self.io.operate(p0, ObjectOperation().setxattr(
+            LAYOUT_ATTR, new_lay))
         return max(len(bufs), 1)
 
     def _layout(self, soid: str) -> dict:
         return self.io.get_xattr(piece_name(soid, 0), LAYOUT_ATTR)
+
+    def _load_layout(self, soid: str) -> dict | None:
+        """The recorded layout, or None when the striped object genuinely
+        does not exist (no piece 0 / no layout attr).  Transient errors —
+        a blocked PG, an I/O failure — PROPAGATE: treating them as
+        'absent' would skip the shrink sweep and permanently orphan
+        pieces that remove() (layout-derived) can no longer reach."""
+        try:
+            return self._layout(soid)
+        except ObjectNotFound:
+            return None
+        except IOError as e:
+            if getattr(e, "errno", None) == -61:    # ENODATA: no attr
+                return None
+            raise
 
     def stat(self, soid: str) -> int:
         return int(self._layout(soid)["size"])
@@ -135,12 +211,19 @@ class RadosStriper:
         return bytes(out)
 
     def remove(self, soid: str) -> int:
-        """Delete every piece by pool-listing ground truth (layout-derived
-        sets would orphan pieces left by an older, larger layout).
-        Piece 0 goes last: the layout must outlive the rest."""
-        pieces = sorted(self._existing_pieces(soid), reverse=True)
-        if not pieces:
+        """Delete every piece of the recorded layout (write_full's
+        layout-derived shrink sweep guarantees no pieces outlive the
+        layout, so the recorded set IS the complete set).  Piece 0 goes
+        last: the layout must outlive the rest."""
+        lay = self._load_layout(soid)
+        if lay is None:
             raise ObjectNotFound(f"no striped object {soid!r}")
+        pieces = sorted(self._layout_pieces(soid, lay), reverse=True)
+        removed = 0
         for oid in pieces:
-            self.io.remove_object(oid)
-        return len(pieces)
+            try:
+                self.io.remove_object(oid)
+                removed += 1
+            except ObjectNotFound:
+                pass                         # sparse piece never written
+        return removed

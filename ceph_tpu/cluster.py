@@ -148,6 +148,11 @@ class MiniCluster:
         self.pools: dict[int, dict] = {}       # pool_id -> {pgs, pool, ec}
         self.pool_ids: dict[str, int] = {}
         self.objects: dict[int, set[str]] = {}  # pool_id -> written oids
+        # (oid, result, msg) from batched (deliver=False) op replies that
+        # completed with an error AFTER their submit call returned — the
+        # next deliver_all() surfaces them (raising from inside the
+        # daemon drain would strand the rest of the queue)
+        self._deferred_errors: list[tuple[str, int, str]] = []
         # one daemon shell per OSD: sharded mClock op queue + superblock
         # (client ops route through the primary's daemon — OSD.cc:9490)
         from .osd.osd_daemon import OSDDaemon
@@ -355,13 +360,38 @@ class MiniCluster:
             # engine so make_writable clones the head at snap boundaries
             # (bypassing it would silently break snapshot isolation)
             from .osd.osd_ops import ObjectOperation
+            failed: list[int] = []
+            sync_phase = [True]      # until put() has checked `failed`
+
+            def _snap_done(reply):
+                # an error reply is NOT a committed write: surface it like
+                # operate() does instead of silently acking the put
+                if reply.result < 0:
+                    if sync_phase[0] and deliver:
+                        failed.append(reply.result)
+                    else:
+                        # the reply arrived AFTER put() returned (batched
+                        # deliver=False op, or a blocked write completing
+                        # once shards came back).  Raising here would
+                        # unwind through the op engine's _finish and
+                        # strand the daemon queue, so park the error for
+                        # deliver_all() to surface instead.
+                        self._deferred_errors.append(
+                            (oid, reply.result,
+                             f"put of {oid} failed: result {reply.result}"))
+                else:
+                    _committed(reply.version)
             res = self._dispatch_op_vector(
                 g, pool_id, oid,
                 ObjectOperation().write(0, bytes(data) + b"\0" * pad).ops,
-                self.osdmap.epoch,
-                lambda reply: _committed(reply.version), drain=deliver)
+                self.osdmap.epoch, _snap_done, drain=deliver)
+            sync_phase[0] = False
             if res is not None:
                 raise IOError(f"put of {oid} bounced as stale: {res}")
+            if failed:
+                err = IOError(f"put of {oid} failed: result {failed[0]}")
+                err.errno = failed[0]
+                raise err
             if deliver and wait and not done:
                 raise BlockedWriteError(
                     f"write of {oid} blocked: PG {g.pgid} inactive")
@@ -511,9 +541,23 @@ class MiniCluster:
         return out["result"][oid][0][2][:length]
 
     def deliver_all(self) -> None:
+        """Run everything queued: daemon op queues FIRST (batched
+        deliver=False ops park there — bus delivery alone would never
+        execute them), then every PG bus.  Errors parked by batched op
+        replies surface here, where the caller expects completion."""
+        for daemon in self.osds.values():
+            daemon.drain()
         for p in self.pools.values():
             for g in p["pgs"].values():
                 g.bus.deliver_all()
+        if self._deferred_errors:
+            oid, result, msg = self._deferred_errors[0]
+            rest = len(self._deferred_errors) - 1
+            self._deferred_errors.clear()
+            err = IOError(msg + (f" (+{rest} more batched errors)"
+                                 if rest else ""))
+            err.errno = result
+            raise err
 
     @staticmethod
     def pg_state(g: PGGroup) -> str:

@@ -86,7 +86,19 @@ def split_clone_oid(oid: str) -> tuple[str, int] | None:
 
 
 def empty_snapset() -> dict:
-    return {"seq": 0, "clones": [], "sizes": {}}
+    # lbs[c] = snapset.seq at clone c's creation: clone c covers exactly
+    # the snaps in (lbs[c], c] — the analog of the reference SnapSet's
+    # per-clone clone_snaps list (src/osd/osd_types.h SnapSet), which is
+    # what lets reads at PRE-creation snaps resolve to ENOENT even after
+    # later clones exist
+    return {"seq": 0, "clones": [], "sizes": {}, "lbs": {}}
+
+
+def clone_lower_bound(ss: dict, c: int) -> int:
+    """The oldest snap NOT covered by clone c (0 = covers everything
+    below c; legacy snapsets without lbs keep the old semantics)."""
+    lbs = ss.get("lbs", {})
+    return lbs.get(c, lbs.get(str(c), 0))
 # non-user attrs that share the "_" prefix (internal attrs otherwise use
 # non-"_" prefixes — e.g. the replicated backend's "@version" — so they
 # cannot collide with any user name)
@@ -317,16 +329,35 @@ class PrimaryLogPG:
         ss = empty_snapset()
         ss["seq"] = max(clones, default=0)
         ss["clones"] = clones
+        # per-clone lower bounds survive head deletion because each clone
+        # is a copy of the PRE-COW head, whose own SS_ATTR recorded the
+        # snapset.seq of that moment — exactly lbs[c].  (The reference
+        # keeps a snapdir object for the deleted-head case instead.)
+        for c in clones:
+            try:
+                old_ss = dict(store.getattr(
+                    GObject(clone_oid(oid, c), self.backend.whoami),
+                    SS_ATTR))
+                ss["lbs"][c] = int(old_ss.get("seq", 0))
+            except KeyError:
+                pass                 # clone predates lbs / no snap context
         return ss
 
     def _resolve_snap(self, oid: str, snapid: int) -> str | None:
-        """find_object_context's snap resolution: clone c covers snaps up
-        to c; a read at snap s hits the oldest clone >= s, else the head.
-        None = the object did not exist at that snap (head postdates it:
-        snapset.seq is stamped at creation/COW) -> ENOENT."""
+        """find_object_context's snap resolution: clone c covers the snap
+        interval (lbs[c], c]; a read at snap s hits the oldest clone >= s
+        IF s falls inside its coverage, else the head.  None = the object
+        did not exist at that snap (it postdates the creation seq stamped
+        on the snapset, or falls below the covering clone's lower bound)
+        -> ENOENT."""
         ss = self._load_snapset(oid)
         for c in sorted(ss["clones"]):
             if c >= snapid:
+                if snapid <= clone_lower_bound(ss, c):
+                    # the clone postdates the object's creation at snapid
+                    # (e.g. snap taken, THEN object created, THEN cloned):
+                    # no state existed at snapid
+                    return None
                 return clone_oid(oid, c)
         if snapid <= ss["seq"]:
             return None
@@ -410,6 +441,11 @@ class PrimaryLogPG:
                     ss["clones"] = sorted(set(ss["clones"]) | {newest})
                     ss["sizes"] = dict(ss["sizes"])
                     ss["sizes"][newest] = ctx.size
+                    # the clone covers (old seq, newest]: snaps at or
+                    # below the pre-clone seq belong to older clones (or
+                    # predate the object entirely)
+                    ss["lbs"] = dict(ss.get("lbs", {}))
+                    ss["lbs"][newest] = ss["seq"]
                     ss["seq"] = m.snapc.seq
                     ctx.stage_attr(SS_ATTR, ss)
             else:
@@ -688,6 +724,12 @@ class PrimaryLogPG:
             except KeyError:
                 ss = self._load_snapset(ctx.m.oid)
             cands = [c for c in sorted(ss["clones"]) if c >= p["snapid"]]
+            if cands and p["snapid"] <= clone_lower_bound(ss, cands[0]):
+                # the covering clone postdates the object's creation at
+                # this snap: the object did not exist then — fall through
+                # to the delete-the-head branch, matching what a read at
+                # the snap reports (ENOENT)
+                cands = []
             if not cands:
                 self._require(ctx)
                 if p["snapid"] <= ss["seq"]:

@@ -59,11 +59,15 @@ def sharded_encode_step(mesh: Mesh, parity_mat: np.ndarray):
     m, k = parity_mat.shape
 
     def local_step(data_blk):
-        # data_blk: [B/dp, k, N/sp] on this chip
+        # data_blk: [B/dp, k, N/sp] on this chip.  Restack into the
+        # VERTICAL stripe layout and run the PRODUCTION kernel selector
+        # (gf_apply_stripes: pallas on TPU, XLA bitslice elsewhere) — the
+        # single-chip bench and the sharded path must exercise ONE kernel,
+        # so shard_map-over-pallas is exactly what multi-chip runs.
         b, kk, n = data_blk.shape
-        folded = data_blk.swapaxes(0, 1).reshape(kk, b * n)
-        parity = rs_kernels.gf_apply_bitslice(mat, folded)
-        parity = parity.reshape(m, b, n).swapaxes(0, 1)     # [B/dp, m, N/sp]
+        vert = data_blk.reshape(b * kk, n)
+        parity = rs_kernels.gf_apply_stripes(mat, vert, b)
+        parity = parity.reshape(b, m, n)                    # [B/dp, m, N/sp]
         # placement checksum: reduce over the byte axis, then over sp —
         # the integrity cross-check a deep-scrub would do per shard
         # (reference: src/osd/ECBackend.cc:2461 be_deep_scrub crc recompute)

@@ -254,6 +254,52 @@ def test_read_at_precreation_snap_is_enoent(cluster):
     assert ei.value.errno == -2
 
 
+def test_precreation_snap_enoent_even_with_later_clone(cluster):
+    """A clone created by a post-creation overwrite must NOT cover snaps
+    that predate the object's creation (regression: _resolve_snap checked
+    clones before the snapset.seq guard, so any later clone resurrected
+    pre-creation reads; reference SnapSet tracks per-clone clone_snaps)."""
+    c, pid = cluster
+    s1 = c.create_pool_snap(pid, "pre")         # snap BEFORE creation
+    c.operate(pid, "lateclone", ObjectOperation().write_full(b"v1" * 300))
+    c.create_pool_snap(pid, "post")             # snap AFTER creation
+    c.operate(pid, "lateclone",                 # overwrite -> COW clone
+              ObjectOperation().write_full(b"v2" * 300))
+    with pytest.raises(IOError) as ei:
+        c.operate(pid, "lateclone", ObjectOperation().read(0, 0), snapid=s1)
+    assert ei.value.errno == -2
+
+
+def test_precreation_snap_enoent_survives_head_deletion(cluster):
+    """The per-clone lower bound must survive head deletion: clone
+    rediscovery (the snapdir analog) harvests each clone's own recorded
+    pre-COW seq (regression: rediscovery rebuilt lbs={} and the clone
+    resurrected pre-creation reads)."""
+    c, pid = cluster
+    s1 = c.create_pool_snap(pid, "pre")
+    c.operate(pid, "delhead", ObjectOperation().write_full(b"v1" * 300))
+    c.create_pool_snap(pid, "post")
+    c.operate(pid, "delhead", ObjectOperation().write_full(b"v2" * 300))
+    c.operate(pid, "delhead", ObjectOperation().remove())
+    with pytest.raises(IOError) as ei:
+        c.operate(pid, "delhead", ObjectOperation().read(0, 0), snapid=s1)
+    assert ei.value.errno == -2
+
+
+def test_rollback_to_precreation_snap_deletes_head(cluster):
+    """OP_ROLLBACK to a snap that predates creation removes the head even
+    when a later clone exists (same lower-bound flaw as the read path)."""
+    c, pid = cluster
+    s1 = c.create_pool_snap(pid, "pre")
+    c.operate(pid, "rbpre", ObjectOperation().write_full(b"v1" * 300))
+    c.create_pool_snap(pid, "post")
+    c.operate(pid, "rbpre", ObjectOperation().write_full(b"v2" * 300))
+    c.operate(pid, "rbpre", ObjectOperation().rollback(s1))
+    with pytest.raises(IOError) as ei:
+        c.operate(pid, "rbpre", ObjectOperation().read(0, 0))
+    assert ei.value.errno == -2
+
+
 def test_legacy_put_respects_cow(cluster):
     """The whole-object put() API honors snapshots too (regression:
     it bypassed the op engine entirely)."""
@@ -264,6 +310,25 @@ def test_legacy_put_respects_cow(cluster):
     c.put(pid, "lp", _data(1000, 24))
     r = c.operate(pid, "lp", ObjectOperation().read(0, 0), snapid=s1)
     assert r.outdata(0)[:1000] == v1
+
+
+def test_put_snap_path_surfaces_op_engine_error(cluster, monkeypatch):
+    """put() through the snapshot op-engine path must raise on an error
+    reply, not silently report the write as committed (regression: the
+    completion callback ignored reply.result)."""
+    import ceph_tpu.osd.primary_log_pg as plp
+    c, pid = cluster
+    c.create_pool_snap(pid, "s")          # snap_seq > 0: op-engine path
+    orig = plp.PrimaryLogPG._do_one
+
+    def failing(self, ctx, op, oi, readdata):
+        if ctx.m.oid == "errput":
+            raise plp.OpError(plp.EINVAL)
+        return orig(self, ctx, op, oi, readdata)
+    monkeypatch.setattr(plp.PrimaryLogPG, "_do_one", failing)
+    with pytest.raises(IOError) as ei:
+        c.put(pid, "errput", b"x" * 100)
+    assert getattr(ei.value, "errno", None) == plp.EINVAL
 
 
 def test_backfill_preserves_clones():

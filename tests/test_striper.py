@@ -107,6 +107,51 @@ def test_shrinking_rewrite_removes_stale_pieces(io):
     assert not [o for o in io.list_objects() if o.startswith("shrink.")]
 
 
+def test_user_object_matching_piece_pattern_survives(io):
+    """A user object whose name happens to match '<soid>.<16 hex>' must
+    survive write_full's shrink sweep AND remove() (regression: the
+    piece set came from a pool-wide name scan, silently deleting
+    unrelated objects; the reference derives pieces from the layout
+    xattr).  Also: a shrink must still clear its own stale pieces."""
+    st = RadosStriper(io, stripe_unit=512, stripe_count=2,
+                      object_size=1024)
+    victim = "big.00000000000000ff"            # piece-shaped USER object
+    io.write_full(victim, b"precious")
+    st.write_full("big", _data(6000, 7))       # many pieces
+    st.write_full("big", b"tiny")              # shrink sweep runs
+    assert io.read(victim) == b"precious"      # user object untouched
+    # the striper's own stale pieces ARE gone
+    assert [o for o in io.list_objects()
+            if o.startswith("big.") and o != victim] == \
+        [piece_name("big", 0)]
+    st.remove("big")
+    assert io.read(victim) == b"precious"      # remove() untouched it too
+
+
+def test_interrupted_write_full_reclaims_pieces(io, monkeypatch):
+    """A write_full that dies between the piece writes and the layout
+    commit must leave enough state (the staged 'pending' layout) for the
+    NEXT write — or remove() — to reclaim every piece it stored."""
+    st = RadosStriper(io, stripe_unit=512, stripe_count=2,
+                      object_size=1024)
+    st.write_full("part", _data(1200, 11))     # small initial object
+    cluster = io.rados.cluster
+    orig = cluster.put_many
+
+    def dying(pool_id, objects, **kw):
+        orig(pool_id, objects, **kw)           # pieces land...
+        raise RuntimeError("simulated crash after piece write")
+    monkeypatch.setattr(cluster, "put_many", dying)
+    with pytest.raises(RuntimeError):
+        st.write_full("part", _data(6000, 12))  # grows to pieces 0..5
+    monkeypatch.setattr(cluster, "put_many", orig)
+    # recovery: the next write sweeps the orphans of the interrupted one
+    st.write_full("part", b"tiny")
+    assert [o for o in io.list_objects() if o.startswith("part.")] == \
+        [piece_name("part", 0)]
+    assert st.read("part") == b"tiny"
+
+
 def test_blocked_op_leaves_no_ghost_resend(io):
     """A write raising BlockedWriteError must leave the objecter's
     inflight list (regression: a map change could resend it and a
