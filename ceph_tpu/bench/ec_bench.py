@@ -203,6 +203,8 @@ class ErasureCodeBench:
 
 
 def main(argv=None) -> int:
+    from ..utils.platform import honour_jax_platforms_env
+    honour_jax_platforms_env()   # axon sitecustomize override
     args = build_parser().parse_args(argv)
     try:
         return ErasureCodeBench(args).run()

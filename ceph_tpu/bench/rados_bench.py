@@ -87,6 +87,8 @@ def _report(kind, elapsed, ops, object_size, latencies, w) -> dict:
 
 
 def main(argv=None) -> int:
+    from ..utils.platform import honour_jax_platforms_env
+    honour_jax_platforms_env()   # axon sitecustomize override
     ap = argparse.ArgumentParser(prog="rados_bench",
                                  description=__doc__.splitlines()[0])
     ap.add_argument("mode", choices=["write", "seq"])

@@ -134,8 +134,10 @@ class MiniCluster:
         hosts = []
         for h0 in range(0, n_osds, osds_per_host):
             items = list(range(h0, min(h0 + osds_per_host, n_osds)))
-            hosts.append(cmap.add_bucket(
-                CRUSH_BUCKET_STRAW2, 1, items, [0x10000] * len(items)))
+            hb = cmap.add_bucket(
+                CRUSH_BUCKET_STRAW2, 1, items, [0x10000] * len(items))
+            cmap.set_item_name(hb, f"host{len(hosts)}")
+            hosts.append(hb)
         root = cmap.add_bucket(
             CRUSH_BUCKET_STRAW2, 2, hosts,
             [sum(cmap.buckets[h].item_weights) for h in hosts])
@@ -172,10 +174,13 @@ class MiniCluster:
             plugin, "", dict(profile), cct=self.cct)
         n = ec.get_chunk_count()
         # ErasureCode::create_rule semantics: chooseleaf indep over hosts
-        # when enough hosts exist, else osds (ErasureCode.cc:64-83)
-        root = self.osdmap.crush.item_id("default")
-        n_hosts = sum(1 for b in self.osdmap.crush.buckets.values()
-                      if b.type == 1)
+        # when enough hosts exist, else osds (ErasureCode.cc:64-83); a
+        # crush-device-class profile key routes the take through the
+        # per-class shadow tree (ErasureCode.cc:44-62 parses it)
+        root = self.osdmap.crush.take_with_class(
+            "default", profile.get("crush-device-class", ""))
+        n_hosts = sum(1 for bid, b in self.osdmap.crush.buckets.items()
+                      if b.type == 1 and not self.osdmap.crush.is_shadow(bid))
         ftype = 1 if n_hosts >= n else 0
         ruleno = self.osdmap.crush.add_rule(
             [(CRUSH_RULE_TAKE, root, 0),
@@ -197,8 +202,8 @@ class MiniCluster:
         (the mon's defaults for ``osd pool create ... replicated``);
         CRUSH chooses hosts firstn the way replicated rules do."""
         root = self.osdmap.crush.item_id("default")
-        n_hosts = sum(1 for b in self.osdmap.crush.buckets.values()
-                      if b.type == 1)
+        n_hosts = sum(1 for bid, b in self.osdmap.crush.buckets.items()
+                      if b.type == 1 and not self.osdmap.crush.is_shadow(bid))
         ftype = 1 if n_hosts >= size else 0
         ruleno = self.osdmap.crush.add_rule(
             [(CRUSH_RULE_TAKE, root, 0),

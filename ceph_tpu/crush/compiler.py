@@ -90,17 +90,35 @@ def _item_name(m: CrushMap, item: int) -> str:
 
 
 def decompile(m: CrushMap) -> str:
-    if any(b.alg == CRUSH_BUCKET_STRAW for b in m.buckets.values()):
-        # straw v1 needs the builder's straw recomputation on compile,
-        # which this framework does not implement (legacy-only alg) —
-        # refuse rather than emit text that cannot round-trip
-        raise ValueError("straw (v1) buckets cannot round-trip through "
-                         "text; convert to straw2 first")
+    # straw(v1) buckets round-trip because compile rebuilds their straw
+    # lengths via crush_calc_straw parity — but ONLY under the same
+    # straw_calc_version.  Loaded reference dumps carry straws as data
+    # without the tunable (crush_create defaults to v0, builder.c:1506),
+    # so detect which version reproduces the stored straws and pin it in
+    # the emitted tunables; refuse if neither does (silent placement
+    # divergence otherwise — the v0/v1 split shows on repeated weights).
+    tunables = dict(m.tunables)
+    straw_buckets = [b for b in m.buckets.values()
+                     if b.alg == CRUSH_BUCKET_STRAW and b.straws]
+    if straw_buckets:
+        from .map import calc_straw_lengths
+        declared = tunables.get("straw_calc_version")
+        candidates = [int(declared)] if declared is not None else [1, 0]
+        scv = next(
+            (v for v in candidates
+             if all(b.item_weights is not None and
+                    b.straws == calc_straw_lengths(b.item_weights, v)
+                    for b in straw_buckets)), None)
+        if scv is None:
+            raise ValueError(
+                "straw(v1) straw lengths match no straw_calc_version; "
+                "the text form cannot reproduce them — convert to straw2")
+        tunables["straw_calc_version"] = scv
     out = ["# begin crush map"]
     for t in TUNABLE_ORDER:
-        out.append(f"tunable {t} {int(m.tunables[t])}")
-    for t in sorted(set(m.tunables) - set(TUNABLE_ORDER)):
-        out.append(f"tunable {t} {int(m.tunables[t])}")
+        out.append(f"tunable {t} {int(tunables[t])}")
+    for t in sorted(set(tunables) - set(TUNABLE_ORDER)):
+        out.append(f"tunable {t} {int(tunables[t])}")
 
     out.append("")
     out.append("# devices")
@@ -144,6 +162,12 @@ def decompile(m: CrushMap) -> str:
         tname = m.type_names.get(b.type, f"type{b.type}")
         out.append(f"{tname} {_item_name(m, bid)} {{")
         out.append(f"\tid {bid}\t\t# do not change unnecessarily")
+        # per-class shadow ids (CrushCompiler.cc decompile_bucket: the
+        # clones themselves are not dumped; their ids are recorded here
+        # so a recompile reuses them)
+        for c, sid in sorted(m.class_bucket.get(bid, {}).items()):
+            out.append(f"\tid {sid} class {c}\t\t# do not change "
+                       f"unnecessarily")
         out.append(f"\t# weight {_fixed(b.weight)}")
         out.append(f"\talg {ALG_NAMES[b.alg]}")
         out.append(f"\thash {b.hash}\t# rjenkins1")
@@ -156,11 +180,15 @@ def decompile(m: CrushMap) -> str:
         out.append("}")
 
     for bid in sorted(m.buckets, reverse=True):     # -1, -2, ...
-        emit_bucket(bid)
+        if not m.is_shadow(bid):      # shadow trees rebuild on compile
+            emit_bucket(bid)
 
     out.append("")
     out.append("# rules")
     name_of_rule = {v: k for k, v in m.rule_names.items()}
+    shadow_of = {sid: (orig, c)
+                 for orig, cb in m.class_bucket.items()
+                 for c, sid in cb.items()}
     for ruleno in sorted(m.rules):
         rule = m.rules[ruleno]
         rname = name_of_rule.get(ruleno, f"rule{ruleno}")
@@ -172,7 +200,12 @@ def decompile(m: CrushMap) -> str:
         out.append(f"\tmax_size {getattr(rule, 'max_size', 10)}")
         for op, arg1, arg2 in rule.steps:
             if op == CRUSH_RULE_TAKE:
-                out.append(f"\tstep take {_item_name(m, arg1)}")
+                if arg1 in shadow_of:
+                    orig, c = shadow_of[arg1]
+                    out.append(f"\tstep take {_item_name(m, orig)} "
+                               f"class {c}")
+                else:
+                    out.append(f"\tstep take {_item_name(m, arg1)}")
             elif op == CRUSH_RULE_EMIT:
                 out.append("\tstep emit")
             elif op in SET_STEP_NAMES:
@@ -283,6 +316,11 @@ def compile_crushmap(text: str) -> CrushMap:
             next_auto_id = _parse_bucket(p, m, tok, name_to_id, next_auto_id)
         else:
             raise ValueError(f"unexpected token {tok!r}")
+    # materialize any reserved shadow trees no rule referenced, so the
+    # class_bucket table (and its ids) survives the round trip
+    for (bid, cls) in list(m._shadow_id_hints):
+        if bid in m.buckets:
+            m.device_class_clone(bid, cls)
     m.finalize()
     m.max_devices = max(m.max_devices, max_device_line)
     return m
@@ -297,15 +335,16 @@ def _parse_bucket(p: _Parser, m: CrushMap, tname: str, name_to_id,
     hash_ = 0
     items: list[int] = []
     weights: list[int] = []
+    class_ids: list[tuple[int, str]] = []   # (shadow id, class) lines
     while True:
         tok = p.next()
         if tok == "}":
             break
         if tok == "id":
             val = int(p.next())
-            if p.peek() == "class":       # per-class shadow id: recorded
+            if p.peek() == "class":       # per-class shadow id
                 p.next()
-                p.next()                  # class name (shadow ids unused)
+                class_ids.append((val, p.next()))
             else:
                 bid = val
         elif tok == "alg":
@@ -345,6 +384,11 @@ def _parse_bucket(p: _Parser, m: CrushMap, tname: str, name_to_id,
     m.buckets[bid].hash = hash_
     m.set_item_name(bid, bname)
     name_to_id[bname] = bid
+    for sid, cls in class_ids:
+        # reserve the dumped shadow id; the clone itself is rebuilt once
+        # every bucket is parsed (CrushWrapper::populate_classes with
+        # old_class_bucket id reuse)
+        m._shadow_id_hints[(bid, cls)] = sid
     return next_auto_id
 
 
@@ -386,7 +430,19 @@ def _parse_rule(p: _Parser, m: CrushMap, name_to_id) -> None:
                 item = item_by_name_or_fail(name, name_to_id)
                 if p.peek() == "class":
                     p.next()
-                    p.next()              # device-class take: base item kept
+                    cls = p.next()
+                    if item >= 0:
+                        raise ValueError(
+                            f"step take {name} class {cls}: class takes "
+                            f"need a bucket, not a device")
+                    if cls not in set(m.device_classes.values()):
+                        # the reference compiler rejects unknown classes
+                        # at compile time (a typo would otherwise build
+                        # an empty shadow tree that maps only holes)
+                        raise ValueError(
+                            f"step take {name} class {cls}: device class "
+                            f"{cls!r} is not assigned to any device")
+                    item = m.device_class_clone(item, cls)
                 steps.append((CRUSH_RULE_TAKE, item, 0))
             elif verb == "emit":
                 steps.append((CRUSH_RULE_EMIT, 0, 0))

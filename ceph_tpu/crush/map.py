@@ -73,6 +73,65 @@ class Rule:
     max_size: int = 10
 
 
+def calc_straw_lengths(weights: list[int], version: int = 1) -> list[int]:
+    """Legacy straw(v1) straw lengths (builder.c:427 crush_calc_straw,
+    transcribed exactly — including its acknowledged-flawed horizontal
+    slicing — because placement bit-equality with reference-built straw
+    maps is the requirement).  Honours both straw_calc_version profiles
+    (crush.h:446): v1 (modern default) and the v0 legacy same-weight
+    special case; they differ only for repeated or zero weights."""
+    import math
+    size = len(weights)
+    straws = [0] * size
+    if not size:
+        return straws
+    # builder.c's insertion sort is ascending and tie-stable
+    order = sorted(range(size), key=lambda i: weights[i])
+    numleft = size
+    straw = 1.0
+    wbelow = 0.0
+    lastw = 0.0
+    i = 0
+    while i < size:
+        if version == 0:
+            if weights[order[i]] == 0:
+                straws[order[i]] = 0
+                i += 1
+                continue
+            straws[order[i]] = int(straw * 0x10000)
+            i += 1
+            if i == size:
+                break
+            if weights[order[i]] == weights[order[i - 1]]:
+                continue                # same straw for equal weights
+            wbelow += (weights[order[i - 1]] - lastw) * numleft
+            j = i
+            while j < size and weights[order[j]] == weights[order[i]]:
+                numleft -= 1
+                j += 1
+            wnext = numleft * (weights[order[i]] - weights[order[i - 1]])
+            pbelow = wbelow / (wbelow + wnext)
+            straw *= math.pow(1.0 / pbelow, 1.0 / numleft)
+            lastw = weights[order[i - 1]]
+        else:
+            if weights[order[i]] == 0:
+                straws[order[i]] = 0
+                i += 1
+                numleft -= 1
+                continue
+            straws[order[i]] = int(straw * 0x10000)
+            i += 1
+            if i == size:
+                break
+            wbelow += (weights[order[i - 1]] - lastw) * numleft
+            numleft -= 1
+            wnext = numleft * (weights[order[i]] - weights[order[i - 1]])
+            pbelow = wbelow / (wbelow + wnext)
+            straw *= math.pow(1.0 / pbelow, 1.0 / numleft)
+            lastw = weights[order[i - 1]]
+    return straws
+
+
 # optimal tunable profile (builder.c set_optimal_crush_map semantics)
 OPTIMAL_TUNABLES = dict(choose_local_tries=0, choose_local_fallback_tries=0,
                         choose_total_tries=50, chooseleaf_descend_once=1,
@@ -97,6 +156,14 @@ class CrushMap:
         self.rule_names: dict[str, int] = {}
         self.choose_args: dict[int, object] = {}
         self.device_classes: dict[int, str] = {}
+        # original bucket id -> device class -> shadow bucket id
+        # (CrushWrapper::class_bucket, CrushWrapper.h:1335)
+        self.class_bucket: dict[int, dict[str, int]] = {}
+        # (original id, class) -> shadow id reservations, installed by the
+        # text compiler from 'id <sid> class <c>' lines so recompiled maps
+        # keep their shadow ids (the reference's old_class_bucket reuse,
+        # CrushWrapper.cc:2707)
+        self._shadow_id_hints: dict[tuple[int, str], int] = {}
 
     # -- builder (builder.c semantics) -------------------------------------
 
@@ -133,14 +200,19 @@ class CrushMap:
             b.item_weights = [int(w) for w in weights]
             self._build_tree(b)
         elif alg == CRUSH_BUCKET_STRAW:
-            raise NotImplementedError(
-                "straw(v1) construction needs the legacy straw calculation; "
-                "straw buckets can be loaded via from_dict (dumped maps) but "
-                "new maps should use straw2")
+            b.item_weights = [int(w) for w in weights]
+            self._calc_straws(b)
         else:
             raise ValueError(f"unknown bucket alg {alg}")
         self.buckets[id] = b
         return id
+
+    def _calc_straws(self, b: Bucket) -> None:
+        """Legacy straw(v1) straw lengths for the map's configured
+        straw_calc_version (see :func:`calc_straw_lengths`)."""
+        b.straws = calc_straw_lengths(
+            b.item_weights, int(self.tunables.get("straw_calc_version", 1)))
+        b.weight = sum(b.item_weights)
 
     @staticmethod
     def _build_tree(b: Bucket) -> None:
@@ -175,10 +247,8 @@ class CrushMap:
         """Recompute a bucket's aggregate/aux arrays after its items or
         item_weights changed (builder.c crush_bucket_adjust/remove paths)."""
         if b.alg == CRUSH_BUCKET_STRAW:
-            # the straws array would go stale (legacy straw recalculation
-            # is not implemented): refuse rather than corrupt placement
-            raise ValueError("straw(v1) buckets are load-only; convert to "
-                             "straw2 before mutating the map")
+            self._calc_straws(b)
+            return
         if b.alg == CRUSH_BUCKET_UNIFORM:
             b.weight = (b.item_weight or 0) * len(b.items)
             return
@@ -265,14 +335,17 @@ class CrushMap:
             self.max_devices = max(self.max_devices, item + 1)
 
     def remove_item(self, item: int) -> None:
-        """Detach an item from its parent and reweight the ancestry
+        """Detach an item from its parent(s) and reweight the ancestry
         (CrushWrapper::remove_item; buckets must be emptied first, like
-        the reference's non-recursive remove)."""
+        the reference's non-recursive remove).  A device is detached from
+        EVERY containing bucket — real and per-class shadow clones alike
+        — or a stale shadow entry would keep placing on it."""
         if item < 0 and item in self.buckets and self.buckets[item].items:
             raise ValueError(f"bucket {item} not empty; move or remove its "
                              f"items first")
-        parent = self.parent_of(item)
-        if parent is not None:
+        parents = [bid for bid, b in self.buckets.items()
+                   if item in b.items]
+        for parent in parents:
             pb = self.buckets[parent]
             self._ensure_item_weights(pb)
             idx = pb.items.index(item)
@@ -283,6 +356,11 @@ class CrushMap:
             self._propagate_weight(parent)
         if item < 0:
             self.buckets.pop(item, None)
+            for cb in self.class_bucket.values():
+                for c, sid in list(cb.items()):
+                    if sid == item:
+                        del cb[c]
+            self.class_bucket.pop(item, None)
         self.item_names.pop(item, None)
         self.device_classes.pop(item, None)
 
@@ -439,10 +517,14 @@ class CrushMap:
         return out
 
     def parent_of(self, item: int) -> int | None:
-        """Containing bucket id (None at a root)."""
-        for b in self.buckets.values():
-            if item in b.items:
-                return b.id
+        """Containing bucket id (None at a root).  Devices live in BOTH
+        the real hierarchy and any per-class shadow clones: the REAL
+        parent wins, unless the queried item is itself a shadow bucket
+        (whose parent is the enclosing shadow bucket)."""
+        want_shadow = item < 0 and self.is_shadow(item)
+        for bid, b in self.buckets.items():
+            if item in b.items and self.is_shadow(bid) == want_shadow:
+                return bid
         return None
 
     def get_full_location(self, item: int) -> dict[str, str]:
@@ -460,14 +542,141 @@ class CrushMap:
             loc[tname] = self.item_names.get(parent, str(parent))
             cur = parent
 
+    # -- device-class shadow trees (CrushWrapper.cc:2648) ------------------
+
+    def set_device_class(self, item: int, device_class: str) -> None:
+        """Assign a device's class (CrushWrapper::update_device_class).
+        Classes must be settled before shadow trees are cloned — a
+        reassignment would leave existing clones stale, so it is refused
+        (the reference rebuilds its shadow forest on the mon instead)."""
+        if item < 0:
+            raise ValueError("device classes apply to devices, not buckets")
+        if any(self.class_bucket.values()):
+            raise ValueError(
+                "device classes are fixed once shadow trees exist; "
+                "rebuild the map to reclassify")
+        self.device_classes[item] = device_class
+
+    def is_shadow(self, item: int) -> bool:
+        """Shadow (per-class clone) buckets carry the intentionally
+        invalid name '<orig>~<class>' (CrushWrapper::is_shadow_item,
+        CrushWrapper.h:583)."""
+        return "~" in self.item_names.get(item, "")
+
+    def nonshadow_roots(self) -> list[int]:
+        """Parentless buckets that are not per-class clones
+        (CrushWrapper::find_nonshadow_roots, CrushWrapper.h:624)."""
+        children = {i for b in self.buckets.values() for i in b.items
+                    if i < 0}
+        return sorted(b for b in self.buckets
+                      if b not in children and not self.is_shadow(b))
+
+    def device_class_clone(self, original_id: int,
+                           device_class: str) -> int:
+        """Clone ``original_id``'s subtree keeping only devices of
+        ``device_class`` (CrushWrapper::device_class_clone,
+        CrushWrapper.cc:2648 / CrushWrapper.h:1342).  The clone is named
+        '<orig>~<class>' (invalid on purpose), registered in
+        class_bucket, and carries per-class choose_args weight sets
+        derived from the original's.  Idempotent per (bucket, class)."""
+        existing = self.class_bucket.get(original_id, {}).get(device_class)
+        if existing is not None:
+            return existing
+        name = self.item_names.get(original_id)
+        if name is None:
+            raise KeyError(f"bucket {original_id} has no name; "
+                           f"name it before cloning per class")
+        copy_name = f"{name}~{device_class}"
+        for i, n in self.item_names.items():   # name_exists fast path
+            if n == copy_name:
+                self.class_bucket.setdefault(
+                    original_id, {})[device_class] = i
+                return i
+        orig = self.buckets[original_id]
+        self._ensure_item_weights(orig)
+        items: list[int] = []
+        weights: list[int] = []
+        orig_pos: list[int] = []               # new item pos -> orig pos
+        for i, item in enumerate(orig.items):
+            if item >= 0:
+                if self.device_classes.get(item) != device_class:
+                    continue
+                w = (orig.item_weights[i] if orig.item_weights is not None
+                     else (orig.item_weight or 0))
+            else:
+                item = self.device_class_clone(item, device_class)
+                w = self.buckets[item].weight
+            items.append(item)
+            weights.append(w)
+            orig_pos.append(i)
+        hint = self._shadow_id_hints.get((original_id, device_class))
+        if orig.alg == CRUSH_BUCKET_UNIFORM:
+            sid = self.add_bucket(orig.alg, orig.type, items, id=hint,
+                                  uniform_weight=orig.item_weight)
+        else:
+            sid = self.add_bucket(orig.alg, orig.type, items, weights,
+                                  id=hint)
+        self.buckets[sid].hash = orig.hash
+        self.item_names[sid] = copy_name
+        self.class_bucket.setdefault(original_id, {})[device_class] = sid
+        # per-class choose_args: device entries keep their original
+        # positional weights; child-clone entries contribute the SUM of
+        # their own cloned weight set per position (the reference's
+        # cmap_item_weight bookkeeping, CrushWrapper.cc:2735-2773)
+        for args in self.choose_args.values():
+            oarg = args.get(original_id)
+            ws = (oarg or {}).get("weight_set")
+            if not ws:
+                continue
+            new_ws = []
+            for s, row in enumerate(ws):
+                new_row = []
+                for p, item in zip(orig_pos, items):
+                    if item >= 0:
+                        new_row.append(row[p])
+                    else:
+                        carg = args.get(item)
+                        cws = (carg or {}).get("weight_set")
+                        new_row.append(sum(cws[s]) if cws
+                                       else self.buckets[item].weight)
+                new_ws.append(new_row)
+            args[sid] = {"weight_set": new_ws}
+        return sid
+
+    def populate_classes(self) -> int:
+        """Clone every non-shadow root for every device class in use
+        (CrushWrapper::populate_classes, CrushWrapper.h:1350).  Returns
+        the number of clones created."""
+        classes = sorted(set(self.device_classes.values()))
+        made = 0
+        for root in self.nonshadow_roots():
+            for c in classes:
+                before = self.class_bucket.get(root, {}).get(c)
+                if before is None:
+                    self.device_class_clone(root, c)
+                    made += 1
+        return made
+
+    def take_with_class(self, root_name: str, device_class: str) -> int:
+        """Resolve 'take <root> class <c>' to the shadow bucket id,
+        cloning on first use (what the reference's rule-creation paths do
+        via class_bucket lookups)."""
+        root = self.item_id(root_name)
+        if not device_class:
+            return root
+        if device_class not in set(self.device_classes.values()):
+            raise ValueError(
+                f"device class {device_class!r} is not assigned to any "
+                f"device (EINVAL, like CrushWrapper::add_simple_rule)")
+        return self.device_class_clone(root, device_class)
+
     def add_simple_rule(self, name: str, root_name: str,
                         failure_domain: str, device_class: str = "",
                         mode: str = "firstn", num_rep: int = 0) -> int:
         """CrushWrapper::add_simple_rule semantics (CrushWrapper.h; used by
-        ErasureCode::create_rule with mode='indep', ErasureCode.cc:64-83)."""
-        if device_class:
-            raise NotImplementedError("device classes: shadow trees TBD")
-        root = self.item_id(root_name)
+        ErasureCode::create_rule with mode='indep', ErasureCode.cc:64-83).
+        With ``device_class`` the rule takes the per-class shadow tree."""
+        root = self.take_with_class(root_name, device_class)
         steps = [(CRUSH_RULE_TAKE, root, 0)]
         if failure_domain == "osd" or failure_domain == "":
             op = (CRUSH_RULE_CHOOSE_INDEP if mode == "indep"
@@ -515,6 +724,9 @@ class CrushMap:
         if d.get("device_classes"):
             m.device_classes = {int(i): c
                                 for i, c in d["device_classes"].items()}
+        if d.get("class_bucket"):
+            m.class_bucket = {int(i): dict(cb)
+                              for i, cb in d["class_bucket"].items()}
         for sid, args in d.get("choose_args", {}).items():
             m.choose_args[int(sid)] = {int(bid): arg
                                        for bid, arg in args.items()}
@@ -550,6 +762,9 @@ class CrushMap:
         if self.device_classes:
             d["device_classes"] = {str(i): c
                                    for i, c in self.device_classes.items()}
+        if self.class_bucket:
+            d["class_bucket"] = {str(i): dict(cb)
+                                 for i, cb in self.class_bucket.items()}
         if self.choose_args:
             d["choose_args"] = {
                 str(sid): {str(bid): arg for bid, arg in args.items()}

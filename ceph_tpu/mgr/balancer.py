@@ -54,7 +54,12 @@ def osd_deviation(m: OSDMap, pools: list[int] | None = None,
 
 def _host_of(m: OSDMap) -> dict[int, int]:
     host = {}
-    for b in m.crush.buckets.values():
+    for bid, b in m.crush.buckets.items():
+        # shadow (per-class clone) hosts must not register as separate
+        # physical hosts, or the upmap host-separation check would let
+        # two replicas share one real host
+        if m.crush.is_shadow(bid):
+            continue
         if m.crush.type_names.get(b.type) == "host":
             for item in b.items:
                 if item >= 0:

@@ -145,11 +145,13 @@ class ErasureCodeLrc(ErasureCode):
         from ..crush.map import (CRUSH_RULE_CHOOSELEAF_INDEP,
                                  CRUSH_RULE_CHOOSE_INDEP, CRUSH_RULE_EMIT,
                                  CRUSH_RULE_TAKE)
-        if self.rule_device_class:
-            raise NotImplementedError("device classes: shadow trees TBD")
         if name in crush.rule_names:
             raise ValueError(f"rule {name!r} already exists")
-        steps = [(CRUSH_RULE_TAKE, crush.item_id(self.rule_root), 0)]
+        # crush-device-class routes the take through the per-class shadow
+        # tree (ErasureCodeLrc.cc create_rule -> CrushWrapper class take)
+        root = crush.take_with_class(self.rule_root,
+                                     self.rule_device_class)
+        steps = [(CRUSH_RULE_TAKE, root, 0)]
         for op, type_, n in self.rule_steps:
             if op == "choose":
                 opcode = CRUSH_RULE_CHOOSE_INDEP

@@ -25,8 +25,11 @@ def render_osd_tree(cluster) -> str:
     REWEIGHT is the osdmap 16.16 override — the reference's two columns."""
     cmap = cluster.osdmap.crush
     lines = ["ID    WEIGHT    REWEIGHT  TYPE NAME                 STATUS"]
+    # shadow (per-class clone) trees stay hidden, like the reference's
+    # 'osd tree' without --show-shadow (CrushWrapper find_nonshadow_roots)
     roots = [bid for bid in cmap.buckets
-             if not any(bid in b.items for b in cmap.buckets.values())]
+             if not any(bid in b.items for b in cmap.buckets.values())
+             and not cmap.is_shadow(bid)]
 
     def walk(item: int, depth: int, crush_w: float) -> None:
         indent = "    " * depth
@@ -67,6 +70,8 @@ def render_pg_dump(cluster) -> str:
 
 
 def main(argv=None) -> int:
+    from ..utils.platform import honour_jax_platforms_env
+    honour_jax_platforms_env()   # axon sitecustomize override
     # '-s' is the classic status alias; argparse would eat it as an
     # unknown option before the positional, so translate it up front
     argv = ["status" if a == "-s" else a

@@ -125,6 +125,8 @@ def _rule_max_reps(cmap: CrushMap, ruleno: int) -> int:
 
 
 def main(argv=None) -> int:
+    from ..utils.platform import honour_jax_platforms_env
+    honour_jax_platforms_env()   # axon sitecustomize override
     ap = argparse.ArgumentParser(prog="crushtool",
                                  description=__doc__.splitlines()[0])
     ap.add_argument("-i", "--in", dest="infile", required=True,

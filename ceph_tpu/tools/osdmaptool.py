@@ -110,6 +110,8 @@ def test_map_pgs(m: OSDMap, pool: int = -1, dump: bool = False,
 
 
 def main(argv=None) -> int:
+    from ..utils.platform import honour_jax_platforms_env
+    honour_jax_platforms_env()   # axon sitecustomize override
     ap = argparse.ArgumentParser(
         prog="osdmaptool", description=__doc__.splitlines()[0])
     ap.add_argument("mapfile", help="OSDMap as JSON (OSDMap.to_dict)")

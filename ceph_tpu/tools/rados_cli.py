@@ -19,6 +19,8 @@ import sys
 
 
 def main(argv=None) -> int:
+    from ..utils.platform import honour_jax_platforms_env
+    honour_jax_platforms_env()   # axon sitecustomize override
     ap = argparse.ArgumentParser(prog="rados")
     ap.add_argument("--data-dir", required=True,
                     help="durable cluster directory")

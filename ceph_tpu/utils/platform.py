@@ -1,0 +1,24 @@
+"""Honour JAX_PLATFORMS in environments whose sitecustomize overrides it.
+
+The axon dev environment installs a sitecustomize that forces
+``jax.config.jax_platforms = "axon,cpu"`` — overriding the caller's
+``JAX_PLATFORMS=cpu`` env var — so any tool that merely imports jax will
+dial the TPU tunnel on first backend init.  The tunnel has multi-hour
+outages where init HANGS (not fails), turning every CLI invocation into a
+wedge.  Call :func:`honour_jax_platforms_env` before first device use in
+every entry point (the test conftest and ``__graft_entry__`` already do
+the equivalent inline).
+"""
+from __future__ import annotations
+
+import os
+
+
+def honour_jax_platforms_env() -> None:
+    """If JAX_PLATFORMS is set, force jax.config to agree with it."""
+    want = os.environ.get("JAX_PLATFORMS", "")
+    if not want:
+        return
+    import jax
+    if jax.config.jax_platforms != want:
+        jax.config.update("jax_platforms", want)

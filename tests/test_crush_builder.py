@@ -157,3 +157,118 @@ class TestPlacementAfterSurgery:
         for x in range(32):
             assert crush_do_rule(m, ruleno, x, 3) == \
                 crush_do_rule(m2, ruleno, x, 3)
+
+
+class TestStrawV1Construction:
+    """crush_calc_straw parity (builder.c:427): straw(v1) buckets BUILT
+    here must carry the same straw lengths — and place identically — as
+    the reference-built bucket in the golden dump (closes the r4
+    'straw maps load-only' partial)."""
+
+    def _golden_straw(self):
+        import json
+        import pathlib
+        d = json.loads((pathlib.Path(__file__).parent / "golden" /
+                        "crush_golden.json").read_text())
+        for g in d["groups"]:
+            for run in g.get("runs", []):
+                if run["name"] == "alg_straw":
+                    return g["map"], run
+        raise AssertionError("alg_straw group missing from golden dump")
+
+    def test_straws_match_reference_builder(self):
+        from ceph_tpu.crush.map import CRUSH_BUCKET_STRAW
+        gmap, _run = self._golden_straw()
+        gb = next(b for b in gmap["buckets"]
+                  if b["alg"] == CRUSH_BUCKET_STRAW)
+        # crush_create() starts at straw_calc_version=0 (builder.c:1506)
+        m = CrushMap(tunables=dict(gmap["tunables"],
+                                   straw_calc_version=0))
+        bid = m.add_bucket(CRUSH_BUCKET_STRAW, gb["type"],
+                           list(gb["items"]), list(gb["item_weights"]))
+        built = m.buckets[bid]
+        assert built.straws == list(gb["straws"])
+        assert built.weight == gb["weight"]
+        # v1 agrees on all-distinct weights (the golden case)
+        m1 = CrushMap(tunables=dict(gmap["tunables"],
+                                    straw_calc_version=1))
+        bid1 = m1.add_bucket(CRUSH_BUCKET_STRAW, gb["type"],
+                             list(gb["items"]), list(gb["item_weights"]))
+        assert m1.buckets[bid1].straws == built.straws
+
+    def test_built_straw_map_places_like_golden(self):
+        from ceph_tpu.crush.map import CRUSH_BUCKET_STRAW
+        from ceph_tpu.crush import CRUSH_RULE_CHOOSE_FIRSTN
+        gmap, run = self._golden_straw()
+        gb = next(b for b in gmap["buckets"]
+                  if b["alg"] == CRUSH_BUCKET_STRAW)
+        m = CrushMap(tunables=dict(gmap["tunables"],
+                                   straw_calc_version=0))
+        root = m.add_bucket(CRUSH_BUCKET_STRAW, gb["type"],
+                            list(gb["items"]), list(gb["item_weights"]))
+        ruleno = m.add_rule([(CRUSH_RULE_TAKE, root, 0),
+                             (CRUSH_RULE_CHOOSE_FIRSTN, 3, 0),
+                             (CRUSH_RULE_EMIT, 0, 0)])
+        m.finalize()
+        for x, want in enumerate(run["results"]):   # x = 0..NX-1
+            got = crush_do_rule(m, ruleno, x, run["result_max"],
+                                weights=list(run["weights"]))
+            assert got == want, (x, got, want)
+
+    def test_straw_bucket_mutable(self):
+        """Surgery recomputes straws (the old code refused to mutate)."""
+        from ceph_tpu.crush.map import CRUSH_BUCKET_STRAW
+        m = CrushMap(tunables={"straw_calc_version": 1})
+        m.set_type_name(1, "host")
+        bid = m.add_bucket(CRUSH_BUCKET_STRAW, 1, [0, 1, 2],
+                           [0x10000, 0x20000, 0x30000])
+        before = list(m.buckets[bid].straws)
+        m.insert_item(3, 0x18000, bid)
+        after = m.buckets[bid].straws
+        assert len(after) == 4 and after != before
+        # straws for a rebuilt identical set are reproducible
+        m2 = CrushMap(tunables={"straw_calc_version": 1})
+        m2.set_type_name(1, "host")
+        b2 = m2.add_bucket(CRUSH_BUCKET_STRAW, 1, [0, 1, 2, 3],
+                           [0x10000, 0x20000, 0x30000, 0x18000])
+        assert m2.buckets[b2].straws == after
+
+    def test_v0_dump_with_repeated_weights_round_trips_text(self):
+        """A reference-style dump (straws computed at v0, tunable absent)
+        with REPEATED weights must round-trip through text: decompile
+        detects the version that reproduces the stored straws and pins
+        it as a tunable (regression: recompile silently rebuilt straws
+        at v1, diverging placements)."""
+        from ceph_tpu.crush import compile_crushmap, decompile
+        from ceph_tpu.crush.map import CRUSH_BUCKET_STRAW, calc_straw_lengths
+        weights = [0x10000, 0x10000, 0x30000, 0x20000, 0x20000]
+        assert calc_straw_lengths(weights, 0) != \
+            calc_straw_lengths(weights, 1)     # the split really shows
+        m0 = CrushMap(tunables={"straw_calc_version": 0})
+        m0.set_type_name(1, "host")
+        bid = m0.add_bucket(CRUSH_BUCKET_STRAW, 1, [0, 1, 2, 3, 4],
+                            weights)
+        m0.set_item_name(bid, "r")
+        m0.finalize()
+        # simulate a loaded reference dump: straws as data, no tunable
+        d = m0.to_dict()
+        d["tunables"].pop("straw_calc_version", None)
+        loaded = CrushMap.from_dict(d)
+        m2 = compile_crushmap(decompile(loaded))
+        assert m2.buckets[bid].straws == m0.buckets[bid].straws
+
+    def test_corrupt_straws_refuse_text(self):
+        """Straws matching NO calc version must refuse decompile rather
+        than silently re-derive different placements."""
+        from ceph_tpu.crush import decompile
+        from ceph_tpu.crush.map import CRUSH_BUCKET_STRAW
+        m = CrushMap(tunables={"straw_calc_version": 1})
+        m.set_type_name(1, "host")
+        bid = m.add_bucket(CRUSH_BUCKET_STRAW, 1, [0, 1, 2],
+                           [0x10000, 0x20000, 0x30000])
+        m.set_item_name(bid, "r")
+        m.buckets[bid].straws[1] ^= 0x5555     # corrupt
+        d = m.to_dict()
+        d["tunables"].pop("straw_calc_version")
+        with pytest.raises(ValueError, match="straw_calc_version"):
+            decompile(CrushMap.from_dict(d))

@@ -340,6 +340,53 @@ int main(int argc, char **argv) {
         }
     }
 
+    /* ---- device-class shadow tree (hand-built per CrushWrapper
+     * device_class_clone semantics: per-class clone buckets holding only
+     * the matching devices at their original weights, child clones at
+     * their recomputed weights; CrushWrapper.cc:2648).  The python side
+     * builds the FULL mixed map, calls device_class_clone, and must
+     * place bit-identically to this reference-built shadow hierarchy. */
+    {
+        struct crush_map *map = crush_create();
+        /* 4 hosts x 2 devices (even=ssd, odd=hdd), weights 1+d%3 */
+        int full_hosts[4], ssd_hosts[4];
+        for (int h = 0; h < 4; h++) {
+            int items[2], weights[2];
+            for (int i = 0; i < 2; i++) {
+                items[i] = h * 2 + i;
+                weights[i] = 0x10000 * (1 + (h * 2 + i) % 3);
+            }
+            full_hosts[h] = add_bucket(map, CRUSH_BUCKET_STRAW2, 1, 2,
+                                       items, weights);
+        }
+        int fw[4];
+        for (int h = 0; h < 4; h++) fw[h] = map->buckets[-1-full_hosts[h]]->weight;
+        int full_root = add_bucket(map, CRUSH_BUCKET_STRAW2, 2, 4,
+                                   full_hosts, fw);
+        (void)full_root;
+        /* the ssd shadow: one device (the even one) per host */
+        for (int h = 0; h < 4; h++) {
+            int items[1] = {h * 2};
+            int weights[1] = {0x10000 * (1 + (h * 2) % 3)};
+            ssd_hosts[h] = add_bucket(map, CRUSH_BUCKET_STRAW2, 1, 1,
+                                      items, weights);
+        }
+        int sw[4];
+        for (int h = 0; h < 4; h++) sw[h] = map->buckets[-1-ssd_hosts[h]]->weight;
+        int ssd_root = add_bucket(map, CRUSH_BUCKET_STRAW2, 2, 4,
+                                  ssd_hosts, sw);
+        struct crush_rule *r = crush_make_rule(3, 0, 3, 1, 10);
+        crush_rule_set_step(r, 0, CRUSH_RULE_TAKE, ssd_root, 0);
+        crush_rule_set_step(r, 1, CRUSH_RULE_CHOOSELEAF_INDEP, 3, 1);
+        crush_rule_set_step(r, 2, CRUSH_RULE_EMIT, 0, 0);
+        int ruleno = crush_add_rule(map, r, -1);
+        begin_group(map);
+        __u32 w[8];
+        for (int i = 0; i < 8; i++) w[i] = 0x10000;
+        run_rule(map, ruleno, NX, w, 8, 3, "class_shadow_ssd");
+        end_group(map);
+    }
+
     /* ---- indep holes: numrep > healthy items ------------------------- */
     {
         struct crush_map *map = crush_create();
