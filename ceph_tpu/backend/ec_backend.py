@@ -100,10 +100,16 @@ class ECBackend(PGBackend):
             # missing primary copy poisons scrub/size for the whole
             # object (fresh version-0 hinfo marks every shard stale).
             # Stale revived shards are excluded: their hinfo may predate
-            # writes they missed (current_shards() semantics).
+            # writes they missed (current_shards() semantics).  That
+            # applies to the PRIMARY'S OWN copy too — while it is stale
+            # (repairing itself), current peers are the authority and the
+            # local attr is consulted last.
             peers = [s for s in self.acting if s != self.whoami
                      and s in self.current_shards()]
-            for shard in [self.whoami] + peers:
+            local_current = self.whoami in self.current_shards()
+            order = ([self.whoami] + peers if local_current
+                     else peers + [self.whoami])
+            for shard in order:
                 if shard not in self.bus.handlers:
                     continue
                 try:
@@ -619,6 +625,11 @@ class ECBackend(PGBackend):
                  if s in self.current_shards()
                  and c not in rop.missing_shards}
         minimum = self.ec_impl.minimum_to_decode(rop.missing_shards, avail)
+        # recovery must size its reads from the FRESHEST authoritative
+        # hinfo: a cached entry may be an empty placeholder from a moment
+        # when no source had applied the object yet (reordered delivery),
+        # and sizing reads at 0 would reconstruct an empty object
+        self.hinfo_cache.pop(rop.oid, None)
         hinfo = self._hinfo(rop.oid)
         c_len = hinfo.get_total_chunk_size()
         # VERIFIED recovery: when the hinfo hashes are gone (overwrites
@@ -641,11 +652,21 @@ class ECBackend(PGBackend):
             shard = self.acting[chunk]
             runs = None if subchunks == [(0, self.ec_impl.get_sub_chunk_count())] \
                 else subchunks
-            per_shard.setdefault(shard, {})[rop.oid] = [(0, c_len, runs)]
+            # c_len 0 = NO source has the hinfo yet (every copy of this
+            # object is mid-flight or missing): read whole chunks rather
+            # than 0 bytes — the payload step re-derives the size from a
+            # source's attrs or the actual read lengths
+            per_shard.setdefault(shard, {})[rop.oid] = [
+                (0, c_len if c_len else None, runs)]
         rop._pending = set(per_shard)
+        # the replicated attr set (object_info, snapset, user xattrs —
+        # identical on every shard) must come from a CURRENT source: the
+        # local copy is the right fallback only while the primary itself
+        # is current, and when repairing the primary's own stale shard it
+        # is exactly the copy that missed the latest attrs
         for shard, to_read in per_shard.items():
             self.bus.send(shard, ECSubRead(
-                self.whoami, rop.read_tid, to_read,
+                self.whoami, rop.read_tid, to_read, attrs_to_read={"*"},
                 sub_chunk_count=self.ec_impl.get_sub_chunk_count()))
 
     def _recovery_push_payloads(self, rop: RecoveryOp
@@ -656,6 +677,28 @@ class ECBackend(PGBackend):
         available = {c: np.frombuffer(v, dtype=np.uint8)
                      for c, v in rop._read_results.items()}
         hinfo = self._hinfo(rop.oid)
+        if not hinfo.get_total_chunk_size():
+            # the local/cached hinfo never saw this object: adopt a
+            # current SOURCE's hinfo (replicated on every shard) so the
+            # reconstruction and the pushed attr carry the true size
+            peer_base = next((a for _c, a in sorted(rop._read_attrs.items())
+                              if a and HINFO_KEY in a), None)
+            if peer_base is not None:
+                d = peer_base[HINFO_KEY]
+                nh = HashInfo(self.ec_impl.get_chunk_count())
+                nh.total_chunk_size = d["total_chunk_size"]
+                nh.cumulative_shard_hashes = list(
+                    d["cumulative_shard_hashes"])
+                nh.projected_total_chunk_size = nh.total_chunk_size
+                nh.version = d.get("version", 0)
+                self.hinfo_cache[rop.oid] = hinfo = nh
+            elif available:
+                # last resort: size from the bytes actually read
+                nh = HashInfo(self.ec_impl.get_chunk_count())
+                nh.total_chunk_size = max(len(v) for v in
+                                          available.values())
+                nh.projected_total_chunk_size = nh.total_chunk_size
+                hinfo = nh
         k = self.ec_impl.get_data_chunk_count()
         if hinfo.has_chunk_hash() and \
                 self.ec_impl.get_sub_chunk_count() == 1:
@@ -697,17 +740,23 @@ class ECBackend(PGBackend):
                             chunk_size=hinfo.get_total_chunk_size())
         # pushes REPLACE the target object, so the replicated attrs
         # (user xattrs, object_info, snapset — identical on every shard)
-        # must travel too, from the primary's authoritative copy;
-        # without them, repairing a located rotten source would WIPE the
-        # xattrs that shard held correctly
+        # must travel too, from a CURRENT copy; without them, repairing a
+        # located rotten source would WIPE the xattrs that shard held
+        # correctly.  Prefer a recovery-read source's attrs (sources are
+        # current by construction — the local copy is stale exactly when
+        # the primary's own shard is the one being repaired); each
+        # source's shard-specific hinfo is stripped.
         attrs = {HINFO_KEY: hinfo.to_dict()}
-        try:
-            base = self.local_shard.store.getattrs(
-                GObject(rop.oid, self.whoami))
-            attrs = {**{a: v for a, v in base.items() if a != HINFO_KEY},
-                     **attrs}
-        except FileNotFoundError:
-            pass
+        base = next((a for _c, a in sorted(rop._read_attrs.items())
+                     if a), None)
+        if base is None:
+            try:
+                base = self.local_shard.store.getattrs(
+                    GObject(rop.oid, self.whoami))
+            except FileNotFoundError:
+                base = {}
+        attrs = {**{a: v for a, v in base.items() if a != HINFO_KEY},
+                 **attrs}
         return {chunk: (bytes(rec[chunk]), dict(attrs), None, b"")
                 for chunk in rop.missing_shards}
 

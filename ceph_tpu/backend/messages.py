@@ -213,6 +213,127 @@ class FaultConfig:
     drop_prob: float = 0.0
 
 
+@dataclass
+class PGEnvelope:
+    """Cluster-bus wrapper routing a PG-scoped message to the right PG on
+    the destination OSD — the analog of the spg_t every reference OSD
+    message carries for dispatch (src/osd/OSD.cc ms_fast_dispatch).
+    ``from_shard`` mirrors the inner message's so reorder fault injection
+    keeps per-sender FIFO semantics."""
+    pgid: object
+    msg: object
+    from_shard: int | None = None
+
+
+class OSDEndpoint:
+    """ONE bus registration per OSD: demuxes PGEnvelopes to the per-PG
+    channels hosted on this OSD (the reference OSD's single messenger
+    endpoint feeding many PGs)."""
+
+    def __init__(self, osd: int):
+        self.osd = osd
+        self.pg_channels: dict = {}       # pgid -> PGChannel
+
+    def handle_message(self, msg) -> None:
+        if not isinstance(msg, PGEnvelope):
+            raise TypeError(
+                f"OSD endpoint {self.osd} got non-enveloped {type(msg)}")
+        ch = self.pg_channels.get(msg.pgid)
+        if ch is None:
+            return           # PG deleted/moved: drop, like an unknown spg_t
+        handler = ch.handlers.get(self.osd)
+        if handler is not None:
+            handler.handle_message(msg.msg)
+
+
+class PGChannel:
+    """A PG's view of the shared cluster bus.
+
+    Exposes the MessageBus surface the PG backends use (send/register/
+    handlers/down/mark_*/deliver_*/listeners/fault injection) while the
+    actual queues, down-set, and delivery loop live on ONE cluster-wide
+    MessageBus with one OSDEndpoint per OSD — the reference's topology
+    (one messenger per OSD, many PGs behind it).  Down/up are OSD-wide:
+    killing an OSD affects every PG it serves, exactly like a real death.
+    """
+
+    def __init__(self, bus: MessageBus, pgid):
+        self.bus = bus
+        self.pgid = pgid
+        self.handlers: dict[int, object] = {}   # this PG's shard handlers
+
+    def register(self, shard: int, handler) -> None:
+        self.handlers[shard] = handler
+        ep = self.bus.handlers.get(shard)
+        if not isinstance(ep, OSDEndpoint):
+            ep = OSDEndpoint(shard)
+            self.bus.register(shard, ep)
+        ep.pg_channels[self.pgid] = self
+
+    def unregister_all(self) -> None:
+        """Drop this PG from every OSD endpoint (PG teardown)."""
+        for ep in self.bus.handlers.values():
+            if isinstance(ep, OSDEndpoint):
+                ep.pg_channels.pop(self.pgid, None)
+
+    def send(self, to_shard: int, msg) -> None:
+        self.bus.send(to_shard, PGEnvelope(
+            self.pgid, msg, getattr(msg, "from_shard", None)))
+
+    # -- delegation to the shared bus ---------------------------------------
+
+    @property
+    def down(self) -> set[int]:
+        return self.bus.down
+
+    def mark_down(self, shard: int) -> None:
+        self.bus.mark_down(shard)
+
+    def mark_up(self, shard: int) -> None:
+        self.bus.mark_up(shard)
+
+    def deliver_one(self, shard: int) -> bool:
+        return self.bus.deliver_one(shard)
+
+    def deliver_all(self, max_rounds: int = 10000) -> int:
+        return self.bus.deliver_all(max_rounds)
+
+    def inject_faults(self, cfg) -> None:
+        self.bus.inject_faults(cfg)
+
+    @property
+    def down_listeners(self) -> list:
+        return self.bus.down_listeners
+
+    @property
+    def up_listeners(self) -> list:
+        return self.bus.up_listeners
+
+    @property
+    def queues(self):
+        return self.bus.queues
+
+    @property
+    def wire(self) -> bool:
+        return self.bus.wire
+
+    @property
+    def wire_secret(self):
+        return self.bus.wire_secret
+
+    @property
+    def delivered(self) -> int:
+        return self.bus.delivered
+
+    @property
+    def dropped(self) -> int:
+        return self.bus.dropped
+
+    @property
+    def duplicated(self) -> int:
+        return self.bus.duplicated
+
+
 class MessageBus:
     """Per-shard FIFO queues; handlers registered per shard id.
 
@@ -236,6 +357,11 @@ class MessageBus:
         # osdmap epoch bump reaching each OSD after heartbeats report it
         self.down_listeners: list = []
         self.up_listeners: list = []
+        # called at the top of deliver_all: the cluster hooks its daemon
+        # op-queue drains here so "deliver everything" includes client
+        # ops parked on live daemons (e.g. queued while their OSD was
+        # down), matching the pre-shared-bus progress guarantees
+        self.pre_deliver_hooks: list = []
         self._faults: FaultConfig | None = None
         self._fault_rng = None
 
@@ -252,7 +378,11 @@ class MessageBus:
 
     def mark_down(self, shard: int) -> None:
         """Drop the shard: pending + future messages to it vanish (a dead
-        OSD's socket resets; the reference learns via heartbeats+osdmap)."""
+        OSD's socket resets; the reference learns via heartbeats+osdmap).
+        Edge-triggered: marking an already-down shard is a no-op, so the
+        per-PG fan-out over a shared bus fires listeners exactly once."""
+        if shard in self.down:
+            return
         self.down.add(shard)
         if shard in self.queues:
             self.queues[shard].clear()
@@ -260,6 +390,8 @@ class MessageBus:
             cb(shard)
 
     def mark_up(self, shard: int) -> None:
+        if shard not in self.down:
+            return
         self.down.discard(shard)
         for cb in self.up_listeners:
             cb(shard)
@@ -325,6 +457,8 @@ class MessageBus:
         """Drain every queue to quiescence; returns messages delivered."""
         n = 0
         for _ in range(max_rounds):
+            for hook in self.pre_deliver_hooks:
+                hook()
             progressed = False
             for shard in list(self.queues):
                 while self.deliver_one(shard):

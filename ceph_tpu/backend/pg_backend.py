@@ -289,10 +289,17 @@ class OSDShard:
                         bufs.append((off, data))
                     reply.buffers_read[oid] = bufs
                     if msg.attrs_to_read:
-                        reply.attrs_read[oid] = {
-                            a: self.store.getattr(obj, a)
-                            for a in msg.attrs_to_read
-                            if a in self.store.objects[obj].xattrs}
+                        xat = self.store.objects[obj].xattrs
+                        if "*" in msg.attrs_to_read:
+                            # recovery wants the FULL replicated attr set
+                            # (object_info, snapset, user xattrs): pushes
+                            # REPLACE the target object, so partial attr
+                            # reads would wipe whatever isn't carried
+                            reply.attrs_read[oid] = dict(xat)
+                        else:
+                            reply.attrs_read[oid] = {
+                                a: self.store.getattr(obj, a)
+                                for a in msg.attrs_to_read if a in xat}
                     if msg.include_omap:
                         reply.omap_read[oid] = (
                             self.store.get_omap(obj),
@@ -588,7 +595,11 @@ class PGBackend:
     def is_active(self) -> bool:
         """Writes proceed only while >= min_size current shards exist (the
         PG-active gate of PeeringState; below it client writes park in
-        waiting_state until shards return — never acked, never lost)."""
+        waiting_state until shards return — never acked, never lost).
+        NOTE: a bus-down primary is gated at the DAEMON dispatch layer
+        (a dead OSD accepts no client ops), not here — the backend
+        coordinator running with its own shard down is a legitimate
+        divergence scenario (it commits on peers and self-repairs)."""
         return len(self.current_shards()) >= self.min_size
 
     # -- message dispatch --------------------------------------------------

@@ -232,9 +232,13 @@ class ReplicatedBackend(PGBackend):
             raise IOError("no current source for replicated recovery")
         src_shard = self.acting[sources[0]]
         rop._pending = {src_shard}
+        # "*": the push replaces the whole object, so EVERY xattr must
+        # travel (a {VERSION_KEY}-only read once pushed attr-less objects
+        # — invisible while only never-read replicas were repaired, data
+        # loss once the shared-bus topology started repairing primaries)
         self.bus.send(src_shard, ECSubRead(
             self.whoami, rop.read_tid,
-            {rop.oid: [(0, None)]}, attrs_to_read={VERSION_KEY},
+            {rop.oid: [(0, None)]}, attrs_to_read={"*"},
             include_omap=True))
 
     def _recovery_push_payloads(self, rop: RecoveryOp):

@@ -46,18 +46,28 @@ class BlockedWriteError(IOError):
 
 
 class PGGroup:
-    """One placement group: primary backend + shard OSDs on its own bus."""
+    """One placement group: primary backend + shard OSDs.
+
+    With ``bus`` (a cluster-wide MessageBus), the PG talks through a
+    :class:`~ceph_tpu.backend.messages.PGChannel` — one endpoint per OSD
+    on ONE shared bus, the reference's messenger topology.  Without it
+    (standalone/unit use) the PG gets a private bus as before."""
 
     def __init__(self, pgid: PG, acting: list[int], ec_impl,
                  chunk_size: int, cct, name_prefix: str,
-                 min_size: int = 0, store_factory=None, epoch: int = 0):
+                 min_size: int = 0, store_factory=None, epoch: int = 0,
+                 bus: MessageBus | None = None):
         self.pgid = pgid
         self.acting = acting
         # map epoch this acting set was established at: ops stamped with
         # an older epoch by a stale client get rejected (the OSD's
         # require_same_or_newer_map check, src/osd/OSD.cc)
         self.epoch = epoch
-        self.bus = MessageBus()
+        if bus is None:
+            self.bus = MessageBus()
+        else:
+            from .backend.messages import PGChannel
+            self.bus = PGChannel(bus, f"{name_prefix}.{pgid}")
         primary = acting[0]
         mk = store_factory if store_factory is not None else lambda osd: None
         # name is unique across PGs sharing a primary AND across clusters
@@ -103,7 +113,9 @@ class PGGroup:
 
     def shutdown(self, discard_stores: bool = False) -> None:
         # closes the primary's store too; discard skips the final
-        # checkpoint when the directories are about to be deleted
+        # checkpoint when the directories are about to be deleted.
+        # (Collections over a shared per-OSD store close as no-ops — the
+        # daemon owns that store's lifecycle.)
         name = self.backend.instance_name
         for cmd in (f"dump_watchers.{name}", f"peering_history.{name}"):
             self.backend.cct.admin_socket.unregister(cmd)
@@ -112,6 +124,8 @@ class PGGroup:
             if isinstance(h, OSDShard) and h is not self.backend.local_shard \
                     and hasattr(h.store, "close"):
                 h.store.close(checkpoint=not discard_stores)
+        if hasattr(self.bus, "unregister_all"):
+            self.bus.unregister_all()
 
 
 class MiniCluster:
@@ -155,11 +169,21 @@ class MiniCluster:
         # next deliver_all() surfaces them (raising from inside the
         # daemon drain would strand the rest of the queue)
         self._deferred_errors: list[tuple[str, int, str]] = []
-        # one daemon shell per OSD: sharded mClock op queue + superblock
-        # (client ops route through the primary's daemon — OSD.cc:9490)
+        # ONE cluster-wide message bus: each OSD registers a single
+        # endpoint that demuxes PG-enveloped traffic to its hosted PGs —
+        # the reference's one-messenger-per-OSD topology
+        self.bus = MessageBus()
+        self.bus.pre_deliver_hooks.append(self._drain_live_daemons)
+        # one daemon shell per OSD: sharded mClock op queue + superblock,
+        # and ONE ObjectStore hosting every PG shard on that OSD as
+        # collections (OSD.cc:3971 load_pgs iterates one store)
         from .osd.osd_daemon import OSDDaemon
-        self.osds = {o: OSDDaemon(o, meta_store=self._osd_meta_store(o))
-                     for o in range(n_osds)}
+        self.osds = {}
+        for o in range(n_osds):
+            st = self._osd_store(o)
+            d = OSDDaemon(o, meta_store=st)
+            d.store = st
+            self.osds[o] = d
 
     # -- pool creation (the mon's osd pool create path) --------------------
 
@@ -231,7 +255,8 @@ class MiniCluster:
                               min_size=pool.min_size,
                               store_factory=self._store_factory(
                                   pool.pool_id, ps),
-                              epoch=self.osdmap.epoch)
+                              epoch=self.osdmap.epoch,
+                              bus=self.bus)
             self.osds[acting[0]].register_pg(pgid, pgs[ps])
         self.pools[pool.pool_id] = {"pool": pool, "pgs": pgs, "ec": ec}
         self.pool_ids[name] = pool.pool_id
@@ -240,22 +265,33 @@ class MiniCluster:
 
     # -- durability (data_dir mode) ----------------------------------------
 
+    def _drain_live_daemons(self) -> None:
+        """Run every live OSD's queued client ops (dead OSDs stay
+        parked); hooked into the shared bus's deliver_all so 'deliver
+        everything' includes daemon queues."""
+        for osd, daemon in self.osds.items():
+            if osd not in self.bus.down:
+                daemon.drain()
+
     def _store_factory(self, pool_id: int, ps: int):
-        if self.data_dir is None:
-            return None
-        from .backend.filestore import FileStore
+        """Every (PG, shard) store is a Collection inside the hosting
+        OSD's ONE shared store — shared WAL ordering, one checkpoint, one
+        restart recovering every hosted PG (reference: OSD.cc:3971
+        load_pgs over a single ObjectStore)."""
+        from .backend.collection import Collection
 
         def factory(osd, _pid=pool_id, _ps=ps):
-            return FileStore(self.data_dir / f"osd.{osd}" / f"pg.{_pid}.{_ps}")
+            return Collection(self.osds[osd].store, f"pg.{_pid}.{_ps}")
         return factory
 
-    def _osd_meta_store(self, osd: int):
-        """The daemon's superblock store (FileStore in durable mode)."""
+    def _osd_store(self, osd: int):
+        """The OSD's single ObjectStore: superblock at the root namespace,
+        PG shards as collections (FileStore in durable mode)."""
         if self.data_dir is None:
             from .backend.memstore import MemStore
             return MemStore()
         from .backend.filestore import FileStore
-        return FileStore(self.data_dir / f"osd.{osd}" / "meta")
+        return FileStore(self.data_dir / f"osd.{osd}" / "store")
 
     def _save_meta(self) -> None:
         """Persist what cannot be rebuilt from the shard stores: the pool
@@ -486,6 +522,7 @@ class MiniCluster:
                 on_done(MOSDOpReply(-2, list(ops)))
             return None
         daemon = self.osds[g.backend.whoami]
+        primary_dead = g.backend.whoami in g.bus.down
 
         def _done(reply):
             if g.backend.local_shard.store.exists(
@@ -501,6 +538,13 @@ class MiniCluster:
         if res is not None:
             return res
         if drain:
+            if primary_dead:
+                # a dead OSD executes nothing: the op stays queued on the
+                # daemon (BlockedWriteError surface) and runs at the next
+                # deliver_all() after revival.  Draining now would let the
+                # engine fan out an op whose replies a bus-down primary
+                # can never receive — leaking its per-object write slot.
+                return None
             daemon.drain()
             g.bus.deliver_all()
         return None
@@ -516,14 +560,29 @@ class MiniCluster:
         submission, like put(deliver=False)."""
         g = self.pg_group(pool_id, oid)
         out: list = []
+        abandoned = [False]
+
+        def _cb(reply):
+            if abandoned[0]:
+                # the caller got BlockedWriteError and stopped listening:
+                # a LATE error reply must not vanish (mirror put()'s
+                # _snap_done) — deliver_all() surfaces it
+                if reply.result < 0:
+                    self._deferred_errors.append(
+                        (oid, reply.result,
+                         f"op on {oid} failed after revival: "
+                         f"result {reply.result}"))
+                return
+            out.append(reply)
         res = self._dispatch_op_vector(g, pool_id, oid, op.ops,
-                                       self.osdmap.epoch, out.append,
+                                       self.osdmap.epoch, _cb,
                                        drain=deliver, snapid=snapid)
         if res is not None:
             raise IOError(f"op on {oid} bounced as stale: {res}")
         if not deliver:
             return None
         if not out:
+            abandoned[0] = True
             raise BlockedWriteError(
                 f"op on {oid} blocked: PG {g.pgid} inactive")
         reply = out[0]
@@ -549,12 +608,13 @@ class MiniCluster:
         """Run everything queued: daemon op queues FIRST (batched
         deliver=False ops park there — bus delivery alone would never
         execute them), then every PG bus.  Errors parked by batched op
-        replies surface here, where the caller expects completion."""
-        for daemon in self.osds.values():
-            daemon.drain()
-        for p in self.pools.values():
-            for g in p["pgs"].values():
-                g.bus.deliver_all()
+        replies surface here, where the caller expects completion.
+        Daemons of bus-down OSDs stay parked: a dead OSD executes
+        nothing until revived."""
+        # every PG channel shares ONE cluster bus whose pre-deliver hook
+        # drains the live daemons: one call quiesces everything (a per-PG
+        # loop would redo the full drain once per PG)
+        self.bus.deliver_all()
         if self._deferred_errors:
             oid, result, msg = self._deferred_errors[0]
             rest = len(self._deferred_errors) - 1
@@ -806,8 +866,8 @@ class MiniCluster:
             for g in p["pgs"].values():
                 g.shutdown()
         for d in self.osds.values():
-            if hasattr(d.meta_store, "close"):
-                d.meta_store.close()
+            if hasattr(d.store, "close"):
+                d.store.close()     # meta_store IS the same store
 
     # -- control plane -----------------------------------------------------
 
@@ -878,18 +938,22 @@ class MiniCluster:
                 store.exists(gobj) else b""
             metadata[oid] = (attrs, omap, header)
         old.shutdown(discard_stores=self.data_dir is not None)
-        if self.data_dir is not None:
-            import shutil
-            for osd in old.acting:
-                shutil.rmtree(
-                    self.data_dir / f"osd.{osd}" / f"pg.{pool_id}.{ps}",
-                    ignore_errors=True)
+        # destroy the outgoing incarnation's collections: the new group
+        # reuses the same collection name, and OSDs present in BOTH
+        # acting sets (or rejoining later) would otherwise boot their
+        # shard from the stale incarnation's persisted pg log
+        from .backend.collection import Collection
+        for osd in old.acting:
+            if osd != NONE_ID:
+                Collection(self.osds[osd].store,
+                           f"pg.{pool_id}.{ps}").destroy()
         new = PGGroup(PG(pool_id, ps), new_acting, ec, self.chunk_size,
                       self.cct, name_prefix=f"c{self.cluster_id}e"
                                             f"{self.osdmap.epoch}",
                       min_size=self.pools[pool_id]["pool"].min_size,
                       store_factory=self._store_factory(pool_id, ps),
-                      epoch=self.osdmap.epoch)
+                      epoch=self.osdmap.epoch,
+                      bus=self.bus)
         for oid, data in contents.items():
             t = PGTransaction().write(oid, 0, data)
             attrs, omap, header = metadata[oid]

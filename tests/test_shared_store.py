@@ -1,0 +1,200 @@
+"""Shared-store OSD topology (r4 VERDICT missing #3): each OSD daemon owns
+ONE ObjectStore hosting every PG shard on that OSD as collections, and one
+bus endpoint on ONE cluster-wide bus (reference: src/osd/OSD.cc:3971
+load_pgs over a single ObjectStore; one messenger per OSD)."""
+import numpy as np
+import pytest
+
+from ceph_tpu.backend.collection import Collection, collection_names
+from ceph_tpu.cluster import MiniCluster
+from ceph_tpu.common import Context
+from ceph_tpu.osd.osd_ops import ObjectOperation
+
+
+def _data(n, seed):
+    return np.random.default_rng(seed).integers(
+        0, 256, n, dtype=np.uint8).tobytes()
+
+
+def test_all_pg_shards_share_one_store_per_osd():
+    c = MiniCluster(n_osds=6, osds_per_host=2, chunk_size=512)
+    c.create_ec_pool("a", {"k": "2", "m": "1", "device": "numpy"}, pg_num=8)
+    c.create_replicated_pool("b", size=3, pg_num=8)
+    per_osd_bases = {}
+    n_colls = 0
+    for pool in c.pools.values():
+        for g in pool["pgs"].values():
+            for shard, h in g.bus.handlers.items():
+                st = h.store if hasattr(h, "store") else None
+                if st is None:
+                    continue
+                assert isinstance(st, Collection)
+                n_colls += 1
+                base = per_osd_bases.setdefault(shard, st.base)
+                assert st.base is base, \
+                    f"osd {shard} has more than one backing store"
+                assert st.base is c.osds[shard].store
+    assert n_colls >= 30       # 16 PGs x 3 shards spread over 6 OSDs
+    c.shutdown()
+
+
+def test_one_cluster_bus_one_endpoint_per_osd():
+    from ceph_tpu.backend.messages import OSDEndpoint, PGChannel
+    c = MiniCluster(n_osds=6, osds_per_host=2, chunk_size=512)
+    c.create_ec_pool("a", {"k": "2", "m": "1", "device": "numpy"}, pg_num=8)
+    assert all(isinstance(ep, OSDEndpoint)
+               for ep in c.bus.handlers.values())
+    g = next(iter(c.pools[1]["pgs"].values()))
+    assert isinstance(g.bus, PGChannel)
+    # every PG channel shares the one cluster bus
+    assert all(g2.bus.bus is c.bus
+               for p in c.pools.values() for g2 in p["pgs"].values())
+    c.shutdown()
+
+
+@pytest.mark.parametrize("pool_type", ["ec", "rep"])
+def test_kill_osd_hosting_many_pgs_then_revive(pool_type):
+    """Kill ONE OSD serving many PGs (primary for several), write through
+    the degradation, revive, and verify everything — the cross-PG blast
+    radius of a real OSD death on the shared bus."""
+    cct = Context(overrides={"mon_osd_down_out_interval": 10_000})
+    c = MiniCluster(n_osds=6, osds_per_host=2, chunk_size=512, cct=cct)
+    if pool_type == "ec":
+        pid = c.create_ec_pool("p", {"k": "2", "m": "1", "device": "numpy"},
+                               pg_num=16)
+    else:
+        pid = c.create_replicated_pool("p", size=3, pg_num=16)
+    oids = [f"o{i}" for i in range(24)]
+    model = {}
+    for i, oid in enumerate(oids):
+        model[oid] = _data(900 + 31 * i, i)
+        c.operate(pid, oid, ObjectOperation().write_full(model[oid])
+                  .setxattr("tag", oid.encode()))
+    # the busiest OSD hosts shards of many PGs
+    victim = max(range(6), key=lambda o: sum(
+        o in g.acting for g in c.pools[pid]["pgs"].values()))
+    hosted = sum(victim in g.acting
+                 for g in c.pools[pid]["pgs"].values())
+    assert hosted >= 8
+    c.bus.mark_down(victim)
+    from ceph_tpu.cluster import BlockedWriteError
+    for i, oid in enumerate(oids):            # overwrite while degraded
+        new = _data(700 + 13 * i, 100 + i)
+        try:
+            c.operate(pid, oid, ObjectOperation().write_full(new)
+                      .setxattr("tag", oid.encode()))
+            model[oid] = new
+        except BlockedWriteError:
+            c.bus.mark_up(victim)
+            c.bus.deliver_all()
+            model[oid] = new
+            c.bus.mark_down(victim)
+    c.bus.mark_up(victim)
+    c.bus.deliver_all()
+    for oid in oids:
+        r = c.operate(pid, oid, ObjectOperation().read(0, 0)
+                      .getxattr("tag"))
+        assert r.outdata(0)[:len(model[oid])] == model[oid], oid
+        assert r.outdata(1) == oid.encode()
+    assert c.scrub_pool(pid) == {}
+    c.shutdown()
+
+
+def test_durable_restart_recovers_every_pg_from_one_store(tmp_path):
+    """The VERDICT's done-criterion: a durable cluster whose OSD stores
+    each hold MANY PG collections reopens from ONE FileStore per OSD and
+    every PG serves its data."""
+    c = MiniCluster(n_osds=6, osds_per_host=2, chunk_size=512,
+                    data_dir=tmp_path)
+    pid = c.create_ec_pool("p", {"k": "2", "m": "1", "device": "numpy"},
+                           pg_num=16)
+    rid = c.create_replicated_pool("r", size=3, pg_num=8)
+    model = {}
+    for i in range(24):
+        oid = f"d{i}"
+        model[oid] = _data(800 + 17 * i, i)
+        c.put(pid, oid, model[oid])
+        c.put(rid, f"r{oid}", model[oid])
+    c.shutdown()
+    # ONE store dir per OSD, holding many PG collections
+    for o in range(6):
+        assert (tmp_path / f"osd.{o}" / "store").exists()
+        assert not list((tmp_path / f"osd.{o}").glob("pg.*"))
+    c2 = MiniCluster.load(tmp_path)
+    # collection discovery sees every hosted PG in the one store
+    colls = collection_names(c2.osds[0].store)
+    assert sum(1 for cn in colls if cn.startswith("pg.")) >= 6
+    for oid, want in model.items():
+        assert c2.get(pid, oid, len(want)) == want
+        assert c2.get(rid, f"r{oid}", len(want)) == want
+    assert c2.scrub_pool(pid) == {}
+    c2.shutdown()
+
+
+def test_remapped_pg_stays_on_shared_bus_and_old_collection_dies():
+    """Backfill to a new acting set must keep the PG on the cluster bus
+    (regression: the replacement group silently got a private bus, so
+    OSD-wide deaths stopped applying to remapped PGs) and must destroy
+    the outgoing incarnation's collection (regression: stale pg logs
+    leaked in the shared store and haunted later incarnations)."""
+    from ceph_tpu.backend.messages import PGChannel
+    cct = Context(overrides={"mon_osd_down_out_interval": 60})
+    c = MiniCluster(n_osds=8, osds_per_host=2, chunk_size=512, cct=cct)
+    pid = c.create_ec_pool("p", {"k": "2", "m": "1", "device": "numpy"},
+                           pg_num=8)
+    mon = c.attach_monitor()
+    model = {}
+    for i in range(12):
+        model[f"o{i}"] = _data(700 + i, i)
+        c.put(pid, f"o{i}", model[f"o{i}"])
+    # fail a non-primary OSD through the mon: auto-out remaps PGs off it
+    primaries = {g.backend.whoami for g in c.pools[pid]["pgs"].values()}
+    victim = next(o for o in range(8) if o not in primaries)
+    pre_acting = {ps: list(g.acting)
+                  for ps, g in c.pools[pid]["pgs"].items()}
+    for r in [o for o in range(8) if o != victim][:4]:
+        mon.prepare_failure(victim, r, 0.0, 25.0)
+    mon.propose_pending(25.0)
+    mon.tick(5000.0)                      # auto-out -> remap + backfill
+    assert mon.osdmap.is_out(victim)
+    remapped = [ps for ps, g in c.pools[pid]["pgs"].items()
+                if list(g.acting) != pre_acting[ps]]
+    assert remapped, "weight-out produced no remaps"
+    for ps in remapped:
+        g = c.pools[pid]["pgs"][ps]
+        assert isinstance(g.bus, PGChannel) and g.bus.bus is c.bus
+        assert victim not in g.acting
+        # the outgoing incarnation left no objects behind on the victim
+        leftovers = [cn for cn in collection_names(c.osds[victim].store)
+                     if cn == f"pg.{pid}.{ps}"]
+        assert not leftovers, leftovers
+    # OSD-wide death still reaches remapped PGs
+    some = c.pools[pid]["pgs"][remapped[0]]
+    peer = some.acting[1]
+    c.bus.mark_down(peer)
+    assert peer in some.bus.down
+    c.bus.mark_up(peer)
+    c.bus.deliver_all()
+    for oid, want in model.items():
+        assert c.get(pid, oid, len(want)) == want, oid
+    c.shutdown()
+
+
+def test_collection_namespace_isolation():
+    """Same oid in two pools lands in different collections of the same
+    per-OSD store without collision."""
+    from ceph_tpu.backend.memstore import GObject, MemStore, Transaction
+    base = MemStore()
+    c1 = Collection(base, "pg.1.0")
+    c2 = Collection(base, "pg.2.0")
+    c1.queue_transaction(Transaction().write(GObject("x", 0), 0, b"one"))
+    c2.queue_transaction(Transaction().write(GObject("x", 0), 0, b"two"))
+    assert c1.read(GObject("x", 0)) == b"one"
+    assert c2.read(GObject("x", 0)) == b"two"
+    assert [g.oid for g in c1.list_objects()] == ["x"]
+    assert collection_names(base) == {"pg.1.0", "pg.2.0"}
+    # the objects view strips prefixes and supports membership/deletion
+    assert GObject("x", 0) in c1.objects
+    del c1.objects[GObject("x", 0)]
+    assert not c1.exists(GObject("x", 0))
+    assert c2.read(GObject("x", 0)) == b"two"
