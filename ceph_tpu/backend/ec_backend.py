@@ -30,6 +30,7 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from .bluestore import ChecksumError
 from .ecutil import HINFO_KEY, HashInfo, StripeInfo, crc32c, decode_shards
 from . import ecutil
 from .extent import ExtentSet
@@ -184,6 +185,7 @@ class ECBackend(PGBackend):
         cur = self.current_shards()
         want = {self.ec_impl.chunk_index(i) for i in range(k)}
         avail = {i for i, s in enumerate(self.acting) if s in cur}
+        avail -= getattr(op, "_rmw_failed", set())   # rotten sources
         minimum = self.ec_impl.minimum_to_decode(want, avail)
         per_shard: dict[int, dict[str, list[tuple]]] = {}
         for oid, es in need.items():
@@ -197,6 +199,9 @@ class ECBackend(PGBackend):
         op._rmw_chunks = {c: self.acting[c] for c in minimum}
         op._rmw_need = need
         op._rmw_buf: dict[str, dict[int, dict[int, bytes]]] = {}
+        # restarts (rotten-source retry, stall recovery) may carry stale
+        # pending entries/sentinels: this dispatch defines the set
+        op.pending_read_shards.clear()
         self._rmw_read_tids.pop(getattr(op, "_rmw_read_tid", None), None)
         self.next_tid += 1
         op._rmw_read_tid = self.next_tid
@@ -553,6 +558,24 @@ class ECBackend(PGBackend):
                 self.whoami, rop.tid, {oid: [(c_off, c_len, None)]}))
 
     def _handle_rmw_read_reply(self, op: Op, reply: ECSubReadReply) -> None:
+        if reply.errors:
+            # a source failed (rotten at rest / vanished): restart the
+            # WHOLE rmw read excluding that chunk — minimum_to_decode
+            # picks a replacement; dropping the chunk silently would hand
+            # the decode k-1 chunks (same widening client reads do via
+            # _retry_remaining_shards)
+            chunk = {s: c for c, s in
+                     enumerate(self.acting)}[reply.from_shard]
+            op._rmw_failed = getattr(op, "_rmw_failed", set()) | {chunk}
+            try:
+                self._start_rmw_reads(op, op._rmw_need)
+                op._rmw_stalled = False
+            except IOError:
+                # not enough clean sources: stall like shard loss until
+                # a repair/revival re-drives
+                op.pending_read_shards.add(-1)
+                op._rmw_stalled = True
+            return
         op.pending_read_shards.discard(reply.from_shard)
         chunk_of_shard = {s: c for c, s in enumerate(self.acting)}
         chunk = chunk_of_shard[reply.from_shard]
@@ -780,7 +803,8 @@ class ECBackend(PGBackend):
             try:
                 data = store.read(obj)
                 stored = store.getattr(obj, HINFO_KEY)
-            except (FileNotFoundError, KeyError):
+            except (FileNotFoundError, KeyError, ChecksumError):
+                # ChecksumError: the store's at-rest crc located the rot
                 out[chunk] = False
                 continue
             # version check first: a shard that missed writes while down is

@@ -41,6 +41,7 @@ from collections import deque
 from dataclasses import dataclass, field
 from enum import Enum
 
+from .bluestore import ChecksumError
 from .memstore import GObject, MemStore, Transaction
 from .messages import (ECSubRead, ECSubReadReply, ECSubWrite, ECSubWriteReply,
                        MessageBus, PGActivate, PGActivateAck, PGLogInfo,
@@ -137,7 +138,13 @@ class OSDShard:
         touched = {obj for obj in touched if obj.oid != PG_META}
         inv = Transaction()
         for obj in sorted(touched, key=lambda g: (g.oid, g.shard)):
-            o = self.store.objects.get(obj)
+            try:
+                o = self.store.objects.get(obj)
+            except ChecksumError:
+                # pre-state unreadable (rotten at rest): the best honest
+                # inverse is removal — a rollback leaves the object
+                # missing, which scrub/recovery detect and rebuild
+                o = None
             inv.remove(obj)
             if o is not None:
                 inv.write(obj, 0, bytes(o.data))
@@ -306,6 +313,11 @@ class OSDShard:
                             self.store.get_omap_header(obj))
                 except FileNotFoundError:
                     reply.errors[oid] = -2  # ENOENT
+                except ChecksumError:
+                    # at-rest checksum failure (BlueStore): the shard's
+                    # copy is rotten — EIO, like the reference's
+                    # bluestore read path
+                    reply.errors[oid] = -5
             self.bus.send(msg.from_shard, reply)
         elif isinstance(msg, PushOp):
             t = Transaction()
@@ -363,6 +375,10 @@ class RecoveryOp:
     # (the reference serializes this with per-object recovery locks)
     at_version: int = 0
     pending_pushes: set[int] = field(default_factory=set)
+    # sources whose copy failed its at-rest checksum (EIO from the
+    # store): excluded from further reads AND added to missing_shards so
+    # the rebuild repairs them too
+    bad_sources: set[int] = field(default_factory=set)
     # sticky: a push target died before acking; even if the remaining
     # pushes ack, the op must finish FAILED (reference _failed_push fails
     # the whole op for any dead push target)
@@ -954,6 +970,23 @@ class PGBackend:
         if rop.state != RecoveryState.READING:
             return                      # stale/duplicate reply
         if rop.oid in reply.errors:
+            if reply.errors[rop.oid] == -5:
+                # the source's copy is ROTTEN at rest (store checksum):
+                # don't fail the op — drop the source, mark its shard for
+                # rebuild too, and restart the read from the remaining
+                # clean sources (mirrors the hash-present rotten-source
+                # drop in _recovery_push_payloads)
+                chunk = {s: c for c, s in
+                         enumerate(self.acting)}[reply.from_shard]
+                rop.bad_sources.add(chunk)
+                rop.missing_shards = set(rop.missing_shards) | {chunk}
+                self._recovery_read_tids.pop(rop.read_tid, None)
+                rop.state = RecoveryState.IDLE
+                try:
+                    self.continue_recovery_op(rop)
+                except IOError:
+                    self._finish_recovery_op(rop, failed=True)
+                return
             # the source no longer has the object (e.g. a delete committed
             # while the read was in flight): the op fails cleanly; a later
             # repair pass re-plans from the log

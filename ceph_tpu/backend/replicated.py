@@ -24,6 +24,7 @@ push so scrub can tell a stale copy from a clean one even when sizes match.
 """
 from __future__ import annotations
 
+from .bluestore import ChecksumError
 from .memstore import GObject, Transaction
 from .messages import ECSubRead, ECSubReadReply, MessageBus
 from .pg_backend import Op, OSDShard, PGBackend, RecoveryOp, shard_store
@@ -173,6 +174,8 @@ class ReplicatedBackend(PGBackend):
                 result[oid] = out
             except FileNotFoundError:
                 errors[oid] = -2      # ENOENT
+            except ChecksumError:
+                errors[oid] = -5      # EIO: rotten at rest (bluestore)
         if result:
             self.perf.inc("reads")
         if errors:
@@ -273,7 +276,9 @@ class ReplicatedBackend(PGBackend):
                                  store.getattr(obj, VERSION_KEY),
                                  tuple(sorted(store.get_omap(obj).items())),
                                  store.get_omap_header(obj))
-            except (FileNotFoundError, KeyError):
+            except (FileNotFoundError, KeyError, ChecksumError):
+                # ChecksumError: bluestore-style at-rest crc failure —
+                # the store itself located the rot, no vote needed
                 copies[chunk] = None
         groups: dict = {}
         for chunk, ident in copies.items():

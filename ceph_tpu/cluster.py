@@ -131,11 +131,17 @@ class PGGroup:
 class MiniCluster:
     def __init__(self, n_osds: int = 12, osds_per_host: int = 3,
                  chunk_size: int = 4096, cct: Context | None = None,
-                 data_dir=None):
+                 data_dir=None, store_backend: str = "file"):
         self.cct = cct if cct is not None else default_context()
         self.chunk_size = chunk_size
         self.n_osds = n_osds
         self.osds_per_host = osds_per_host
+        # durable-store flavour: "file" (FileStore WAL+snapshot) or
+        # "bluestore" (extent allocator, checksums at rest, compression)
+        if store_backend not in ("file", "bluestore"):
+            raise ValueError(f"unknown store_backend {store_backend!r} "
+                             f"(choose 'file' or 'bluestore')")
+        self.store_backend = store_backend
         # durable mode: every shard store is a FileStore under
         # data_dir/osd.<id>/pg.<pool>.<ps>/ and cluster metadata persists
         # to cluster_meta.pkl — MiniCluster.load() reopens the whole thing
@@ -286,10 +292,14 @@ class MiniCluster:
 
     def _osd_store(self, osd: int):
         """The OSD's single ObjectStore: superblock at the root namespace,
-        PG shards as collections (FileStore in durable mode)."""
+        PG shards as collections (FileStore or BlueStore-lite in durable
+        mode, per ``store_backend``)."""
         if self.data_dir is None:
             from .backend.memstore import MemStore
             return MemStore()
+        if self.store_backend == "bluestore":
+            from .backend.bluestore import BlueStoreLite
+            return BlueStoreLite(self.data_dir / f"osd.{osd}" / "store")
         from .backend.filestore import FileStore
         return FileStore(self.data_dir / f"osd.{osd}" / "store")
 
@@ -306,6 +316,7 @@ class MiniCluster:
             "n_osds": self.n_osds,
             "osds_per_host": self.osds_per_host,
             "chunk_size": self.chunk_size,
+            "store_backend": self.store_backend,
             "pools": [{"name": p["pool"].name,
                        "type": p["pool"].type,
                        "size": p["pool"].size,
@@ -333,7 +344,8 @@ class MiniCluster:
         with open(Path(data_dir) / "cluster_meta.pkl", "rb") as f:
             meta = pickle.load(f)
         c = cls(n_osds=meta["n_osds"], osds_per_host=meta["osds_per_host"],
-                chunk_size=meta["chunk_size"], cct=cct, data_dir=data_dir)
+                chunk_size=meta["chunk_size"], cct=cct, data_dir=data_dir,
+                store_backend=meta.get("store_backend", "file"))
         for p in meta["pools"]:
             if p["type"] == POOL_TYPE_REPLICATED:
                 pid = c.create_replicated_pool(p["name"], p["size"],

@@ -22,6 +22,12 @@ def main(argv=None) -> int:
     from ..utils.platform import honour_jax_platforms_env
     honour_jax_platforms_env()   # axon sitecustomize override
     ap = argparse.ArgumentParser(prog="rados")
+    ap.add_argument("--store-backend", default="file",
+                    choices=["file", "bluestore"],
+                    help="durable store flavour for a NEW cluster "
+                         "(bluestore: extent allocator + checksums at "
+                         "rest + compression); existing clusters reopen "
+                         "with their recorded backend")
     ap.add_argument("--data-dir", required=True,
                     help="durable cluster directory")
     ap.add_argument("--n-osds", type=int, default=9,
@@ -65,7 +71,8 @@ def main(argv=None) -> int:
     fresh = not os.path.exists(os.path.join(args.data_dir,
                                             "cluster_meta.pkl"))
     if fresh:
-        c = MiniCluster(n_osds=args.n_osds, data_dir=args.data_dir)
+        c = MiniCluster(n_osds=args.n_osds, data_dir=args.data_dir,
+                        store_backend=args.store_backend)
     else:
         c = MiniCluster.load(args.data_dir)
     try:

@@ -1,0 +1,387 @@
+"""BlueStore-lite: extent allocation, checksums at rest, compression,
+blob-sharing clones, restart survival (r4 VERDICT missing #2; reference:
+src/os/bluestore/BlueStore.cc structure, src/os/ObjectStore.h contract)."""
+import pickle
+
+import numpy as np
+import pytest
+
+from ceph_tpu.backend.bluestore import (BlueStoreLite, ChecksumError,
+                                        RunListAllocator)
+from ceph_tpu.backend.memstore import GObject, MemStore, Transaction
+
+
+def _data(n, seed=0):
+    return np.random.default_rng(seed).integers(
+        0, 256, n, dtype=np.uint8).tobytes()
+
+
+@pytest.fixture
+def bs(tmp_path):
+    s = BlueStoreLite(tmp_path / "bs", min_alloc=512)
+    yield s
+    s.close()
+
+
+class TestAllocator:
+    def test_alloc_free_coalesce(self):
+        a = RunListAllocator(512)
+        o1, l1 = a.alloc(1000)          # 2 units
+        o2, l2 = a.alloc(512)           # 1 unit
+        assert (o1, l1) == (0, 1024) and (o2, l2) == (1024, 512)
+        a.free(o1, l1)
+        a.free(o2, l2)                  # coalesces into one run
+        assert a.runs == [[0, 3]]
+        o3, _ = a.alloc(1536)           # first-fit reuses the hole
+        assert o3 == 0
+        assert a.watermark == 3
+
+    def test_rebuild_from_blobs(self):
+        from ceph_tpu.backend.bluestore import Blob
+        a = RunListAllocator(512)
+        blobs = {1: Blob(poff=512, plen=400, alloc=512, raw_len=400,
+                         csum=0, comp=None),
+                 2: Blob(poff=2048, plen=512, alloc=512, raw_len=512,
+                         csum=0, comp=None)}
+        a.rebuild(blobs)
+        assert a.runs == [[0, 1], [2, 2]]
+        assert a.watermark == 5
+
+
+class TestStoreContract:
+    """MemStore-equivalence: every Transaction op produces identical
+    observable state on both stores."""
+
+    OPS = [
+        lambda t, g: t.write(g, 0, _data(700, 1)),
+        lambda t, g: t.write(g, 300, _data(600, 2)),   # overlapping rmw
+        lambda t, g: t.zero(g, 100, 250),
+        lambda t, g: t.truncate(g, 450),
+        lambda t, g: t.truncate(g, 900),               # extend
+        lambda t, g: t.write(g, 2000, _data(64, 3)),   # hole
+        lambda t, g: t.setattr(g, "a", {"x": 1}),
+        lambda t, g: t.omap_setkeys(g, {"k": b"v"}),
+        lambda t, g: t.omap_setheader(g, b"hdr"),
+    ]
+
+    def test_matches_memstore(self, bs):
+        mem = MemStore()
+        g = GObject("o", 3)
+        for op in self.OPS:
+            for store in (bs, mem):
+                t = Transaction()
+                op(t, g)
+                store.queue_transaction(t)
+            assert bs.read(g) == mem.read(g)
+            assert bs.stat(g) == mem.stat(g)
+        assert bs.getattrs(g) == mem.getattrs(g)
+        assert bs.get_omap(g) == mem.get_omap(g)
+        assert bs.get_omap_header(g) == mem.get_omap_header(g)
+
+    def test_random_rmw_fuzz_matches_memstore(self, bs):
+        """rmw-heavy fuzz: random overlapping writes/zeros/truncates must
+        track MemStore byte-for-byte (the extent-map surgery is the
+        riskiest code here)."""
+        rng = np.random.default_rng(42)
+        mem = MemStore()
+        g = GObject("fuzz", 0)
+        for i in range(300):
+            t1, t2 = Transaction(), Transaction()
+            kind = rng.integers(0, 10)
+            off = int(rng.integers(0, 5000))
+            ln = int(rng.integers(1, 2000))
+            if kind < 6:
+                d = _data(ln, 1000 + i)
+                t1.write(g, off, d)
+                t2.write(g, off, d)
+            elif kind < 8:
+                t1.zero(g, off, ln)
+                t2.zero(g, off, ln)
+            else:
+                t1.truncate(g, off)
+                t2.truncate(g, off)
+            bs.queue_transaction(t1)
+            mem.queue_transaction(t2)
+            if i % 37 == 0:
+                assert bs.read(g) == mem.read(g), i
+        assert bs.read(g) == mem.read(g)
+        # every live blob is referenced by exactly its extent count
+        refcount = {}
+        for onode in bs.onodes.values():
+            for e in onode.extents:
+                refcount[e.blob] = refcount.get(e.blob, 0) + 1
+        assert refcount == {bid: b.refs for bid, b in bs.blobs.items()}
+
+    def test_remove_frees_space(self, bs):
+        g = GObject("big", 0)
+        bs.queue_transaction(Transaction().write(g, 0, _data(8192, 5)))
+        used = bs.usage()["allocated_bytes"]
+        assert used >= 8192
+        bs.queue_transaction(Transaction().remove(g))
+        assert bs.usage()["allocated_bytes"] == 0
+        assert bs.usage()["free_bytes"] >= used
+        # the freed space is REUSED, not appended after
+        wm = bs.alloc.watermark
+        bs.queue_transaction(Transaction().write(GObject("n", 0), 0,
+                                                 _data(4096, 6)))
+        assert bs.alloc.watermark == wm
+
+    def test_clone_shares_blobs(self, bs):
+        g, c = GObject("h", 0), GObject("h\x00snap\x001", 0)
+        payload = _data(4096, 7)
+        bs.queue_transaction(Transaction().write(g, 0, payload)
+                             .setattr(g, "t", b"v"))
+        before = bs.usage()["allocated_bytes"]
+        bs.queue_transaction(Transaction().clone(g, c))
+        # O(extent-map) clone: no new data allocation
+        assert bs.usage()["allocated_bytes"] == before
+        assert bs.read(c) == payload
+        assert bs.getattr(c, "t") == b"v"
+        # COW: overwriting the head leaves the clone intact
+        bs.queue_transaction(Transaction().write(g, 0, _data(4096, 8)))
+        assert bs.read(c) == payload
+        # dropping the head keeps the shared blob alive for the clone
+        bs.queue_transaction(Transaction().remove(g))
+        assert bs.read(c) == payload
+
+
+class TestChecksums:
+    def test_bitrot_at_rest_detected(self, bs):
+        g = GObject("x", 0)
+        bs.queue_transaction(Transaction().write(g, 0, _data(2048, 9)))
+        blob = next(iter(bs.blobs.values()))
+        # flip one byte of the stored data behind the store's back
+        bs._block.seek(blob.poff + 100)
+        orig = bs._block.read(1)
+        bs._block.seek(blob.poff + 100)
+        bs._block.write(bytes([orig[0] ^ 0xFF]))
+        with pytest.raises(ChecksumError):
+            bs.read(g)
+        # repair (rewrite) clears the error
+        bs.queue_transaction(Transaction().write(g, 0, _data(2048, 9)))
+        assert bs.read(g) == _data(2048, 9)
+
+
+class TestCompression:
+    def test_compressible_data_saves_units(self, tmp_path):
+        s = BlueStoreLite(tmp_path / "c", min_alloc=512,
+                          compression="zlib")
+        g = GObject("z", 0)
+        payload = b"A" * 65536                   # wildly compressible
+        s.queue_transaction(Transaction().write(g, 0, payload))
+        u = s.usage()
+        assert u["compressed_blobs"] == 1
+        assert u["allocated_bytes"] < len(payload) // 4
+        assert s.read(g) == payload
+        # partial reads decompress and slice exactly
+        assert s.read(g, 1000, 500) == payload[1000:1500]
+        s.close()
+        # survives restart (comp metadata persisted)
+        s2 = BlueStoreLite(tmp_path / "c", min_alloc=512,
+                           compression="zlib")
+        assert s2.read(g) == payload
+        s2.close()
+
+    def test_incompressible_data_stays_raw(self, tmp_path):
+        s = BlueStoreLite(tmp_path / "r", min_alloc=512,
+                          compression="zlib")
+        g = GObject("rnd", 0)
+        payload = _data(8192, 11)               # random: incompressible
+        s.queue_transaction(Transaction().write(g, 0, payload))
+        assert s.usage()["compressed_blobs"] == 0
+        assert s.read(g) == payload
+        s.close()
+
+
+class TestDurability:
+    def test_restart_survival(self, tmp_path):
+        s = BlueStoreLite(tmp_path / "d", min_alloc=512)
+        g1, g2 = GObject("a", 0), GObject("b", 1)
+        s.queue_transaction(Transaction().write(g1, 0, _data(3000, 12))
+                            .setattr(g1, "k", b"v")
+                            .omap_setkeys(g1, {"o": b"m"}))
+        s.queue_transaction(Transaction().write(g2, 100, _data(700, 13)))
+        s.close()                               # checkpoint path
+        s2 = BlueStoreLite(tmp_path / "d", min_alloc=512)
+        assert s2.read(g1) == _data(3000, 12)
+        assert s2.getattr(g1, "k") == b"v"
+        assert s2.get_omap(g1) == {"o": b"m"}
+        assert s2.read(g2, 100, 700) == _data(700, 13)
+        assert s2.stat(g2) == 800
+        s2.close()
+
+    def test_wal_replay_without_checkpoint(self, tmp_path):
+        s = BlueStoreLite(tmp_path / "w", min_alloc=512)
+        g = GObject("a", 0)
+        s.queue_transaction(Transaction().write(g, 0, _data(1500, 14)))
+        s.queue_transaction(Transaction().write(g, 500, _data(400, 15)))
+        want = s.read(g)
+        s._wal.flush()
+        s._block.flush()
+        # crash: no close/checkpoint
+        s2 = BlueStoreLite(tmp_path / "w", min_alloc=512)
+        assert s2.read(g) == want
+        # allocator rebuilt: new writes do not clobber live blobs
+        s2.queue_transaction(Transaction().write(GObject("n", 0), 0,
+                                                 _data(2048, 16)))
+        assert s2.read(g) == want
+        s2.close()
+
+    def test_torn_wal_tail_discarded(self, tmp_path):
+        s = BlueStoreLite(tmp_path / "t", min_alloc=512)
+        g = GObject("a", 0)
+        s.queue_transaction(Transaction().write(g, 0, b"committed"))
+        s._wal.flush()
+        s._block.flush()
+        # simulate a crash mid-append: garbage half-record at the tail
+        with open(s.path / "kv.log", "ab") as f:
+            f.write(b"\x99" * 7)
+        s2 = BlueStoreLite(tmp_path / "t", min_alloc=512)
+        assert s2.read(g) == b"committed"
+        # the store keeps working (tail truncated)
+        s2.queue_transaction(Transaction().write(g, 0, b"next"))
+        s2.close()
+        s3 = BlueStoreLite(tmp_path / "t", min_alloc=512)
+        assert s3.read(g, 0, 4) == b"next"
+        s3.close()
+
+    def test_metadata_checkpoint_excludes_data(self, tmp_path):
+        """The checkpoint is metadata-only: its size must not scale with
+        data volume (the r4 FileStore whole-store-pickle weakness)."""
+        s = BlueStoreLite(tmp_path / "m", min_alloc=4096)
+        for i in range(8):
+            s.queue_transaction(Transaction().write(
+                GObject(f"o{i}", 0), 0, _data(1 << 18, i)))   # 2 MiB total
+        s.close()
+        snap_size = (tmp_path / "m" / "kv.snap").stat().st_size
+        block_size = (tmp_path / "m" / "block").stat().st_size
+        assert block_size >= 1 << 21
+        assert snap_size < 64 * 1024
+
+
+class TestScrubWithChecksumsAtRest:
+    def test_scrub_flags_rotten_blob(self, tmp_path):
+        """Bitrot injected into a replica's blob AT REST: the store's own
+        crc32c locates it during deep scrub — no majority vote needed —
+        and repair restores the copy."""
+        from ceph_tpu.cluster import MiniCluster
+        c = MiniCluster(n_osds=6, osds_per_host=2, chunk_size=512,
+                        data_dir=tmp_path, store_backend="bluestore")
+        pid = c.create_replicated_pool("p", size=3, pg_num=4)
+        payload = _data(3000, 77)
+        c.put(pid, "rotten", payload)
+        g = c.pg_group(pid, "rotten")
+        peer = next(s for s in g.acting if s != g.backend.whoami)
+        bs = c.osds[peer].store
+        # find the blob backing the peer's copy and flip a byte on disk
+        target = next(go for go in bs.onodes
+                      if go.oid.endswith("rotten") and go.shard == peer)
+        blob = bs.blobs[bs.onodes[target].extents[0].blob]
+        bs._block.seek(blob.poff + 10)
+        b0 = bs._block.read(1)
+        bs._block.seek(blob.poff + 10)
+        bs._block.write(bytes([b0[0] ^ 0xFF]))
+        bs._block.flush()
+        rep = c.scrub_pool(pid)
+        assert any("rotten" in o for bad in rep.values() for o in bad)
+        # scrub's repair rewrote the copy: clean now, reads fine
+        assert c.scrub_pool(pid) == {}
+        assert c.get(pid, "rotten", len(payload)) == payload
+        c.shutdown()
+
+
+class TestRottenSourceRecovery:
+    def _rot_shard_copy(self, c, pid, oid, shard):
+        bs = c.osds[shard].store
+        target = next(go for go in bs.onodes
+                      if go.oid.endswith(oid) and go.shard == shard)
+        blob = bs.blobs[bs.onodes[target].extents[0].blob]
+        bs._block.seek(blob.poff)
+        b0 = bs._block.read(1)
+        bs._block.seek(blob.poff)
+        bs._block.write(bytes([b0[0] ^ 0xFF]))
+        bs._block.flush()
+
+    def test_ec_rmw_read_retries_past_rotten_chunk(self, tmp_path):
+        """A partial-stripe overwrite whose RMW read hits a rotten source
+        chunk must widen to a parity chunk, not hand the decode k-1
+        chunks (regression: reply errors were silently discarded)."""
+        from ceph_tpu.cluster import MiniCluster
+        from ceph_tpu.osd.osd_ops import ObjectOperation
+        c = MiniCluster(n_osds=6, osds_per_host=2, chunk_size=512,
+                        data_dir=tmp_path, store_backend="bluestore")
+        pid = c.create_ec_pool("p", {"k": "2", "m": "2",
+                                     "device": "numpy"}, pg_num=4)
+        payload = _data(2048, 21)
+        c.operate(pid, "rmw", ObjectOperation().write_full(payload))
+        g = c.pg_group(pid, "rmw")
+        data_shard = g.acting[1]              # a non-primary data chunk
+        self._rot_shard_copy(c, pid, "rmw", data_shard)
+        # partial overwrite: RMW reads the stripe, hits the rot, widens
+        patch = _data(100, 22)
+        c.operate(pid, "rmw", ObjectOperation().write(300, patch))
+        want = bytearray(payload)
+        want[300:400] = patch
+        r = c.operate(pid, "rmw", ObjectOperation().read(0, 0))
+        assert r.outdata(0)[:len(want)] == bytes(want)
+        c.shutdown()
+
+    def test_ec_recovery_rebuilds_rotten_source_too(self, tmp_path):
+        """Recovery reading a rotten source must drop it, rebuild from
+        clean chunks, and repair the rotten shard as well (regression:
+        the -5 reply failed the whole recovery op forever)."""
+        from ceph_tpu.backend.pg_backend import RecoveryState
+        from ceph_tpu.cluster import MiniCluster
+        c = MiniCluster(n_osds=8, osds_per_host=2, chunk_size=512,
+                        data_dir=tmp_path, store_backend="bluestore")
+        pid = c.create_ec_pool("p", {"k": "2", "m": "2",
+                                     "device": "numpy"}, pg_num=4)
+        payload = _data(2048, 23)
+        c.put(pid, "rec", payload)
+        g = c.pg_group(pid, "rec")
+        rotten = g.acting[2]
+        self._rot_shard_copy(c, pid, "rec", rotten)
+        missing_chunk = 3                     # rebuild the last chunk
+        rop = g.backend.recover_object("rec", {missing_chunk})
+        g.bus.deliver_all()
+        assert rop.state == RecoveryState.COMPLETE
+        # the rotten chunk was detected and repaired alongside
+        assert 2 in rop.missing_shards
+        assert c.get(pid, "rec", len(payload)) == payload
+        assert c.scrub_pool(pid) == {}
+        c.shutdown()
+
+
+class TestClusterIntegration:
+    def test_minicluster_on_bluestore(self, tmp_path):
+        """A durable cluster on BlueStore-lite: EC pool IO, rmw-heavy
+        churn, restart, deep scrub with checksums at rest."""
+        from ceph_tpu.cluster import MiniCluster
+        from ceph_tpu.osd.osd_ops import ObjectOperation
+        c = MiniCluster(n_osds=6, osds_per_host=2, chunk_size=512,
+                        data_dir=tmp_path, store_backend="bluestore")
+        pid = c.create_ec_pool("p", {"k": "2", "m": "1",
+                                     "device": "numpy"}, pg_num=8)
+        rng = np.random.default_rng(0)
+        model = {}
+        for i in range(10):
+            model[f"o{i}"] = _data(1500 + 37 * i, 50 + i)
+            c.operate(pid, f"o{i}", ObjectOperation()
+                      .write_full(model[f"o{i}"]).setxattr("t", b"x"))
+        for step in range(60):                   # rmw churn
+            oid = f"o{int(rng.integers(0, 10))}"
+            off = int(rng.integers(0, 1000))
+            d = _data(int(rng.integers(50, 600)), 500 + step)
+            c.operate(pid, oid, ObjectOperation().write(off, d))
+            cur = bytearray(model[oid])
+            if len(cur) < off + len(d):
+                cur.extend(b"\0" * (off + len(d) - len(cur)))
+            cur[off:off + len(d)] = d
+            model[oid] = bytes(cur)
+        c.shutdown()
+        c2 = MiniCluster.load(tmp_path)
+        for oid, want in model.items():
+            r = c2.operate(pid, oid, ObjectOperation().read(0, 0))
+            assert r.outdata(0)[:len(want)] == want, oid
+        assert c2.scrub_pool(pid) == {}
+        c2.shutdown()
