@@ -18,6 +18,32 @@ import argparse
 import sys
 
 
+
+def _parse_profile(parts):
+    """(kv dict, replicated?) from 'k=4 m=2' / 'replicated size=3'."""
+    kv = dict(p.split("=", 1) for p in parts if "=" in p)
+    return kv, "replicated" in parts
+
+
+def _read_input(path: str) -> bytes:
+    return sys.stdin.buffer.read() if path == "-" else \
+        open(path, "rb").read()
+
+
+def _write_output(path: str, data: bytes) -> None:
+    if path == "-":
+        sys.stdout.buffer.write(data)
+    else:
+        open(path, "wb").write(data)
+
+
+def _fmt_df(st: dict) -> str:
+    return (f"{st['pgmap']['num_pools']} pools, "
+            f"{st['pgmap']['num_pgs']} pgs, "
+            f"{st['osdmap']['num_up_osds']}/"
+            f"{st['osdmap']['num_osds']} osds up")
+
+
 def main(argv=None) -> int:
     from ..utils.platform import honour_jax_platforms_env
     honour_jax_platforms_env()   # axon sitecustomize override
@@ -28,8 +54,15 @@ def main(argv=None) -> int:
                          "(bluestore: extent allocator + checksums at "
                          "rest + compression); existing clusters reopen "
                          "with their recorded backend")
-    ap.add_argument("--data-dir", required=True,
-                    help="durable cluster directory")
+    ap.add_argument("--data-dir",
+                    help="durable cluster directory (local mode)")
+    ap.add_argument("--connect", metavar="HOST:PORT",
+                    help="talk to a LIVE cluster process over TCP "
+                         "(cephx-authenticated, HMAC-secured v2 frames) "
+                         "instead of reopening --data-dir")
+    ap.add_argument("--keyring",
+                    help="client.admin keyring path (default: "
+                         "<data-dir>/client.admin.keyring)")
     ap.add_argument("--n-osds", type=int, default=9,
                     help="cluster size when creating a new directory")
     sub = ap.add_subparsers(dest="cmd", required=True)
@@ -62,8 +95,18 @@ def main(argv=None) -> int:
     p = sub.add_parser("rollback")
     p.add_argument("pool"), p.add_argument("oid"), p.add_argument("snap")
     p = sub.add_parser("df")
+    p = sub.add_parser("serve")
+    p.add_argument("--port", type=int, default=0,
+                   help="listen port (0 = ephemeral, printed on start)")
 
     args = ap.parse_args(argv)
+    if args.connect:
+        if args.cmd == "serve":
+            ap.error("serve runs the cluster locally; it cannot combine "
+                     "with --connect")
+        return _run_remote(args)
+    if args.data_dir is None:
+        ap.error("--data-dir is required (or --connect for remote mode)")
 
     import os
     from ..client.rados import ObjectNotFound, Rados
@@ -76,9 +119,20 @@ def main(argv=None) -> int:
     else:
         c = MiniCluster.load(args.data_dir)
     try:
+        if args.cmd == "serve":
+            from ..net import ClusterServer
+            server = ClusterServer(c, port=args.port)
+            print(f"serving on 127.0.0.1:{server.port}", flush=True)
+            try:
+                server.serve_forever()
+            except KeyboardInterrupt:
+                pass
+            server.stop()
+            return 0
+
         if args.cmd == "mkpool":
-            kv = dict(p.split("=", 1) for p in args.profile if "=" in p)
-            if "replicated" in args.profile:
+            kv, replicated = _parse_profile(args.profile)
+            if replicated:
                 c.create_replicated_pool(args.pool,
                                          size=int(kv.get("size", 3)))
             else:
@@ -89,23 +143,14 @@ def main(argv=None) -> int:
 
         rados = Rados(c)
         if args.cmd == "df":
-            st = rados.cluster_stat()
-            print(f"{st['pgmap']['num_pools']} pools, "
-                  f"{st['pgmap']['num_pgs']} pgs, "
-                  f"{st['osdmap']['num_up_osds']}/"
-                  f"{st['osdmap']['num_osds']} osds up")
+            print(_fmt_df(rados.cluster_stat()))
             return 0
         io = rados.open_ioctx(args.pool)
         if args.cmd == "put":
-            data = (sys.stdin.buffer.read() if args.file == "-"
-                    else open(args.file, "rb").read())
-            io.write_full(args.oid, data)
+            io.write_full(args.oid, _read_input(args.file))
         elif args.cmd == "get":
-            data = io.read(args.oid)     # object_info carries exact size
-            if args.file == "-":
-                sys.stdout.buffer.write(data)
-            else:
-                open(args.file, "wb").write(data)
+            # object_info carries the exact size
+            _write_output(args.file, io.read(args.oid))
         elif args.cmd == "ls":
             for oid in io.list_objects():
                 print(oid)
@@ -139,6 +184,67 @@ def main(argv=None) -> int:
         return 2
     finally:
         c.shutdown()
+
+
+def _run_remote(args) -> int:
+    """Remote mode: every verb through TcpRados over the live socket."""
+    import os
+    from ..net import TcpRados
+    try:
+        host, _, port_s = args.connect.rpartition(":")
+        if not host or not port_s.isdigit():
+            raise ValueError(f"--connect wants HOST:PORT, got "
+                             f"{args.connect!r}")
+        keyring = args.keyring or (os.path.join(args.data_dir,
+                                                "client.admin.keyring")
+                                   if args.data_dir else None)
+        if keyring is None:
+            raise ValueError("--keyring (or --data-dir) required with "
+                             "--connect")
+        r = TcpRados(host, int(port_s), keyring)
+    except (IOError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    try:
+        if args.cmd == "mkpool":
+            kv, replicated = _parse_profile(args.profile)
+            if replicated:
+                r.mkpool(args.pool, replicated=True,
+                         size=int(kv.get("size", 3)))
+            else:
+                kv.setdefault("device", "auto")
+                r.mkpool(args.pool, profile=kv)
+            print(f"pool {args.pool} created")
+        elif args.cmd == "put":
+            r.put(args.pool, args.oid, _read_input(args.file))
+        elif args.cmd == "get":
+            _write_output(args.file, r.get(args.pool, args.oid))
+        elif args.cmd == "ls":
+            for oid in r.ls(args.pool):
+                print(oid)
+        elif args.cmd == "rm":
+            r.remove(args.pool, args.oid)
+        elif args.cmd == "stat":
+            size, mtime = r.stat(args.pool, args.oid)
+            print(f"{args.pool}/{args.oid} size {size} mtime {mtime:.0f}")
+        elif args.cmd == "setxattr":
+            r.setxattr(args.pool, args.oid, args.name,
+                       args.value.encode())
+        elif args.cmd == "getxattr":
+            v = r.getxattr(args.pool, args.oid, args.name)
+            print(v.decode() if isinstance(v, bytes) else v)
+        elif args.cmd == "df":
+            print(_fmt_df(r.status()))
+        else:
+            print(f"error: {args.cmd!r} not supported over --connect",
+                  file=sys.stderr)
+            return 2
+        return 0
+    except (IOError, KeyError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    finally:
+        r.close()
 
 
 if __name__ == "__main__":

@@ -1,0 +1,204 @@
+"""TCP transport: a live cluster served over sockets with cephx auth and
+HMAC-secured v2 frames (r4 VERDICT missing #4; reference:
+src/msg/async/AsyncMessenger.h:74, ProtocolV2.cc, src/auth/cephx).
+"""
+import os
+import pickle
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ceph_tpu.net import ClusterServer, TcpRados
+
+
+def _data(n, seed=0):
+    return np.random.default_rng(seed).integers(
+        0, 256, n, dtype=np.uint8).tobytes()
+
+
+@pytest.fixture
+def served(tmp_path):
+    """An in-process served cluster (threaded server) + keyring path."""
+    from ceph_tpu.cluster import MiniCluster
+    c = MiniCluster(n_osds=6, osds_per_host=2, chunk_size=512,
+                    data_dir=tmp_path)
+    server = ClusterServer(c)
+    server.start()
+    yield server, tmp_path / "client.admin.keyring"
+    server.stop()
+    c.shutdown()
+
+
+class TestRpc:
+    def test_put_get_roundtrip_secured(self, served):
+        server, keyring = served
+        r = TcpRados("127.0.0.1", server.port, keyring)
+        r.mkpool("p", profile={"k": "2", "m": "1", "device": "numpy"})
+        payload = _data(20000, 1)
+        r.put("p", "obj", payload)
+        assert r.get("p", "obj") == payload
+        assert r.stat("p", "obj")[0] == len(payload)
+        assert r.ls("p") == ["obj"]
+        # frames after the handshake are HMAC mode (secret installed)
+        assert r.ch.secret is not None
+        r.setxattr("p", "obj", "k", b"v")
+        assert r.getxattr("p", "obj", "k") == b"v"
+        r.remove("p", "obj")
+        with pytest.raises(IOError):
+            r.get("p", "obj")
+        r.close()
+
+    def test_two_concurrent_clients(self, served):
+        server, keyring = served
+        a = TcpRados("127.0.0.1", server.port, keyring)
+        b = TcpRados("127.0.0.1", server.port, keyring)
+        a.mkpool("p", replicated=True, size=3)
+        errs = []
+
+        def worker(r, tag):
+            try:
+                for i in range(20):
+                    r.put("p", f"{tag}{i}", _data(600 + i, i))
+                for i in range(20):
+                    assert r.get("p", f"{tag}{i}") == _data(600 + i, i)
+            except Exception as e:            # noqa: BLE001
+                errs.append(e)
+        ta = threading.Thread(target=worker, args=(a, "a"))
+        tb = threading.Thread(target=worker, args=(b, "b"))
+        ta.start(), tb.start()
+        ta.join(60), tb.join(60)
+        assert not errs
+        # each client sees the other's writes
+        assert a.get("p", "b3") == _data(603, 3)
+        assert b.get("p", "a7") == _data(607, 7)
+        a.close(), b.close()
+
+    def test_watch_notify_across_connections(self, served):
+        """Client A watches; client B notifies; A's callback value rides
+        the ack back to B — the cross-process watch/notify contract."""
+        server, keyring = served
+        a = TcpRados("127.0.0.1", server.port, keyring)
+        b = TcpRados("127.0.0.1", server.port, keyring)
+        a.mkpool("p", replicated=True, size=3)
+        a.put("p", "watched", b"x")
+        got = []
+
+        def on_notify(notify_id, cookie, payload):
+            got.append(bytes(payload))
+            return b"seen:" + bytes(payload)
+        a.watch("p", "watched", cookie=77, on_notify=on_notify)
+        acks = b.notify("p", "watched", b"ping")
+        assert got == [b"ping"]
+        assert acks == {77: b"seen:ping"}
+        a.unwatch("p", "watched", 77)
+        assert b.notify("p", "watched", b"again") == {}
+        a.close(), b.close()
+
+    def test_wrong_key_rejected(self, served):
+        server, keyring = served
+        bad = keyring.parent / "bad.keyring"
+        with open(keyring, "rb") as f:
+            saved = pickle.load(f)
+        saved["key"] = b"\x00" * 32
+        with open(bad, "wb") as f:
+            pickle.dump(saved, f)
+        from ceph_tpu.auth.cephx import AuthError
+        from ceph_tpu.backend.wire import WireError
+        with pytest.raises((AuthError, WireError, ConnectionError,
+                            IOError)):
+            TcpRados("127.0.0.1", server.port, bad)
+
+
+class TestTwoProcesses:
+    def test_cli_server_process_and_concurrent_clients(self, tmp_path):
+        """THE integration check: the cluster lives in another PROCESS
+        (rados serve); this process runs two concurrent clients doing
+        put/get + watch/notify over real sockets."""
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ceph_tpu.tools.rados_cli",
+             "--data-dir", str(tmp_path), "serve", "--port", "0"],
+            stdout=subprocess.PIPE, text=True, env=env)
+        try:
+            line = proc.stdout.readline()
+            assert "serving on" in line, line
+            port = int(line.rsplit(":", 1)[1])
+            keyring = tmp_path / "client.admin.keyring"
+            deadline = time.monotonic() + 30
+            while not keyring.exists():
+                assert time.monotonic() < deadline
+                time.sleep(0.1)
+            a = TcpRados("127.0.0.1", port, keyring)
+            b = TcpRados("127.0.0.1", port, keyring)
+            a.mkpool("p", profile={"k": "2", "m": "1",
+                                   "device": "numpy"})
+            payload = _data(30000, 9)
+            seen = []
+            a.put("p", "obj", payload)
+            a.watch("p", "obj", cookie=5,
+                    on_notify=lambda nid, ck, pl: seen.append(bytes(pl))
+                    or b"ok")
+            assert b.get("p", "obj") == payload
+            acks = b.notify("p", "obj", b"hello-from-b")
+            assert seen == [b"hello-from-b"]
+            assert acks == {5: b"ok"}
+            # concurrent hammering from both clients
+            errs = []
+
+            def w(r, tag):
+                try:
+                    for i in range(10):
+                        r.put("p", f"{tag}{i}", _data(800 + i, i))
+                        assert r.get("p", f"{tag}{i}") == _data(800 + i, i)
+                except Exception as e:        # noqa: BLE001
+                    errs.append(e)
+            ts = [threading.Thread(target=w, args=(a, "a")),
+                  threading.Thread(target=w, args=(b, "b"))]
+            [t.start() for t in ts]
+            [t.join(60) for t in ts]
+            assert not errs
+            a.close(), b.close()
+        finally:
+            proc.terminate()
+            proc.wait(timeout=30)
+
+    def test_cli_connect_verbs(self, tmp_path):
+        """rados --connect runs its verbs against the live server
+        process: two processes sharing one cluster concurrently."""
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ceph_tpu.tools.rados_cli",
+             "--data-dir", str(tmp_path), "serve", "--port", "0"],
+            stdout=subprocess.PIPE, text=True, env=env)
+        try:
+            line = proc.stdout.readline()
+            port = int(line.rsplit(":", 1)[1])
+            keyring = str(tmp_path / "client.admin.keyring")
+            deadline = time.monotonic() + 30
+            while not os.path.exists(keyring):
+                assert time.monotonic() < deadline
+                time.sleep(0.1)
+
+            def cli(*argv, data=None):
+                return subprocess.run(
+                    [sys.executable, "-m", "ceph_tpu.tools.rados_cli",
+                     "--connect", f"127.0.0.1:{port}",
+                     "--keyring", keyring, *argv],
+                    input=data, capture_output=True, env=env, timeout=120)
+            r = cli("mkpool", "p", "replicated")
+            assert r.returncode == 0, r.stderr
+            r = cli("put", "p", "obj", "-", data=b"over-the-wire")
+            assert r.returncode == 0, r.stderr
+            r = cli("get", "p", "obj", "-")
+            assert r.returncode == 0 and r.stdout == b"over-the-wire"
+            r = cli("ls", "p")
+            assert r.stdout.decode().split() == ["obj"]
+            r = cli("df")
+            assert b"pools" in r.stdout
+        finally:
+            proc.terminate()
+            proc.wait(timeout=30)
