@@ -203,10 +203,11 @@ class IoCtx:
         self.operate(oid, ObjectOperation().remove())
 
     def list_objects(self) -> list[str]:
+        from ..osd.hit_set import is_hit_set_oid
         from ..osd.primary_log_pg import is_clone_oid
         return sorted(o for o in
                       self.rados.cluster.objects.get(self.pool_id, ())
-                      if not is_clone_oid(o))
+                      if not is_clone_oid(o) and not is_hit_set_oid(o))
 
     # -- xattrs --------------------------------------------------------------
 

@@ -227,10 +227,13 @@ class MiniCluster:
         return self._instantiate_pool(pool, name, ec)
 
     def create_replicated_pool(self, name: str, size: int = 3,
-                               pg_num: int = 8) -> int:
+                               pg_num: int = 8,
+                               params: dict | None = None) -> int:
         """Replicated pool: ``size`` full copies, min_size = size//2 + 1
         (the mon's defaults for ``osd pool create ... replicated``);
-        CRUSH chooses hosts firstn the way replicated rules do."""
+        CRUSH chooses hosts firstn the way replicated rules do.
+        ``params`` carries pool options (hit_set_count/hit_set_period
+        arm cache-tier hit sets)."""
         root = self.osdmap.crush.item_id("default")
         n_hosts = sum(1 for bid, b in self.osdmap.crush.buckets.items()
                       if b.type == 1 and not self.osdmap.crush.is_shadow(bid))
@@ -243,7 +246,8 @@ class MiniCluster:
         self._next_pool += 1
         pool = Pool(pool_id=pool_id, type=POOL_TYPE_REPLICATED, size=size,
                     min_size=size // 2 + 1, pg_num=pg_num,
-                    crush_rule=ruleno, name=name, params={"size": str(size)})
+                    crush_rule=ruleno, name=name,
+                    params={"size": str(size), **(params or {})})
         return self._instantiate_pool(pool, name, None)
 
     def _instantiate_pool(self, pool: Pool, name: str, ec) -> int:
@@ -264,10 +268,25 @@ class MiniCluster:
                               epoch=self.osdmap.epoch,
                               bus=self.bus)
             self.osds[acting[0]].register_pg(pgid, pgs[ps])
+            self._arm_hit_sets(pgs[ps], pool)
         self.pools[pool.pool_id] = {"pool": pool, "pgs": pgs, "ec": ec}
         self.pool_ids[name] = pool.pool_id
         self._save_meta()
         return pool.pool_id
+
+    @staticmethod
+    def _arm_hit_sets(g: PGGroup, pool: Pool) -> None:
+        """hit_set_count/hit_set_period pool params arm per-PG hit-set
+        accumulation (PrimaryLogPG::hit_set_setup; the tiering agent's
+        temperature source).  Called at pool creation AND after a remap
+        rebuilds the PGGroup — the new engine would otherwise silently
+        stop tracking and the agent would evict its whole working set."""
+        hs_count = int(pool.params.get("hit_set_count", 0))
+        if hs_count > 0:
+            g.engine.configure_hit_sets(
+                hs_count, int(pool.params.get("hit_set_period", 100)),
+                int(pool.params.get("hit_set_target_size", 1000)),
+                float(pool.params.get("hit_set_fpp", 0.05)))
 
     # -- durability (data_dir mode) ----------------------------------------
 
@@ -349,7 +368,8 @@ class MiniCluster:
         for p in meta["pools"]:
             if p["type"] == POOL_TYPE_REPLICATED:
                 pid = c.create_replicated_pool(p["name"], p["size"],
-                                               p["pg_num"])
+                                               p["pg_num"],
+                                               params=p.get("params"))
             else:
                 pid = c.create_ec_pool(p["name"], p["params"], p["pg_num"])
             pool = c.pools[pid]["pool"]
@@ -367,8 +387,11 @@ class MiniCluster:
                 # was never acked); only then repair stale shards
                 g.backend.start_boot_peering()
                 g.bus.deliver_all()
+                from .osd.hit_set import is_hit_set_oid
+                from .osd.primary_log_pg import is_clone_oid
                 c.objects.setdefault(pid, set()).update(
-                    g.backend._local_oids())
+                    o for o in g.backend._local_oids()
+                    if not is_clone_oid(o) and not is_hit_set_oid(o))
                 for osd in g.acting:
                     if osd != g.backend.whoami:
                         g.backend.start_shard_repair(osd)
@@ -516,7 +539,8 @@ class MiniCluster:
 
     def _dispatch_op_vector(self, g, pool_id: int, oid: str, ops,
                             epoch: int, on_done, drain: bool = True,
-                            snapid: int | None = None):
+                            snapid: int | None = None,
+                            internal: bool = False):
         """ONE copy of the MOSDOp dispatch path (used by operate() and
         the Objecter-facing osd_submit): daemon queue -> op engine, with
         object bookkeeping in the COMPLETION callback — a write parked on
@@ -546,7 +570,8 @@ class MiniCluster:
                 on_done(reply)
         res = daemon.ms_dispatch(
             g.pgid, MOSDOp(oid=oid, ops=ops, epoch=epoch, snapid=snapid,
-                           snapc=self._snap_context(pool_id)), _done)
+                           snapc=self._snap_context(pool_id),
+                           internal=internal), _done)
         if res is not None:
             return res
         if drain:
@@ -562,7 +587,8 @@ class MiniCluster:
         return None
 
     def operate(self, pool_id: int, oid: str, op,
-                deliver: bool = True, snapid: int | None = None):
+                deliver: bool = True, snapid: int | None = None,
+                internal: bool = False):
         """Execute a librados-style op vector atomically on ``oid``
         through the primary's op engine (IoCtx::operate →
         PrimaryLogPG::do_osd_ops).  Returns the MOSDOpReply; raises
@@ -588,7 +614,8 @@ class MiniCluster:
             out.append(reply)
         res = self._dispatch_op_vector(g, pool_id, oid, op.ops,
                                        self.osdmap.epoch, _cb,
-                                       drain=deliver, snapid=snapid)
+                                       drain=deliver, snapid=snapid,
+                                       internal=internal)
         if res is not None:
             raise IOError(f"op on {oid} bounced as stale: {res}")
         if not deliver:
@@ -981,6 +1008,7 @@ class MiniCluster:
         # BE the laundered rot, and dropping the flag would let it scrub
         # clean forever without an operator restore
         new.backend.inconsistent_objects |= damaged
+        self._arm_hit_sets(new, self.pools[pool_id]["pool"])
         self.pools[pool_id]["pgs"][ps] = new
         # re-home the PG on its (possibly new) primary's daemon
         if old.backend.whoami != new.backend.whoami:

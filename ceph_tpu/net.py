@@ -115,19 +115,106 @@ _TYPES = {c.__name__: c for c in (
     CephxBegin, CephxChallenge, CephxAuthenticate, CephxSession,
     CephxAuthorize, CephxDone, RpcCall, RpcResult, NotifyPush, NotifyAck)}
 
+# ---- pre-auth codec: NO pickle before the peer is authenticated ----------
+#
+# Everything that arrives before the HMAC session is established is
+# attacker-controlled, and unpickling attacker bytes is remote code
+# execution.  The six handshake message types therefore serialize as
+# plain length-prefixed primitive fields (str/bytes/int only); pickle is
+# allowed ONLY for post-auth frames, whose HMAC a peer without the
+# session key cannot forge (the same trust line ProtocolV2 draws at its
+# auth-done frame).
+
+_HANDSHAKE_FIELDS = {
+    "CephxBegin": ("name",),
+    "CephxChallenge": ("challenge",),
+    "CephxAuthenticate": ("client_challenge", "proof"),
+    "CephxSession": ("env", "ticket_env"),
+    "CephxDone": ("reply",),
+    # Authorizer flattened: the only nested handshake payload
+    "CephxAuthorize": ("service", "blob", "secret_id", "nonce", "proof"),
+}
+_LEN = struct.Struct("<I")
+
+
+def _pack_field(v) -> bytes:
+    if isinstance(v, str):
+        tag, payload = b"s", v.encode()
+    elif isinstance(v, (bytes, bytearray)):
+        tag, payload = b"b", bytes(v)
+    elif isinstance(v, int):
+        tag, payload = b"i", str(int(v)).encode()
+    else:
+        raise WireError(f"unsupported handshake field {type(v)}")
+    return tag + _LEN.pack(len(payload)) + payload
+
+
+def _unpack_fields(blob: bytes) -> list:
+    out, off = [], 0
+    while off < len(blob):
+        tag = blob[off:off + 1]
+        (ln,) = _LEN.unpack_from(blob, off + 1)
+        payload = blob[off + 1 + _LEN.size:off + 1 + _LEN.size + ln]
+        if len(payload) != ln:
+            raise WireError("truncated handshake field")
+        off += 1 + _LEN.size + ln
+        if tag == b"s":
+            out.append(payload.decode())
+        elif tag == b"b":
+            out.append(payload)
+        elif tag == b"i":
+            out.append(int(payload))
+        else:
+            raise WireError(f"bad handshake field tag {tag!r}")
+    return out
+
+
+def _handshake_dumps(msg) -> bytes:
+    name = type(msg).__name__
+    fields = _HANDSHAKE_FIELDS[name]
+    if name == "CephxAuthorize":
+        a = msg.authorizer
+        values = [a.service, a.blob, a.secret_id, a.nonce, a.proof]
+    else:
+        values = [getattr(msg, f) for f in fields]
+    return b"".join(_pack_field(v) for v in values)
+
+
+def _handshake_loads(name: str, blob: bytes):
+    values = _unpack_fields(blob)
+    if len(values) != len(_HANDSHAKE_FIELDS[name]):
+        raise WireError(f"bad field count for {name}")
+    if name == "CephxAuthorize":
+        return CephxAuthorize(Authorizer(*values))
+    return _TYPES[name](*values)
+
 
 def _encode(msg, secret: bytes | None) -> bytes:
-    return frame_encode(TAG_MESSAGE,
-                        [type(msg).__name__.encode(), pickle.dumps(msg)],
+    name = type(msg).__name__
+    if name in _HANDSHAKE_FIELDS:
+        payload = _handshake_dumps(msg)
+    else:
+        if secret is None:
+            raise WireError(f"{name} may not ride an unauthenticated "
+                            f"connection")
+        payload = pickle.dumps(msg)
+    return frame_encode(TAG_MESSAGE, [name.encode(), payload],
                         secret=secret)
 
 
-def _decode(tag: int, segs: list[bytes]):
+def _decode(tag: int, segs: list[bytes], *, authed: bool):
     if tag != TAG_MESSAGE or len(segs) != 2:
         raise WireError(f"unexpected frame tag {tag}")
-    klass = _TYPES.get(segs[0].decode())
+    name = segs[0].decode()
+    klass = _TYPES.get(name)
     if klass is None:
-        raise WireError(f"unknown rpc type {segs[0]!r}")
+        raise WireError(f"unknown rpc type {name!r}")
+    if name in _HANDSHAKE_FIELDS:
+        return _handshake_loads(name, segs[1])
+    if not authed:
+        # pickle is reachable ONLY behind the HMAC (pre-auth unpickling
+        # of peer bytes would be remote code execution)
+        raise WireError(f"{name} before authentication")
     msg = pickle.loads(segs[1])
     if type(msg) is not klass:
         raise WireError("rpc type name mismatch")
@@ -176,7 +263,8 @@ class Channel:
                 self._banner_buf.clear()
             frames = self.parser.feed(data)
             if frames:
-                return [_decode(t, s) for t, s in frames]
+                return [_decode(t, s, authed=self.secret is not None)
+                        for t, s in frames]
 
     def recv_one(self):
         msgs = self.recv_msgs()
@@ -207,6 +295,10 @@ class ClusterServer:
         self.port = self._listener.getsockname()[1]
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
+        # the KeyServer's per-entity challenge/session slots are single
+        # (cephx.py _pending/_sessions): concurrent handshakes for the
+        # same entity must serialize or they clobber each other
+        self._auth_lock = threading.Lock()
         # cookie -> (channel, client name) for remote watchers
         self._watchers: dict[int, Channel] = {}
         self._pending_acks: dict[tuple[int, int], list] = {}
@@ -214,27 +306,42 @@ class ClusterServer:
 
     # -- keyring -------------------------------------------------------------
 
+    SERVER_KEYS = "mon.keyserver"     # server-only: rotating secrets
+
     def _load_or_create_keys(self) -> None:
+        """The CLIENT keyring carries ONLY the entity key (a real cephx
+        keyring's content); the rotating service secrets stay in a
+        separate server-only file — a keyring holder must never be able
+        to seal ticket blobs and impersonate entities."""
         data_dir = getattr(self.cluster, "data_dir", None)
-        path = Path(data_dir) / KEYRING if data_dir is not None else None
-        if path is not None and path.exists():
-            with open(path, "rb") as f:
+        base = Path(data_dir) if data_dir is not None else None
+        if base is not None and (base / self.SERVER_KEYS).exists():
+            with open(base / self.SERVER_KEYS, "rb") as f:
                 saved = pickle.load(f)
-            self.keyserver.entity_keys["client.admin"] = saved["key"]
+            self.keyserver.entity_keys.update(saved["entity_keys"])
             self.keyserver.rotating = saved["rotating"]
             return
         self.keyserver.create_entity("client.admin")
         self.keyserver.rotate(SERVICE)
-        if path is not None:
-            with open(path, "wb") as f:
-                pickle.dump({"key":
-                             self.keyserver.entity_keys["client.admin"],
+        if base is not None:
+            with open(base / self.SERVER_KEYS, "wb") as f:
+                pickle.dump({"entity_keys":
+                             dict(self.keyserver.entity_keys),
                              "rotating": self.keyserver.rotating}, f)
+            with open(base / KEYRING, "wb") as f:
+                pickle.dump({"key":
+                             self.keyserver.entity_keys["client.admin"]},
+                            f)
 
     # -- lifecycle -----------------------------------------------------------
 
     def serve_forever(self) -> None:
-        self._listener.settimeout(0.25)
+        try:
+            self._listener.settimeout(0.25)
+        except OSError:
+            if self._stop.is_set():
+                return              # stopped before the loop started
+            raise
         while not self._stop.is_set():
             try:
                 sock, _addr = self._listener.accept()
@@ -266,7 +373,12 @@ class ClusterServer:
     def _serve_conn(self, sock: socket.socket) -> None:
         ch = Channel(sock)
         try:
-            name, session_key = self._handshake(ch)
+            # the auth lock is held across handshake round-trips: bound
+            # them so a stalled client cannot freeze everyone's connects
+            sock.settimeout(10.0)
+            with self._auth_lock:
+                name, session_key = self._handshake(ch)
+            sock.settimeout(None)
             ch.secure(session_key)
             while True:
                 for msg in ch.recv_msgs():
@@ -381,8 +493,13 @@ class ClusterServer:
         return True
 
     def _rpc_ls(self, ch, pool):
+        from .osd.hit_set import is_hit_set_oid
+        from .osd.primary_log_pg import is_clone_oid
         pid = self.cluster.pool_ids[pool]
-        return sorted(self.cluster.objects.get(pid, set()))
+        # internal oids (snapshot clones, hit-set archives) stay hidden,
+        # like the local IoCtx listing
+        return sorted(o for o in self.cluster.objects.get(pid, set())
+                      if not is_clone_oid(o) and not is_hit_set_oid(o))
 
     def _rpc_setxattr(self, ch, pool, oid, name, value):
         from .osd.osd_ops import ObjectOperation

@@ -219,6 +219,10 @@ class MOSDOp:
     tid: int = 0
     snapid: int | None = None          # read AT this snap (None = head)
     snapc: SnapContext | None = None   # write-time snap context
+    # internal ops (tiering agent, scrub helpers) must not count as
+    # client accesses — they would keep every object artificially hot in
+    # the hit sets (the reference's agent IO bypasses hit_set tracking)
+    internal: bool = False
 
 
 @dataclass

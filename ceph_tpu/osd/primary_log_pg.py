@@ -19,7 +19,12 @@ omap ops with guards, object classes (cls registry), snapshots
 (SnapContext COW + snap reads + rollback + list_snaps) and watch/notify.
 
 Scope notes (deliberate divergences, all returning clean errors):
-- cache-tiering ops are not implemented;
+- cache tiering lives in osd/hit_set.py (per-period bloom hit sets
+  accumulated here, archived as internal PG objects) + osd/tiering.py
+  (writeback CacheTier facade + flush/evict TieringAgent); the in-engine
+  proxy/flush OPS of the reference (COPY_FROM, CACHE_FLUSH/EVICT
+  opcodes) stay out of the opcode switch — the facade + agent carry the
+  same semantics at pool level;
 - data READs inside a *write* vector are rejected with -EINVAL on EC
   pools (the reference queues them as pending_async_reads; here a vector
   is either data-reading or mutating — metadata reads work in both);
@@ -291,12 +296,94 @@ class PrimaryLogPG:
         # watch/notify state (the obc watchers map, src/osd/Watch.cc)
         self.watchers: dict[str, dict[int, object]] = {}
         self.notify_id = 0
+        # hit-set accumulation (PrimaryLogPG.h:952-966); configured by
+        # the pool's hit_set_* params via configure_hit_sets
+        self.hit_set = None
+        self.hit_set_params: dict | None = None
+        self.hit_set_archive_n = 0
+        self._hit_set_ops = 0
+
+    # -- hit sets (hit_set_setup/persist/trim, PrimaryLogPG.h:957-961) ------
+
+    def configure_hit_sets(self, count: int, period: int,
+                           target_size: int = 1000,
+                           fpp: float = 0.05) -> None:
+        """hit_set_setup: start accumulating per-period bloom hit sets,
+        archived as internal PG objects in a ring of ``count``.  The
+        period counts OPS (deterministic in-process; the reference uses
+        wall-clock seconds — see osd/hit_set.py)."""
+        from .hit_set import HIT_SET_PREFIX, BloomHitSet, is_hit_set_oid
+        self.hit_set_params = {"count": int(count), "period": int(period),
+                               "target_size": int(target_size),
+                               "fpp": float(fpp)}
+        self.hit_set = BloomHitSet(target_size, fpp)
+        self._hit_set_ops = 0
+        # restart: resume the archive ring after the persisted ones
+        store = self.backend.local_shard.store
+        ns = [int(g.oid[len(HIT_SET_PREFIX):])
+              for g in store.list_objects()
+              if g.shard == self.backend.whoami and is_hit_set_oid(g.oid)]
+        self.hit_set_archive_n = max(ns, default=-1) + 1
+
+    def _hit_set_record(self, oid: str) -> None:
+        from .hit_set import is_hit_set_oid
+        if self.hit_set is None or is_hit_set_oid(oid):
+            return
+        parsed = split_clone_oid(oid)
+        self.hit_set.insert(parsed[0] if parsed else oid)
+        self._hit_set_ops += 1
+        if self._hit_set_ops >= self.hit_set_params["period"]:
+            self.hit_set_persist()
+
+    def hit_set_persist(self) -> None:
+        """Archive the accumulating set as an internal PG object and trim
+        the ring past hit_set_count (hit_set_persist + hit_set_trim)."""
+        from .hit_set import BloomHitSet, archive_oid
+        p = self.hit_set_params
+        n = self.hit_set_archive_n
+        self.hit_set_archive_n += 1
+        t = PGTransaction().write(archive_oid(n), 0,
+                                  self.hit_set.to_bytes())
+        old = n - p["count"]
+        if old >= 0:
+            t.delete(archive_oid(old))
+        self.backend.submit_transaction(t)
+        self._hit_set_ops = 0
+        self.hit_set = BloomHitSet(p["target_size"], p["fpp"])
+
+    def hit_set_archives(self) -> list:
+        """The persisted ring, oldest first (agent_load_hit_sets)."""
+        from .hit_set import BloomHitSet, archive_oid
+        if self.hit_set_params is None:
+            return []
+        store = self.backend.local_shard.store
+        out = []
+        lo = max(0, self.hit_set_archive_n - self.hit_set_params["count"])
+        for n in range(lo, self.hit_set_archive_n):
+            gobj = GObject(archive_oid(n), self.backend.whoami)
+            if store.exists(gobj):
+                out.append(BloomHitSet.from_bytes(bytes(
+                    store.read(gobj))))
+        return out
+
+    def object_temperature(self, oid: str) -> int:
+        """How many recent hit sets (current + archives) saw this object
+        (agent_estimate_temp: 0 = cold, eviction candidate)."""
+        temp = 0
+        if self.hit_set is not None and self.hit_set.contains(oid):
+            temp += 1
+        for hs in self.hit_set_archives():
+            if hs.contains(oid):
+                temp += 1
+        return temp
 
     # -- entry -------------------------------------------------------------
 
     def do_op(self, m: MOSDOp, on_reply: Callable[[MOSDOpReply], None]):
         """Execute one client op vector; on_reply fires with the reply —
         immediately for pure reads, at commit for mutations."""
+        if not m.internal:
+            self._hit_set_record(m.oid)
         if m.oid in self._busy:
             self._waiting.setdefault(m.oid, deque()).append((m, on_reply))
             return

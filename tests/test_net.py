@@ -202,3 +202,31 @@ class TestTwoProcesses:
         finally:
             proc.terminate()
             proc.wait(timeout=30)
+
+
+class TestPreAuthHardening:
+    def test_rpc_frames_rejected_before_auth(self, served):
+        """A peer skipping cephx cannot reach the pickle decoder: RPC
+        frames before authentication are refused at the codec (pre-auth
+        unpickling of peer bytes would be remote code execution)."""
+        import socket as socket_mod
+        from ceph_tpu.backend.wire import BANNER, frame_encode
+        server, _keyring = served
+        sock = socket_mod.create_connection(("127.0.0.1", server.port))
+        sock.recv(65536)                     # server banner
+        evil = frame_encode(
+            17, [b"RpcCall", pickle.dumps({"anything": 1})])
+        sock.sendall(BANNER + evil)
+        # the server drops the connection instead of unpickling
+        sock.settimeout(10)
+        assert sock.recv(65536) == b""
+        sock.close()
+
+    def test_keyring_has_no_rotating_secrets(self, served):
+        """The client keyring carries ONLY the entity key; rotating
+        service secrets stay server-side (a keyring holder must not be
+        able to forge ticket blobs)."""
+        _server, keyring = served
+        with open(keyring, "rb") as f:
+            saved = pickle.load(f)
+        assert set(saved) == {"key"}

@@ -1,0 +1,197 @@
+"""Hit sets + cache tiering (r4 VERDICT missing #6; reference:
+src/osd/HitSet.h, src/osd/PrimaryLogPG.h:952-992 hit_set_* + agent_*,
+osd_types.h CACHEMODE_WRITEBACK / FLAG_DIRTY)."""
+import numpy as np
+import pytest
+
+from ceph_tpu.cluster import MiniCluster
+from ceph_tpu.osd.hit_set import BloomHitSet, archive_oid, is_hit_set_oid
+from ceph_tpu.osd.osd_ops import ObjectOperation
+from ceph_tpu.osd.tiering import DIRTY_ATTR, CacheTier, TieringAgent
+
+
+def _data(n, seed=0):
+    return np.random.default_rng(seed).integers(
+        0, 256, n, dtype=np.uint8).tobytes()
+
+
+class TestBloomHitSet:
+    def test_membership_and_fpp(self):
+        hs = BloomHitSet(target_size=500, fpp=0.01, seed=7)
+        members = [f"obj{i}" for i in range(500)]
+        for oid in members:
+            hs.insert(oid)
+        assert all(hs.contains(o) for o in members)   # no false negatives
+        fp = sum(hs.contains(f"other{i}") for i in range(2000))
+        assert fp < 2000 * 0.05        # ~1% target, generous bound
+
+    def test_serialization_roundtrip(self):
+        hs = BloomHitSet(target_size=100, fpp=0.02)
+        for i in range(80):
+            hs.insert(f"o{i}")
+        hs2 = BloomHitSet.from_bytes(hs.to_bytes())
+        assert all(hs2.contains(f"o{i}") for i in range(80))
+        assert hs2.inserts == 80
+
+
+@pytest.fixture
+def tiered():
+    c = MiniCluster(n_osds=6, osds_per_host=2, chunk_size=512)
+    base = c.create_ec_pool("base", {"k": "2", "m": "1",
+                                     "device": "numpy"}, pg_num=4)
+    cache = c.create_replicated_pool(
+        "cache", size=3, pg_num=4,
+        params={"hit_set_count": "2", "hit_set_period": "8"})
+    yield c, cache, base
+    c.shutdown()
+
+
+class TestHitSets:
+    def test_accumulation_and_archive_ring(self, tiered):
+        c, cache, _base = tiered
+        for i in range(40):             # 40 ops / period 8 = 5 persists
+            c.operate(cache, f"o{i % 4}",
+                      ObjectOperation().write_full(b"x" * 100))
+        g = c.pg_group(cache, "o0")
+        archives = g.engine.hit_set_archives()
+        assert 1 <= len(archives) <= 2            # ring trims to count=2
+        # trimmed archives are GONE from the store
+        from ceph_tpu.backend.memstore import GObject
+        store = g.backend.local_shard.store
+        kept = [n for n in range(g.engine.hit_set_archive_n)
+                if store.exists(GObject(archive_oid(n),
+                                        g.backend.whoami))]
+        assert len(kept) <= 2
+        assert min(kept) >= g.engine.hit_set_archive_n - 2
+
+    def test_temperature(self, tiered):
+        c, cache, _base = tiered
+        for _ in range(3):
+            c.operate(cache, "hot", ObjectOperation().write_full(b"h"))
+        g = c.pg_group(cache, "hot")
+        assert g.engine.object_temperature("hot") >= 1
+        assert g.engine.object_temperature("never-seen") == 0
+
+    def test_internal_ops_not_recorded(self, tiered):
+        c, cache, _base = tiered
+        g = c.pg_group(cache, "ghost")
+        c.operate(cache, "ghost", ObjectOperation().write_full(b"x"),
+                  internal=True)
+        assert g.engine.object_temperature("ghost") == 0
+
+
+class TestCacheTier:
+    def test_writeback_flush_and_promote(self, tiered):
+        c, cache, base = tiered
+        tier = CacheTier(c, cache, base)
+        agent = TieringAgent(c, cache, base)
+        payload = _data(3000, 1)
+        tier.write("obj", payload)
+        assert agent.is_dirty("obj")
+        # not yet on the base pool
+        with pytest.raises(IOError):
+            c.operate(base, "obj", ObjectOperation().stat())
+        agent.flush("obj")
+        assert not agent.is_dirty("obj")
+        r = c.operate(base, "obj", ObjectOperation().read(0, 0))
+        assert r.outdata(0)[:len(payload)] == payload
+        # evict, then a read MISS promotes from base
+        agent.evict("obj")
+        with pytest.raises(IOError):
+            c.operate(cache, "obj", ObjectOperation().stat())
+        assert tier.read("obj") == payload        # promoted
+        c.operate(cache, "obj", ObjectOperation().stat())   # in cache now
+
+    def test_agent_flushes_dirty_and_evicts_cold(self, tiered):
+        c, cache, base = tiered
+        tier = CacheTier(c, cache, base)
+        agent = TieringAgent(c, cache, base)
+        for i in range(4):
+            tier.write(f"cold{i}", _data(500 + i, i))
+        tier.write("hotobj", _data(200, 99))
+        # agent passes with aging: hit sets are PER-PG and op-count
+        # periods never advance on idle PGs, so the agent pass is the
+        # clock — the hot object is re-read each period, the cold ones
+        # age out of the ring (count=2) and evict
+        stats = {}
+        for _ in range(4):
+            assert tier.read("hotobj") == _data(200, 99)
+            stats = agent.agent_work(age=True)
+        assert stats["flushes"] >= 5              # everything flushed
+        # cold objects evicted; base holds their bytes
+        for i in range(4):
+            with pytest.raises(IOError):
+                c.operate(cache, f"cold{i}", ObjectOperation().stat())
+            r = c.operate(base, f"cold{i}", ObjectOperation().read(0, 0))
+            assert r.outdata(0)[:500 + i] == _data(500 + i, i)
+        # the hot object stays cached
+        c.operate(cache, "hotobj", ObjectOperation().stat())
+        assert stats["skipped_hot"] >= 1
+        # reads after eviction still work through the tier (promote)
+        assert tier.read("cold2") == _data(502, 2)
+
+    def test_dirty_flag_survives_user_xattrs(self, tiered):
+        c, cache, base = tiered
+        tier = CacheTier(c, cache, base)
+        agent = TieringAgent(c, cache, base)
+        tier.write("x", b"v1")
+        c.operate(cache, "x", ObjectOperation().setxattr("user", b"u"))
+        agent.flush("x")
+        # user xattrs travel to the base copy; the dirty flag does not
+        r = c.operate(base, "x", ObjectOperation().getxattr("user"))
+        assert r.outdata(0) == b"u"
+        with pytest.raises(IOError):
+            c.operate(base, "x", ObjectOperation().getxattr(DIRTY_ATTR))
+
+
+class TestHitSetsSurviveRestart(object):
+    def test_archives_reload(self, tmp_path):
+        c = MiniCluster(n_osds=6, osds_per_host=2, chunk_size=512,
+                        data_dir=tmp_path)
+        cache = c.create_replicated_pool(
+            "cache", size=3, pg_num=4,
+            params={"hit_set_count": "2", "hit_set_period": "4"})
+        for i in range(16):
+            c.operate(cache, "obj", ObjectOperation().write_full(b"x"))
+        g = c.pg_group(cache, "obj")
+        n_before = g.engine.hit_set_archive_n
+        assert n_before >= 2
+        c.shutdown()
+        c2 = MiniCluster.load(tmp_path)
+        g2 = c2.pg_group(c2.pool_ids["cache"], "obj")
+        # the ring resumes after the persisted archives
+        assert g2.engine.hit_set_archive_n == n_before
+        assert g2.engine.object_temperature("obj") >= 1
+        c2.shutdown()
+
+
+class TestRemapKeepsHitSets:
+    def test_backfill_rearms_hit_sets_and_moves_archives(self):
+        """A remapped cache-pool PG must keep accumulating hit sets and
+        keep its archive ring (regression: the rebuilt PGGroup had
+        hit_set_params=None, so the agent evicted the whole working set
+        as 'cold')."""
+        from ceph_tpu.common import Context
+        cct = Context(overrides={"mon_osd_down_out_interval": 60})
+        c = MiniCluster(n_osds=8, osds_per_host=2, chunk_size=512,
+                        cct=cct)
+        cache = c.create_replicated_pool(
+            "cache", size=3, pg_num=4,
+            params={"hit_set_count": "2", "hit_set_period": "4"})
+        mon = c.attach_monitor()
+        for _ in range(10):
+            c.operate(cache, "obj", ObjectOperation().write_full(b"x"))
+        g = c.pg_group(cache, "obj")
+        primaries = {gg.backend.whoami
+                     for gg in c.pools[cache]["pgs"].values()}
+        victim = next(o for o in g.acting if o not in primaries)
+        for r in [o for o in range(8) if o != victim][:4]:
+            mon.prepare_failure(victim, r, 0.0, 25.0)
+        mon.propose_pending(25.0)
+        mon.tick(5000.0)                   # auto-out -> remap + backfill
+        g2 = c.pg_group(cache, "obj")
+        assert list(g2.acting) != list(g.acting)
+        assert g2.engine.hit_set_params is not None
+        assert g2.engine.object_temperature("obj") >= 1   # archives moved
+        c.operate(cache, "obj", ObjectOperation().read(0, 0))
+        c.shutdown()
