@@ -517,6 +517,9 @@ class ClusterServer:
     def _rpc_status(self, ch):
         return self.cluster.status()
 
+    def _rpc_health(self, ch):
+        return self.cluster.health()
+
     def _rpc_watch(self, ch, pool, oid, cookie):
         from .osd.osd_ops import ObjectOperation
         pid = self.cluster.pool_ids[pool]
@@ -555,6 +558,25 @@ class ClusterServer:
         # exceptions don't pickle reliably; stringify them
         return {ck: (repr(v) if isinstance(v, Exception) else v)
                 for ck, v in acks.items()}
+
+
+# -- CLI helper --------------------------------------------------------------
+
+def cli_connect(connect: str, keyring: str | None, data_dir: str | None):
+    """Shared --connect preamble for the rados/ceph CLIs: parse
+    HOST:PORT, resolve the keyring (explicit or <data-dir>/keyring), and
+    open an authenticated TcpRados.  Raises ValueError/IOError/AuthError
+    with operator-readable messages; the CLIs map those to 'error: ...'
+    + exit 2."""
+    host, _, port_s = connect.rpartition(":")
+    if not host or not port_s.isdigit():
+        raise ValueError(f"--connect wants HOST:PORT, got {connect!r}")
+    keyring = keyring or (os.path.join(data_dir, KEYRING)
+                          if data_dir else None)
+    if keyring is None:
+        raise ValueError("--keyring (or --data-dir) required with "
+                         "--connect")
+    return TcpRados(host, int(port_s), keyring)
 
 
 # -- client ------------------------------------------------------------------

@@ -77,13 +77,23 @@ def main(argv=None) -> int:
     argv = ["status" if a == "-s" else a
             for a in (sys.argv[1:] if argv is None else list(argv))]
     ap = argparse.ArgumentParser(prog="ceph")
-    ap.add_argument("--data-dir", required=True)
+    ap.add_argument("--data-dir")
+    ap.add_argument("--connect", metavar="HOST:PORT",
+                    help="talk to a live cluster process over TCP "
+                         "(status/health/df)")
+    ap.add_argument("--keyring",
+                    help="client.admin keyring (default: "
+                         "<data-dir>/client.admin.keyring)")
     ap.add_argument("cmd", nargs="+",
                     help="status | -s | health [detail] | osd tree | "
                          "osd df | pg dump | df")
     args = ap.parse_args(argv)
 
     import os
+    if args.connect:
+        return _run_remote(args)
+    if args.data_dir is None:
+        ap.error("--data-dir is required (or --connect for remote mode)")
     from ..cluster import MiniCluster
     if not os.path.exists(os.path.join(args.data_dir, "cluster_meta.pkl")):
         print(f"error: no cluster at {args.data_dir}", file=sys.stderr)
@@ -92,25 +102,9 @@ def main(argv=None) -> int:
     try:
         cmd = " ".join(args.cmd)
         if cmd in ("status", "-s"):
-            st = c.status()
-            h = c.health()
-            states = ", ".join(f"{n} {s}" for s, n in
-                               sorted(st["pgmap"]["pgs_by_state"].items()))
-            print(f"  cluster:\n    health: {h['status']}\n"
-                  f"  services:\n"
-                  f"    osd: {st['osdmap']['num_osds']} osds: "
-                  f"{st['osdmap']['num_up_osds']} up "
-                  f"(epoch {st['osdmap']['epoch']})\n"
-                  f"  data:\n"
-                  f"    pools:   {st['pgmap']['num_pools']} pools, "
-                  f"{st['pgmap']['num_pgs']} pgs\n"
-                  f"    pgs:     {states}")
+            print(_fmt_status(c.status(), c.health()))
         elif cmd in ("health", "health detail"):
-            h = c.health()
-            print(h["status"])
-            if cmd == "health detail":
-                for key, msg in sorted(h["checks"].items()):
-                    print(f"[{key}] {msg}")
+            _print_health(c.health(), cmd == "health detail")
         elif cmd == "osd tree":
             print(render_osd_tree(c))
         elif cmd == "osd df":
@@ -146,6 +140,57 @@ def main(argv=None) -> int:
         return 0
     finally:
         c.shutdown()
+
+
+def _print_health(h: dict, detail: bool) -> None:
+    print(h["status"])
+    if detail:
+        for key, msg in sorted(h["checks"].items()):
+            print(f"[{key}] {msg}")
+
+
+def _fmt_status(st: dict, h: dict) -> str:
+    states = ", ".join(f"{n} {s}" for s, n in
+                       sorted(st["pgmap"]["pgs_by_state"].items()))
+    return (f"  cluster:\n    health: {h['status']}\n"
+            f"  services:\n"
+            f"    osd: {st['osdmap']['num_osds']} osds: "
+            f"{st['osdmap']['num_up_osds']} up "
+            f"(epoch {st['osdmap']['epoch']})\n"
+            f"  data:\n"
+            f"    pools:   {st['pgmap']['num_pools']} pools, "
+            f"{st['pgmap']['num_pgs']} pgs\n"
+            f"    pgs:     {states}")
+
+
+def _run_remote(args) -> int:
+    """status/health/df against a live served cluster (TcpRados RPC)."""
+    from ..net import cli_connect
+    try:
+        r = cli_connect(args.connect, args.keyring, args.data_dir)
+    except Exception as e:        # AuthError/Unpickling/IO/Value: all
+        print(f"error: {e}", file=sys.stderr)   # operator-facing
+        return 2
+    try:
+        cmd = " ".join(args.cmd)
+        if cmd in ("status", "-s"):
+            print(_fmt_status(r.status(), r.call("health")))
+        elif cmd in ("health", "health detail"):
+            _print_health(r.call("health"), cmd == "health detail")
+        elif cmd == "df":
+            st = r.status()
+            print(f"{st['pgmap']['num_pools']} pools, "
+                  f"{st['pgmap']['num_pgs']} pgs")
+        else:
+            print(f"error: {cmd!r} not supported over --connect",
+                  file=sys.stderr)
+            return 2
+        return 0
+    except (IOError, KeyError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    finally:
+        r.close()
 
 
 if __name__ == "__main__":

@@ -188,22 +188,11 @@ def main(argv=None) -> int:
 
 def _run_remote(args) -> int:
     """Remote mode: every verb through TcpRados over the live socket."""
-    import os
-    from ..net import TcpRados
+    from ..net import cli_connect
     try:
-        host, _, port_s = args.connect.rpartition(":")
-        if not host or not port_s.isdigit():
-            raise ValueError(f"--connect wants HOST:PORT, got "
-                             f"{args.connect!r}")
-        keyring = args.keyring or (os.path.join(args.data_dir,
-                                                "client.admin.keyring")
-                                   if args.data_dir else None)
-        if keyring is None:
-            raise ValueError("--keyring (or --data-dir) required with "
-                             "--connect")
-        r = TcpRados(host, int(port_s), keyring)
-    except (IOError, ValueError) as e:
-        print(f"error: {e}", file=sys.stderr)
+        r = cli_connect(args.connect, args.keyring, args.data_dir)
+    except Exception as e:        # AuthError/Unpickling/IO/Value: all
+        print(f"error: {e}", file=sys.stderr)   # operator-facing
         return 2
     try:
         if args.cmd == "mkpool":

@@ -20,6 +20,36 @@ def _data(n, seed=0):
         0, 256, n, dtype=np.uint8).tobytes()
 
 
+
+
+def _spawn_server(tmp_path, env):
+    """Start 'rados serve' and wait for its port + keyring, surfacing
+    stderr on startup failure instead of hanging/IndexError."""
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ceph_tpu.tools.rados_cli",
+         "--data-dir", str(tmp_path), "serve", "--port", "0"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=env)
+    import selectors
+    sel = selectors.DefaultSelector()
+    sel.register(proc.stdout, selectors.EVENT_READ)
+    deadline = time.monotonic() + 60
+    line = ""
+    while time.monotonic() < deadline and not line:
+        if sel.select(timeout=1.0):
+            line = proc.stdout.readline()
+        if proc.poll() is not None:
+            raise AssertionError(
+                f"serve died rc={proc.returncode}: {proc.stderr.read()}")
+    assert "serving on" in line, f"no port line within 60s: {line!r}"
+    port = int(line.rsplit(":", 1)[1])
+    keyring = os.path.join(str(tmp_path), "client.admin.keyring")
+    while not os.path.exists(keyring):
+        assert time.monotonic() < deadline, "keyring never appeared"
+        time.sleep(0.1)
+    return proc, port, keyring
+
+
 @pytest.fixture
 def served(tmp_path):
     """An in-process served cluster (threaded server) + keyring path."""
@@ -119,19 +149,8 @@ class TestTwoProcesses:
         (rados serve); this process runs two concurrent clients doing
         put/get + watch/notify over real sockets."""
         env = dict(os.environ, JAX_PLATFORMS="cpu")
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "ceph_tpu.tools.rados_cli",
-             "--data-dir", str(tmp_path), "serve", "--port", "0"],
-            stdout=subprocess.PIPE, text=True, env=env)
+        proc, port, keyring = _spawn_server(tmp_path, env)
         try:
-            line = proc.stdout.readline()
-            assert "serving on" in line, line
-            port = int(line.rsplit(":", 1)[1])
-            keyring = tmp_path / "client.admin.keyring"
-            deadline = time.monotonic() + 30
-            while not keyring.exists():
-                assert time.monotonic() < deadline
-                time.sleep(0.1)
             a = TcpRados("127.0.0.1", port, keyring)
             b = TcpRados("127.0.0.1", port, keyring)
             a.mkpool("p", profile={"k": "2", "m": "1",
@@ -170,19 +189,8 @@ class TestTwoProcesses:
         """rados --connect runs its verbs against the live server
         process: two processes sharing one cluster concurrently."""
         env = dict(os.environ, JAX_PLATFORMS="cpu")
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "ceph_tpu.tools.rados_cli",
-             "--data-dir", str(tmp_path), "serve", "--port", "0"],
-            stdout=subprocess.PIPE, text=True, env=env)
+        proc, port, keyring = _spawn_server(tmp_path, env)
         try:
-            line = proc.stdout.readline()
-            port = int(line.rsplit(":", 1)[1])
-            keyring = str(tmp_path / "client.admin.keyring")
-            deadline = time.monotonic() + 30
-            while not os.path.exists(keyring):
-                assert time.monotonic() < deadline
-                time.sleep(0.1)
-
             def cli(*argv, data=None):
                 return subprocess.run(
                     [sys.executable, "-m", "ceph_tpu.tools.rados_cli",
@@ -230,3 +238,26 @@ class TestPreAuthHardening:
         with open(keyring, "rb") as f:
             saved = pickle.load(f)
         assert set(saved) == {"key"}
+
+
+class TestCephCliRemote:
+    def test_ceph_status_over_connect(self, tmp_path):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc, port, keyring = _spawn_server(tmp_path, env)
+        try:
+            r = subprocess.run(
+                [sys.executable, "-m", "ceph_tpu.tools.ceph_cli",
+                 "--connect", f"127.0.0.1:{port}", "--keyring", keyring,
+                 "status"],
+                capture_output=True, text=True, env=env, timeout=120)
+            assert r.returncode == 0, r.stderr
+            assert "health:" in r.stdout and "osds" in r.stdout
+            r = subprocess.run(
+                [sys.executable, "-m", "ceph_tpu.tools.ceph_cli",
+                 "--connect", f"127.0.0.1:{port}", "--keyring", keyring,
+                 "health"],
+                capture_output=True, text=True, env=env, timeout=120)
+            assert r.returncode == 0 and "HEALTH" in r.stdout
+        finally:
+            proc.terminate()
+            proc.wait(timeout=30)
