@@ -272,16 +272,7 @@ class TestScrubWithChecksumsAtRest:
         c.put(pid, "rotten", payload)
         g = c.pg_group(pid, "rotten")
         peer = next(s for s in g.acting if s != g.backend.whoami)
-        bs = c.osds[peer].store
-        # find the blob backing the peer's copy and flip a byte on disk
-        target = next(go for go in bs.onodes
-                      if go.oid.endswith("rotten") and go.shard == peer)
-        blob = bs.blobs[bs.onodes[target].extents[0].blob]
-        bs._block.seek(blob.poff + 10)
-        b0 = bs._block.read(1)
-        bs._block.seek(blob.poff + 10)
-        bs._block.write(bytes([b0[0] ^ 0xFF]))
-        bs._block.flush()
+        _rot_shard_copy(c, pid, "rotten", peer)
         rep = c.scrub_pool(pid)
         assert any("rotten" in o for bad in rep.values() for o in bad)
         # scrub's repair rewrote the copy: clean now, reads fine
@@ -290,17 +281,21 @@ class TestScrubWithChecksumsAtRest:
         c.shutdown()
 
 
+def _rot_shard_copy(c, pid, oid, shard):
+    """Flip one at-rest byte of ``oid``'s copy on ``shard`` behind the
+    store's back (the blob-level bitrot injection)."""
+    bs = c.osds[shard].store
+    target = next(go for go in bs.onodes
+                  if go.oid.endswith(oid) and go.shard == shard)
+    blob = bs.blobs[bs.onodes[target].extents[0].blob]
+    bs._block.seek(blob.poff)
+    b0 = bs._block.read(1)
+    bs._block.seek(blob.poff)
+    bs._block.write(bytes([b0[0] ^ 0xFF]))
+    bs._block.flush()
+
+
 class TestRottenSourceRecovery:
-    def _rot_shard_copy(self, c, pid, oid, shard):
-        bs = c.osds[shard].store
-        target = next(go for go in bs.onodes
-                      if go.oid.endswith(oid) and go.shard == shard)
-        blob = bs.blobs[bs.onodes[target].extents[0].blob]
-        bs._block.seek(blob.poff)
-        b0 = bs._block.read(1)
-        bs._block.seek(blob.poff)
-        bs._block.write(bytes([b0[0] ^ 0xFF]))
-        bs._block.flush()
 
     def test_ec_rmw_read_retries_past_rotten_chunk(self, tmp_path):
         """A partial-stripe overwrite whose RMW read hits a rotten source
@@ -316,7 +311,7 @@ class TestRottenSourceRecovery:
         c.operate(pid, "rmw", ObjectOperation().write_full(payload))
         g = c.pg_group(pid, "rmw")
         data_shard = g.acting[1]              # a non-primary data chunk
-        self._rot_shard_copy(c, pid, "rmw", data_shard)
+        _rot_shard_copy(c, pid, "rmw", data_shard)
         # partial overwrite: RMW reads the stripe, hits the rot, widens
         patch = _data(100, 22)
         c.operate(pid, "rmw", ObjectOperation().write(300, patch))
@@ -340,7 +335,7 @@ class TestRottenSourceRecovery:
         c.put(pid, "rec", payload)
         g = c.pg_group(pid, "rec")
         rotten = g.acting[2]
-        self._rot_shard_copy(c, pid, "rec", rotten)
+        _rot_shard_copy(c, pid, "rec", rotten)
         missing_chunk = 3                     # rebuild the last chunk
         rop = g.backend.recover_object("rec", {missing_chunk})
         g.bus.deliver_all()
@@ -383,5 +378,65 @@ class TestClusterIntegration:
         for oid, want in model.items():
             r = c2.operate(pid, oid, ObjectOperation().read(0, 0))
             assert r.outdata(0)[:len(want)] == want, oid
+        assert c2.scrub_pool(pid) == {}
+        c2.shutdown()
+
+
+class TestBlueStoreComposition:
+    def test_snaps_kills_rot_restart_campaign(self, tmp_path):
+        """Everything at once on the bluestore backend: snapshots with
+        COW clones, an OSD death and revival mid-writes, at-rest bitrot
+        located by the store's checksums and repaired by scrub, then a
+        full restart recovering every PG from the per-OSD block files."""
+        from ceph_tpu.cluster import BlockedWriteError, MiniCluster
+        from ceph_tpu.common import Context
+        from ceph_tpu.osd.osd_ops import ObjectOperation
+        cct = Context(overrides={"mon_osd_down_out_interval": 10_000})
+        c = MiniCluster(n_osds=6, osds_per_host=2, chunk_size=512,
+                        data_dir=tmp_path, store_backend="bluestore",
+                        cct=cct)
+        pid = c.create_replicated_pool("p", size=3, pg_num=8)
+        model, snaps = {}, {}
+        for i in range(12):
+            model[f"o{i}"] = _data(900 + 31 * i, i)
+            c.operate(pid, f"o{i}", ObjectOperation()
+                      .write_full(model[f"o{i}"]))
+        sid = c.create_pool_snap(pid, "s1")
+        snaps[sid] = dict(model)
+        # kill an OSD, write through the degradation
+        victim = c.pg_group(pid, "o0").acting[1]
+        c.bus.mark_down(victim)
+        for i in range(12):
+            new = _data(700 + 13 * i, 100 + i)
+            try:
+                c.operate(pid, f"o{i}",
+                          ObjectOperation().write_full(new))
+                model[f"o{i}"] = new
+            except BlockedWriteError:
+                c.bus.mark_up(victim)
+                c.bus.deliver_all()
+                model[f"o{i}"] = new
+                c.bus.mark_down(victim)
+        c.bus.mark_up(victim)
+        c.bus.deliver_all()
+        # at-rest rot on a non-primary copy of one object
+        g = c.pg_group(pid, "o3")
+        peer = next(s for s in g.acting if s != g.backend.whoami)
+        _rot_shard_copy(c, pid, "o3", peer)
+        rep = c.scrub_pool(pid)
+        assert any("o3" in o for bad in rep.values() for o in bad)
+        assert c.scrub_pool(pid) == {}          # repaired
+        # snapshot isolation held through all of it
+        r = c.operate(pid, "o5", ObjectOperation().read(0, 0), snapid=sid)
+        assert r.outdata(0)[:len(snaps[sid]["o5"])] == snaps[sid]["o5"]
+        c.shutdown()
+        # restart: everything recovers from the per-OSD block files
+        c2 = MiniCluster.load(tmp_path)
+        for oid, want in model.items():
+            r = c2.operate(pid, oid, ObjectOperation().read(0, 0))
+            assert r.outdata(0)[:len(want)] == want, oid
+        r = c2.operate(pid, "o5", ObjectOperation().read(0, 0),
+                       snapid=sid)
+        assert r.outdata(0)[:len(snaps[sid]["o5"])] == snaps[sid]["o5"]
         assert c2.scrub_pool(pid) == {}
         c2.shutdown()
