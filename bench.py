@@ -47,7 +47,11 @@ PROBE_DEADLINE_S = float(os.environ.get("BENCH_PROBE_DEADLINE_S", 600))
 PROBE_STEP_S = float(os.environ.get("BENCH_PROBE_STEP_S", 30))
 PROBE_ATTEMPT_TIMEOUT_S = float(   # a single init probe may WEDGE, not fail
     os.environ.get("BENCH_PROBE_ATTEMPT_TIMEOUT_S", 90))
-WATCHDOG_S = int(os.environ.get("BENCH_WATCHDOG_S", 1800))
+# worst honest path: probe deadline (600) + compile (~40) + two bounded
+# measurement passes (~240) + cpu baseline (~60) ≈ 950s; the watchdog
+# leaves headroom above that while still emitting the fallback line
+# before any plausible driver timeout
+WATCHDOG_S = int(os.environ.get("BENCH_WATCHDOG_S", 1200))
 
 
 _chain_cache: dict = {}
