@@ -93,41 +93,51 @@ class ECBackend(PGBackend):
 
     def _hinfo(self, oid: str) -> HashInfo:
         if oid not in self.hinfo_cache:
-            n = self.ec_impl.get_chunk_count()
-            stored = None
-            # hinfo replicates on every shard's copy: when the primary's
-            # own copy is gone (bitrot/lost shard object), any CURRENT
-            # peer's attr is the same authority — without this fallback a
-            # missing primary copy poisons scrub/size for the whole
-            # object (fresh version-0 hinfo marks every shard stale).
-            # Stale revived shards are excluded: their hinfo may predate
-            # writes they missed (current_shards() semantics).  That
-            # applies to the PRIMARY'S OWN copy too — while it is stale
-            # (repairing itself), current peers are the authority and the
-            # local attr is consulted last.
-            peers = [s for s in self.acting if s != self.whoami
-                     and s in self.current_shards()]
-            local_current = self.whoami in self.current_shards()
-            order = ([self.whoami] + peers if local_current
-                     else peers + [self.whoami])
-            for shard in order:
-                if shard not in self.bus.handlers:
-                    continue
-                try:
-                    stored = shard_store(self.bus, shard).getattr(
-                        GObject(oid, shard), HINFO_KEY)
-                    break
-                except (FileNotFoundError, KeyError):
-                    continue
-            h = HashInfo(n)
-            if stored is not None:
-                h.total_chunk_size = stored["total_chunk_size"]
-                h.cumulative_shard_hashes = list(
-                    stored["cumulative_shard_hashes"])
-                h.projected_total_chunk_size = h.total_chunk_size
-                h.version = stored.get("version", 0)
-            self.hinfo_cache[oid] = h
+            self.hinfo_cache[oid] = self._read_hinfo(oid)
         return self.hinfo_cache[oid]
+
+    def _read_hinfo(self, oid: str) -> HashInfo:
+        """The authoritative stored hinfo, bypassing the cache.  Recovery
+        sizes its reads with this: the CACHE may hold an in-flight
+        write's projected state, and conversely evicting the cache to
+        force a re-read would yank that projection out from under the
+        write — it would then commit a STALE hinfo to every shard while
+        the data/object-info move forward (observed as permanently short
+        reads in the seed-244 soak)."""
+        n = self.ec_impl.get_chunk_count()
+        stored = None
+        # hinfo replicates on every shard's copy: when the primary's
+        # own copy is gone (bitrot/lost shard object), any CURRENT
+        # peer's attr is the same authority — without this fallback a
+        # missing primary copy poisons scrub/size for the whole
+        # object (fresh version-0 hinfo marks every shard stale).
+        # Stale revived shards are excluded: their hinfo may predate
+        # writes they missed (current_shards() semantics).  That
+        # applies to the PRIMARY'S OWN copy too — while it is stale
+        # (repairing itself), current peers are the authority and the
+        # local attr is consulted last.
+        peers = [s for s in self.acting if s != self.whoami
+                 and s in self.current_shards()]
+        local_current = self.whoami in self.current_shards()
+        order = ([self.whoami] + peers if local_current
+                 else peers + [self.whoami])
+        for shard in order:
+            if shard not in self.bus.handlers:
+                continue
+            try:
+                stored = shard_store(self.bus, shard).getattr(
+                    GObject(oid, shard), HINFO_KEY)
+                break
+            except (FileNotFoundError, KeyError):
+                continue
+        h = HashInfo(n)
+        if stored is not None:
+            h.total_chunk_size = stored["total_chunk_size"]
+            h.cumulative_shard_hashes = list(
+                stored["cumulative_shard_hashes"])
+            h.projected_total_chunk_size = h.total_chunk_size
+            h.version = stored.get("version", 0)
+        return h
 
     def object_size(self, oid: str) -> int:
         return self._hinfo(oid).get_total_logical_size(self.sinfo)
@@ -376,13 +386,29 @@ class ECBackend(PGBackend):
                 self.extent_cache.claim(oid, op.tid, off, data)
                 op.cache_claims.append((oid, op.tid))
             # hash maintenance: pure appends chain the crc (HashInfo::append,
-            # ECUtil.cc:161-177); overwrites invalidate it and deep scrub
-            # recomputes from data
+            # ECUtil.cc:161-177).  A WHOLESALE rewrite has every chunk
+            # byte in hand, so fresh cumulative hashes are re-derived
+            # instead of cleared — hash-less objects are what let a
+            # degraded exactly-k rebuild launder silent rot into parity
+            # with nothing left to cross-check (seed-244 soak: one rotten
+            # source re-encoded into a self-consistent wrong clone).
+            # Only PARTIAL overwrites still clear (mid-stream crc is
+            # unknowable); deep scrub's parity-consistency fallback
+            # covers those.
+            total = hinfo.projected_total_chunk_size
             if pure_append and appended:
                 hinfo.append(old_size, append_chunks)
             elif not pure_append:
-                hinfo.set_total_chunk_size_clear_hash(
-                    hinfo.projected_total_chunk_size)
+                if len(pieces) == 1 and pieces[0][0] == 0 and \
+                        c_cursor == total:
+                    # explicit reset: a preceding truncate may have
+                    # EMPTIED the hash array (clear() would keep it so)
+                    hinfo.cumulative_shard_hashes = [0xFFFFFFFF] * n
+                    hinfo.total_chunk_size = 0
+                    hinfo.append(0, {c: encoded[c][:total]
+                                     for c in range(n)})
+                else:
+                    hinfo.set_total_chunk_size_clear_hash(total)
             self._persist_hinfo(oid, hinfo, shard_txns)
         return shard_txns, log_entries
 
@@ -476,6 +502,16 @@ class ECBackend(PGBackend):
         cur = self.current_shards()
         avail = {i for i, s in enumerate(self.acting) if s in cur}
         want = {self.ec_impl.chunk_index(i) for i in range(k)}
+        try:
+            base_minimum = self.ec_impl.minimum_to_decode(want, avail)
+        except IOError:
+            # degraded below k current shards: the read cannot reconstruct
+            # right now — EIO to the caller (mirrors the replicated
+            # backend's no-current-source answer) rather than an exception
+            # unwinding through the daemon's drain loop
+            self.in_progress_reads.pop(tid, None)
+            on_complete({}, {oid: -5 for oid in reads})
+            return tid
         per_shard: dict[int, dict[str, list[tuple]]] = {}
         for oid, extents in reads.items():
             lo = min(off for off, _ in extents)
@@ -484,7 +520,7 @@ class ECBackend(PGBackend):
             c_off = self.sinfo.aligned_logical_offset_to_chunk_offset(start)
             c_len = self.sinfo.aligned_logical_offset_to_chunk_offset(length)
             rop.shard_extents[oid] = (c_off, c_len)
-            minimum = self.ec_impl.minimum_to_decode(want, avail)
+            minimum = base_minimum
             if fast_read and len(avail) > len(minimum):
                 # redundant reads: ask every available shard (ECBackend.cc:1609-1615)
                 minimum = {c: [(0, self.ec_impl.get_sub_chunk_count())]
@@ -648,12 +684,12 @@ class ECBackend(PGBackend):
                  if s in self.current_shards()
                  and c not in rop.missing_shards}
         minimum = self.ec_impl.minimum_to_decode(rop.missing_shards, avail)
-        # recovery must size its reads from the FRESHEST authoritative
-        # hinfo: a cached entry may be an empty placeholder from a moment
-        # when no source had applied the object yet (reordered delivery),
-        # and sizing reads at 0 would reconstruct an empty object
-        self.hinfo_cache.pop(rop.oid, None)
-        hinfo = self._hinfo(rop.oid)
+        # recovery sizes its reads from the FRESHEST authoritative hinfo,
+        # read PAST the cache: a cached entry may be an empty placeholder
+        # from a moment when no source had applied the object yet
+        # (reordered delivery) — and evicting the cache instead would
+        # corrupt an in-flight write's projection (_read_hinfo docstring)
+        hinfo = self._read_hinfo(rop.oid)
         c_len = hinfo.get_total_chunk_size()
         # VERIFIED recovery: when the hinfo hashes are gone (overwrites
         # clear them) the reconstruction sources cannot be crc-checked —
@@ -675,12 +711,15 @@ class ECBackend(PGBackend):
             shard = self.acting[chunk]
             runs = None if subchunks == [(0, self.ec_impl.get_sub_chunk_count())] \
                 else subchunks
-            # c_len 0 = NO source has the hinfo yet (every copy of this
-            # object is mid-flight or missing): read whole chunks rather
-            # than 0 bytes — the payload step re-derives the size from a
-            # source's attrs or the actual read lengths
-            per_shard.setdefault(shard, {})[rop.oid] = [
-                (0, c_len if c_len else None, runs)]
+            # whole-chunk reads: a point-in-time LOCAL hinfo can lag a
+            # just-generated write whose sub-ops are still queued (log
+            # appends at generation, stores apply at delivery), and
+            # sizing by it TRUNCATES the sources' newer chunks — the
+            # seed-244 soak pushed 512 bytes of a 1024-byte chunk that
+            # way.  Each source serves its own current full chunk; only
+            # clay's fractional sub-chunk runs still need c_len.
+            length = c_len if runs is not None else None
+            per_shard.setdefault(shard, {})[rop.oid] = [(0, length, runs)]
         rop._pending = set(per_shard)
         # the replicated attr set (object_info, snapset, user xattrs —
         # identical on every shard) must come from a CURRENT source: the
@@ -699,29 +738,46 @@ class ECBackend(PGBackend):
         # (clay) the helpers are fractional
         available = {c: np.frombuffer(v, dtype=np.uint8)
                      for c, v in rop._read_results.items()}
-        hinfo = self._hinfo(rop.oid)
+        # the hinfo must be COHERENT with the data the sources served:
+        # each read reply carries data and attrs from one store state, so
+        # a source's attr hinfo describes exactly the bytes it returned —
+        # while the local attr can lag (or lead) the read by in-flight
+        # sub-writes.  Prefer the newest source hinfo; fall back to the
+        # local stored one, then to sizing from the bytes read.
+        hinfo = self._read_hinfo(rop.oid)     # uncached: see _read_hinfo
+        peer_base = max(
+            (a for _c, a in sorted(rop._read_attrs.items())
+             if a and HINFO_KEY in a),
+            key=lambda a: a[HINFO_KEY].get("version", 0), default=None)
+        if peer_base is not None and \
+                peer_base[HINFO_KEY].get("version", 0) >= hinfo.version:
+            d = peer_base[HINFO_KEY]
+            nh = HashInfo(self.ec_impl.get_chunk_count())
+            nh.total_chunk_size = d["total_chunk_size"]
+            nh.cumulative_shard_hashes = list(
+                d["cumulative_shard_hashes"])
+            nh.projected_total_chunk_size = nh.total_chunk_size
+            nh.version = d.get("version", 0)
+            hinfo = nh
         if not hinfo.get_total_chunk_size():
-            # the local/cached hinfo never saw this object: adopt a
-            # current SOURCE's hinfo (replicated on every shard) so the
-            # reconstruction and the pushed attr carry the true size
-            peer_base = next((a for _c, a in sorted(rop._read_attrs.items())
-                              if a and HINFO_KEY in a), None)
-            if peer_base is not None:
-                d = peer_base[HINFO_KEY]
-                nh = HashInfo(self.ec_impl.get_chunk_count())
-                nh.total_chunk_size = d["total_chunk_size"]
-                nh.cumulative_shard_hashes = list(
-                    d["cumulative_shard_hashes"])
-                nh.projected_total_chunk_size = nh.total_chunk_size
-                nh.version = d.get("version", 0)
-                self.hinfo_cache[rop.oid] = hinfo = nh
-            elif available:
+            if available:
                 # last resort: size from the bytes actually read
                 nh = HashInfo(self.ec_impl.get_chunk_count())
                 nh.total_chunk_size = max(len(v) for v in
                                           available.values())
                 nh.projected_total_chunk_size = nh.total_chunk_size
                 hinfo = nh
+        # whole-chunk reads may catch sources mid-update at different
+        # lengths: normalize to the adopted hinfo's size — a source whose
+        # bytes are from another version then fails its crc (or the
+        # parity-consistency check) and is dropped/rebuilt below
+        total = hinfo.get_total_chunk_size()
+        if total:
+            available = {
+                c: (v if len(v) == total else np.frombuffer(
+                    v.tobytes()[:total].ljust(total, b"\0"),
+                    dtype=np.uint8))
+                for c, v in available.items()}
         k = self.ec_impl.get_data_chunk_count()
         if hinfo.has_chunk_hash() and \
                 self.ec_impl.get_sub_chunk_count() == 1:

@@ -104,6 +104,30 @@ class OSDShard:
     def _meta(self) -> GObject:
         return GObject(PG_META, self.shard)
 
+    def _push_is_stale(self, msg: PushOp, obj: GObject) -> bool:
+        """Is this push older than the object state already applied here?
+        Compared on the per-object version attrs both pool types carry
+        (EC: hinfo_key.version; replicated: @version) — each is monotone
+        per object, so incoming < stored means the push predates a write
+        this shard has already applied."""
+        if not self.store.exists(obj):
+            return False
+        for key, field_ in (("hinfo_key", "version"), ("@version", None)):
+            incoming = msg.attrs.get(key)
+            try:
+                stored = self.store.getattr(obj, key)
+            except (KeyError, FileNotFoundError):
+                continue
+            if incoming is None:
+                continue
+            if field_ is not None:
+                incoming = incoming.get(field_, 0)
+                stored = stored.get(field_, 0) if isinstance(stored, dict) \
+                    else 0
+            if incoming < stored:
+                return True
+        return False
+
     def _load_pg_state(self) -> None:
         """Boot: rebuild the in-RAM log + rollback map from the pgmeta
         omap (the OSD::init superblock/PG-load path, OSD.cc:2719)."""
@@ -320,8 +344,20 @@ class OSDShard:
                     reply.errors[oid] = -5
             self.bus.send(msg.from_shard, reply)
         elif isinstance(msg, PushOp):
-            t = Transaction()
             obj = GObject(msg.oid, self.shard)
+            if self._push_is_stale(msg, obj):
+                # per-object recovery serialization (the reference holds
+                # recovery locks): a push reconstructed from a PRE-write
+                # snapshot can already be in flight when a newer client
+                # write applies on this shard — applying it would regress
+                # the shard to the old state while the PG log stays at
+                # the new version (observed: seed-244 soak served mixed-
+                # version garbage).  Drop it; ack so the rop completes —
+                # the shard already holds newer-or-equal state.
+                self.bus.send(msg.from_shard, PushReply(self.shard,
+                                                        msg.oid))
+                return
+            t = Transaction()
             # the remove wipes everything, so omap=None ("leave alone")
             # must re-apply the PRE-push omap to honour its contract
             if msg.omap is not None:
