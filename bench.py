@@ -38,8 +38,12 @@ import subprocess
 import sys
 import threading
 import time
+from contextlib import contextmanager
 
 import numpy as np
+
+# stdlib-only import (no jax): safe before any backend probe
+from ceph_tpu.common.tracer import default_tracer
 
 HBM_BYTES_PER_S = 819e9          # TPU v5e HBM bandwidth (public spec)
 # env-overridable so CI / smoke tests can shrink the retry budget
@@ -56,9 +60,31 @@ WATCHDOG_S = int(os.environ.get("BENCH_WATCHDOG_S", 1200))
 
 _chain_cache: dict = {}
 
+# -- per-phase accounting -----------------------------------------------------
+# Every phase lands in the bench JSON (`phases`: name -> seconds) AND on the
+# process span tracer, so a wedged run is diagnosable from the artifact alone
+# (the BENCH_r05 lesson: 570s of probe with no per-attempt record).
+_PHASES: dict[str, float] = {}
+_OPEN_PHASES: dict[str, float] = {}    # in-flight: name -> start perf_counter
+_PROBE_HISTORY: list[dict] = []
+_RUN_T0 = time.monotonic()
 
-def chain_timer(apply_fn, mat, data, reps, rounds=5):
-    """Best-of-rounds wall time of a jitted chain of `reps` applications."""
+
+@contextmanager
+def phase(name):
+    with default_tracer().span(f"bench.{name}"):
+        _OPEN_PHASES[name] = time.perf_counter()
+        try:
+            yield
+        finally:
+            t0 = _OPEN_PHASES.pop(name)
+            _PHASES[name] = round(
+                _PHASES.get(name, 0.0) + time.perf_counter() - t0, 3)
+
+
+def chain_fn(apply_fn, mat, data, reps):
+    """The cached jitted chain of `reps` applications (build only; the
+    first execution compiles)."""
     import jax
     import jax.numpy as jnp
 
@@ -86,6 +112,12 @@ def chain_timer(apply_fn, mat, data, reps, rounds=5):
             final = jax.lax.fori_loop(0, reps, body, D)
             return final.astype(jnp.int32).sum()
         _chain_cache[key] = run
+    return run
+
+
+def chain_timer(apply_fn, mat, data, reps, rounds=5):
+    """Best-of-rounds wall time of a jitted chain of `reps` applications."""
+    run = chain_fn(apply_fn, mat, data, reps)
     _ = int(run(mat, data))                                # compile+sync
     best = 1e9
     for _ in range(rounds):
@@ -127,11 +159,15 @@ def probe_backend() -> str | None:
     nothing initialized before the deadline.  Subprocess isolation matters
     twice over: a wedged tunnel can hang init forever (per-attempt
     timeout kills it), and a failed init poisons the in-process backend
-    cache (each retry gets a fresh process)."""
+    cache (each retry gets a fresh process).  Each attempt — including the
+    successful one — is recorded in the JSON's `probe_history` (start,
+    duration, error), so a wedged init is diagnosable from the artifact."""
     t0 = time.monotonic()
     attempt = 0
     while True:
         attempt += 1
+        a0 = time.monotonic()
+        platform = None
         try:
             r = subprocess.run(
                 [sys.executable, "-c",
@@ -139,11 +175,25 @@ def probe_backend() -> str | None:
                 capture_output=True, text=True,
                 timeout=PROBE_ATTEMPT_TIMEOUT_S)
             if r.returncode == 0 and r.stdout.strip():
-                return r.stdout.strip().splitlines()[-1]
-            reason = (r.stderr or "").strip().splitlines()[-1:] or ["rc!=0"]
-            reason = reason[0][-120:]
+                platform = r.stdout.strip().splitlines()[-1]
+                reason = None
+            else:
+                reason = (r.stderr or "").strip().splitlines()[-1:] \
+                    or ["rc!=0"]
+                reason = reason[0][-120:]
         except subprocess.TimeoutExpired:
             reason = f"init wedged > {PROBE_ATTEMPT_TIMEOUT_S}s"
+        _PROBE_HISTORY.append({
+            "attempt": attempt,
+            "start_s": round(a0 - _RUN_T0, 3),
+            "duration_s": round(time.monotonic() - a0, 3),
+            "platform": platform,
+            "error": reason,
+        })
+        default_tracer().instant("bench.probe_attempt", attempt=attempt,
+                                 platform=platform, error=reason)
+        if platform is not None:
+            return platform
         elapsed = time.monotonic() - t0
         if elapsed + PROBE_STEP_S > PROBE_DEADLINE_S:
             print(f"# backend probe gave up after {elapsed:.0f}s "
@@ -207,6 +257,17 @@ def emit(value, vs_baseline, extra):
         "vs_baseline": round(vs_baseline, 3),
     }
     line.update(extra)
+    # always carried, even on the watchdog/fallback paths: the per-phase
+    # breakdown and the per-attempt probe record accumulated so far.  A
+    # phase still OPEN when the watchdog fires is exactly the one that
+    # wedged: include its elapsed-so-far and name it explicitly.
+    phases = dict(_PHASES)
+    now = time.perf_counter()
+    for name, t0 in list(_OPEN_PHASES.items()):
+        phases[name] = round(phases.get(name, 0.0) + now - t0, 3)
+        line["phase_in_flight"] = name
+    line["phases"] = phases
+    line["probe_history"] = list(_PROBE_HISTORY)
     print(json.dumps(line), flush=True)
 
 
@@ -235,13 +296,26 @@ def measure_device(data, k, m, erasures, batch):
 
     stripe_bytes = data.shape[1] * k
     codec = RSCodec(k, m, technique="cauchy", device="jax")
-    dev = jax.device_put(jnp.asarray(data))
-    pmat = jax.device_put(jnp.asarray(codec.parity_mat))
-    D, _src = codec.decode_matrix(erasures)
-    dmat = jax.device_put(jnp.asarray(D))
+    with phase("table_upload"):
+        dev = jax.device_put(jnp.asarray(data))
+        pmat = jax.device_put(jnp.asarray(codec.parity_mat))
+        D, _src = codec.decode_matrix(erasures)
+        dmat = jax.device_put(jnp.asarray(D))
+        jax.block_until_ready(dev)
 
     def apply_auto(M, Dd):
         return rs_kernels.gf_apply_stripes(M, Dd, batch)
+
+    # the chains per_op_seconds will time (lo=4, hi=52 reps over the
+    # encode and decode matrices): compile them all first, then warm them
+    # once more, so the measure phase is pure steady-state dispatch
+    with phase("compile"):
+        for mt in (pmat, dmat):
+            for reps in (4, 52):
+                _ = int(chain_fn(apply_auto, mt, dev, reps)(mt, dev))
+    with phase("warmup"):
+        for mt in (pmat, dmat):
+            _ = int(chain_fn(apply_auto, mt, dev, 4)(mt, dev))
 
     # Best of two full passes: the shared tunnel has multi-second slow
     # periods that depress encode and decode uniformly; peak-of-passes is
@@ -250,18 +324,20 @@ def measure_device(data, k, m, erasures, batch):
     # the second pass cannot help — skip it instead of timing out.
     t_start = time.perf_counter()
     enc_mibs = dec_mibs = 0.0
-    for _pass in range(2):
-        enc_t = per_op_seconds(apply_auto, pmat, dev)       # [B*k]->[B*m]
-        enc_mibs = max(enc_mibs, batch * (stripe_bytes / 2**20) / enc_t)
-        # decode: 2 erasures (1 data + 1 parity) — the same apply primitive
-        # over the decode matrix; the chain keeps the [B*k, N] carry so
-        # per-op traffic matches a real reconstruct over k survivors
-        dec_t = per_op_seconds(apply_auto, dmat, dev)
-        dec_mibs = max(dec_mibs, batch * (stripe_bytes / 2**20) / dec_t)
-        if time.perf_counter() - t_start > 240:
-            print("# degraded tunnel: single measurement pass",
-                  file=sys.stderr)
-            break
+    with phase("measure"):
+        for _pass in range(2):
+            enc_t = per_op_seconds(apply_auto, pmat, dev)   # [B*k]->[B*m]
+            enc_mibs = max(enc_mibs, batch * (stripe_bytes / 2**20) / enc_t)
+            # decode: 2 erasures (1 data + 1 parity) — the same apply
+            # primitive over the decode matrix; the chain keeps the
+            # [B*k, N] carry so per-op traffic matches a real reconstruct
+            # over k survivors
+            dec_t = per_op_seconds(apply_auto, dmat, dev)
+            dec_mibs = max(dec_mibs, batch * (stripe_bytes / 2**20) / dec_t)
+            if time.perf_counter() - t_start > 240:
+                print("# degraded tunnel: single measurement pass",
+                      file=sys.stderr)
+                break
 
     combined = 2.0 / (1.0 / enc_mibs + 1.0 / dec_mibs)
 
@@ -285,6 +361,25 @@ def measure_device(data, k, m, erasures, batch):
     }
 
 
+def smoke_device_phases() -> None:
+    """Tiny jitted encode on whatever backend DID initialize: keeps the
+    compile/warmup/measure phase breakdown present in the artifact even
+    when the TPU is away (the device numbers themselves stay cpu-marked)."""
+    from ceph_tpu.gf import cauchy1
+    from ceph_tpu.ops import rs_kernels
+
+    rng = np.random.default_rng(1)
+    mat = cauchy1(8, 4)
+    small = rng.integers(0, 256, size=(8, 4096), dtype=np.uint8)
+    with phase("compile"):
+        np.asarray(rs_kernels.gf_apply(mat, small, variant="bitslice"))
+    with phase("warmup"):
+        np.asarray(rs_kernels.gf_apply(mat, small, variant="bitslice"))
+    with phase("measure"):
+        for _ in range(3):
+            np.asarray(rs_kernels.gf_apply(mat, small, variant="bitslice"))
+
+
 def main() -> int:
     k, m = 8, 4
     stripe_bytes = 1024 * 1024
@@ -301,8 +396,9 @@ def main() -> int:
 
     # CPU baseline first: jax-free, so it lands even when the tunnel is
     # down, and the fallback JSON can carry a real measured value
-    cpu_combined, cpu_kind, cpu_enc, cpu_dec = cpu_baseline(
-        data, k, m, erasures)
+    with phase("cpu_baseline"):
+        cpu_combined, cpu_kind, cpu_enc, cpu_dec = cpu_baseline(
+            data, k, m, erasures)
     print(f"# cpu-{cpu_kind} encode {cpu_enc:.0f} decode {cpu_dec:.0f} "
           f"MiB/s", file=sys.stderr)
     # re-arm with a real fallback value now that one exists: if the
@@ -313,7 +409,8 @@ def main() -> int:
         "device": "cpu", "cpu_kind": cpu_kind,
         "error": "watchdog: device measurement wedged"})
 
-    platform = probe_backend()
+    with phase("probe"):
+        platform = probe_backend()
     if platform == "tpu":
         try:
             combined, extra = measure_device(data, k, m, erasures, batch)
@@ -329,7 +426,15 @@ def main() -> int:
                 "device": "cpu", "cpu_kind": cpu_kind,
                 "error": f"device measurement failed: {e!r}"[:200]})
             return 0
-    # no TPU: still one parsable line, clearly marked
+    # no TPU: still one parsable line, clearly marked.  When SOME backend
+    # initialized (cpu), run a tiny jitted encode on it so the phases
+    # section still carries real compile/warmup/measure durations and the
+    # jit telemetry is exercised end to end.
+    if platform is not None:
+        try:
+            smoke_device_phases()
+        except Exception as e:
+            print(f"# device smoke failed: {e!r}", file=sys.stderr)
     emit(cpu_combined, 1.0, {
         "device": "cpu", "cpu_kind": cpu_kind,
         "error": "tpu backend unavailable after bounded init retries"

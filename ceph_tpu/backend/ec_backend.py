@@ -42,6 +42,7 @@ from .pg_backend import (Op, OSDShard, PG_META, PGBackend, RecoveryOp,
                          RecoveryState, RepairState, ShardRepairOp,
                          _slice_subchunks)
 from .transaction import get_write_plan
+from ..common.tracer import trace_span
 from ..osd.pg_log import OP_DELETE, OP_MODIFY
 
 __all__ = ["ECBackend", "OSDShard", "RecoveryState", "RecoveryOp",
@@ -354,7 +355,10 @@ class ECBackend(PGBackend):
                 encoded = {c: np.asarray(pre[c], dtype=np.uint8)
                            for c in range(n)}
             else:
-                with self.perf.time("encode_time"):
+                with trace_span("ec.encode", oid=oid,
+                                bytes=int(logical.nbytes),
+                                backend=self.instance_name), \
+                        self.perf.time("encode_time"):
                     encoded = ecutil.encode(self.sinfo, self.ec_impl, logical)
             self.perf.inc("stripe_bytes_encoded", int(logical.nbytes))
             if op.tracked:
@@ -629,7 +633,9 @@ class ECBackend(PGBackend):
         for oid, runs in op._rmw_buf.items():
             for c_off, by_chunk in runs.items():
                 logical_off = self.sinfo.aligned_chunk_offset_to_logical_offset(c_off)
-                with self.perf.time("decode_time"):
+                with trace_span("ec.decode", oid=oid, kind="rmw_read",
+                                backend=self.instance_name), \
+                        self.perf.time("decode_time"):
                     data = ecutil.decode(self.sinfo, self.ec_impl, by_chunk)
                 op.remote_reads.setdefault(oid, {})[logical_off] = data
 
@@ -647,7 +653,9 @@ class ECBackend(PGBackend):
                 continue
             # keep exactly k shards for decode
             chosen = dict(sorted(by_chunk.items())[:k])
-            with self.perf.time("decode_time"):
+            with trace_span("ec.decode", oid=oid, kind="client_read",
+                            backend=self.instance_name), \
+                    self.perf.time("decode_time"):
                 logical = ecutil.decode(self.sinfo, self.ec_impl, chosen)
             c_off, _ = rop.shard_extents[oid]
             base = self.sinfo.aligned_chunk_offset_to_logical_offset(c_off)

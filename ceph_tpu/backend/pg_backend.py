@@ -49,6 +49,7 @@ from .messages import (ECSubRead, ECSubReadReply, ECSubWrite, ECSubWriteReply,
                        PGScan, PGScanReply, PushOp, PushReply,
                        RollForward, Rollback)
 from .transaction import PGTransaction
+from ..common.tracer import trace_span
 from ..osd.pg_log import OP_DELETE, OP_MODIFY, PGLog, dedup_latest
 
 
@@ -573,12 +574,14 @@ class PGBackend:
                              "repairs past the log horizon (full backfill)")
             .add_u64_counter("backfill_objects",
                              "objects moved by shard backfill")
+            .add_u64_counter("slow_ops",
+                             "ops exceeding osd_op_complaint_time")
             .add_time_avg("encode_time", "batched encode wall time")
             .add_time_avg("decode_time", "batched decode wall time")
             .add_u64("pipeline_depth", "ops across the three wait lists")
             .create_perf_counters())
         self.cct.perf.add(self.perf)
-        self.op_tracker = OpTracker()
+        self.op_tracker = OpTracker(conf=self.cct.conf, perf=self.perf)
         for cmd, fn in ((f"dump_ops_in_flight.{self.instance_name}",
                          lambda **kw: self.op_tracker.dump_ops_in_flight()),
                         (f"dump_historic_ops.{self.instance_name}",
@@ -851,7 +854,9 @@ class PGBackend:
         self.waiting_reads.popleft()
         self.waiting_commit.append(op)
         op.first_version = self.pg_log.head + 1
-        shard_txns, log_entries = self._generate_transactions(op)
+        with trace_span("pg.generate_transactions", tid=op.tid,
+                        backend=self.instance_name):
+            shard_txns, log_entries = self._generate_transactions(op)
         # fan out to every current shard (down/stale shards miss the write
         # and are repaired later by the log — the reference's peering
         # likewise keeps them out of the acting set)

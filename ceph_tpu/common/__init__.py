@@ -1,6 +1,7 @@
 """Common runtime services (SURVEY.md §5): typed config with observers,
 perf counters, ring-buffered log, admin-socket command registry, op
-tracker, bundled by Context (the CephContext analog)."""
+tracker, span tracer + JIT telemetry, bundled by Context (the CephContext
+analog)."""
 from .options import (ConfigProxy, Option, OPTIONS, SCHEMA, parse_size,
                       LEVEL_BASIC, LEVEL_ADVANCED, LEVEL_DEV,
                       TYPE_STR, TYPE_INT, TYPE_UINT, TYPE_FLOAT, TYPE_BOOL,
@@ -9,6 +10,8 @@ from .perf_counters import (PerfCounters, PerfCountersBuilder,
                             PerfCountersCollection)
 from .log import Log, Entry
 from .admin_socket import AdminSocket
+from .tracer import (Span, Tracer, default_tracer, trace_span,
+                     trace_instant, jit_dump, jit_perf_counters)
 from .optracker import OpTracker, TrackedOp
 from .context import Context, default_context
 
@@ -19,5 +22,7 @@ __all__ = [
     "TYPE_SIZE",
     "PerfCounters", "PerfCountersBuilder", "PerfCountersCollection",
     "Log", "Entry", "AdminSocket", "OpTracker", "TrackedOp",
+    "Span", "Tracer", "default_tracer", "trace_span", "trace_instant",
+    "jit_dump", "jit_perf_counters",
     "Context", "default_context",
 ]

@@ -9,6 +9,7 @@ hang their counters/commands off it.
 """
 from __future__ import annotations
 
+from . import tracer as tracer_mod
 from .admin_socket import AdminSocket
 from .log import Log
 from .options import ConfigProxy
@@ -21,6 +22,9 @@ class Context:
         self.log = Log(self.conf)
         self.perf = PerfCountersCollection()
         self.admin_socket = AdminSocket()
+        # the process-wide jit telemetry collection: shared by every
+        # Context so any `perf dump` / prometheus render carries it
+        self.perf.add(tracer_mod.jit_perf_counters())
 
         self.admin_socket.register(
             "perf dump", lambda **kw: self.perf.perf_dump(),
@@ -40,6 +44,20 @@ class Context:
         self.admin_socket.register(
             "log dump", lambda **kw: self.log.dump_recent(),
             "dump recent log entries")
+        self.admin_socket.register(
+            "trace dump",
+            lambda **kw: tracer_mod.default_tracer().dump(),
+            "dump the span tracer as Chrome trace-event JSON")
+        self.admin_socket.register(
+            "trace reset",
+            lambda **kw: tracer_mod.default_tracer().reset(),
+            "clear the span tracer ring buffer and histograms")
+        self.admin_socket.register(
+            "jit dump", lambda **kw: tracer_mod.jit_dump(),
+            "per-(function, shape) JIT compile/dispatch telemetry")
+        self.admin_socket.register(
+            "jit reset", lambda **kw: tracer_mod.jit_reset(),
+            "clear the per-(function, shape) JIT telemetry records")
 
     def dout(self, subsys: str, level: int, message: str) -> None:
         self.log.dout(subsys, level, message)

@@ -105,6 +105,10 @@ OPTIONS: list[Option] = [
            description="seconds between peer heartbeats", min=1, max=60),
     Option("osd_heartbeat_grace", TYPE_INT, LEVEL_ADVANCED, default=20,
            description="seconds without heartbeat before reporting down"),
+    Option("osd_op_complaint_time", TYPE_FLOAT, LEVEL_ADVANCED, default=30.0,
+           description="ops slower than this many seconds are slow ops "
+                       "(flagged in dumps, counted on slow_ops)",
+           min=0.0),
     Option("mon_osd_min_down_reporters", TYPE_UINT, LEVEL_ADVANCED,
            default=2, description="failure reports needed to mark down"),
     Option("mon_osd_min_up_ratio", TYPE_FLOAT, LEVEL_ADVANCED, default=0.3,

@@ -4,7 +4,14 @@ Mirror of the reference's OpTracker (reference: src/common/TrackedOp.{h,cc};
 ``op->mark_event`` timeline entries surfaced over the admin socket as
 ``dump_ops_in_flight`` / ``dump_historic_ops``; the FUNCTRACE/OID event
 usage at src/osd/OSD.cc:9549-9578 is the same mechanism at the dispatch
-points).
+points).  Slow-op handling follows the reference's complaint path
+(``osd_op_complaint_time``, TrackedOp.cc check_ops_in_flight): an op whose
+duration exceeds the configurable threshold is flagged ``slow``, counted on
+the owning subsystem's ``slow_ops`` perf counter, and kept in the historic
+dump with the flag set.  Every ``mark_event`` also lands on the process
+span tracer as an instant event, and ``finish`` emits the whole op as a
+complete span, so ``trace dump`` interleaves op timelines with the
+codec/kernel spans they caused.
 """
 from __future__ import annotations
 
@@ -14,6 +21,8 @@ import time
 from collections import deque
 from dataclasses import dataclass, field
 
+from .tracer import default_tracer
+
 
 @dataclass
 class TrackedOp:
@@ -22,16 +31,23 @@ class TrackedOp:
     description: str
     initiated_at: float = field(default_factory=time.time)
     events: list[tuple[float, str]] = field(default_factory=list)
+    slow: bool = False
     _done: bool = False
 
     def mark_event(self, event: str) -> None:
         self.events.append((time.time(), event))
+        default_tracer().instant(f"op.{event}", cat="optracker",
+                                 seq=self.seq, desc=self.description)
 
     def finish(self) -> None:
         if not self._done:
             self._done = True
             self.mark_event("done")
             self.tracker._finish(self)
+            default_tracer().complete("op", self.initiated_at,
+                                      self.duration, cat="optracker",
+                                      seq=self.seq, desc=self.description,
+                                      slow=self.slow)
 
     @property
     def age(self) -> float:
@@ -49,6 +65,7 @@ class TrackedOp:
             "initiated_at": self.initiated_at,
             "age": self.age,
             "duration": self.duration,
+            "slow": self.slow,
             "type_data": {
                 "events": [{"time": t, "event": e} for t, e in self.events],
             },
@@ -63,10 +80,15 @@ class TrackedOp:
 
 
 class OpTracker:
-    """In-flight registry + bounded history of completed/slow ops."""
+    """In-flight registry + bounded history of completed/slow ops.
+
+    ``conf`` (a ConfigProxy) supplies — and live-updates, via observer —
+    the ``osd_op_complaint_time`` slow threshold; ``perf`` is the owning
+    subsystem's PerfCounters, bumped on its ``slow_ops`` key when present.
+    """
 
     def __init__(self, history_size: int = 20, history_duration: float = 600.0,
-                 complaint_time: float = 30.0):
+                 complaint_time: float = 30.0, conf=None, perf=None):
         self._inflight: dict[int, TrackedOp] = {}
         self._history: deque[TrackedOp] = deque(maxlen=history_size)
         self._slow: deque[TrackedOp] = deque(maxlen=history_size)
@@ -74,6 +96,13 @@ class OpTracker:
         self._lock = threading.Lock()
         self.history_duration = history_duration
         self.complaint_time = complaint_time
+        self.perf = perf
+        if conf is not None and "osd_op_complaint_time" in conf.schema:
+            self.complaint_time = float(conf.get("osd_op_complaint_time"))
+            conf.add_observer(
+                "osd_op_complaint_time",
+                lambda _name, v, _t=self: setattr(_t, "complaint_time",
+                                                  float(v)))
 
     def create_request(self, description: str) -> TrackedOp:
         op = TrackedOp(self, next(self._seq), description)
@@ -83,11 +112,18 @@ class OpTracker:
         return op
 
     def _finish(self, op: TrackedOp) -> None:
+        slow = op.duration >= self.complaint_time
         with self._lock:
             self._inflight.pop(op.seq, None)
             self._history.append(op)
-            if op.duration >= self.complaint_time:
+            if slow:
+                op.slow = True
                 self._slow.append(op)
+        if slow and self.perf is not None:
+            try:
+                self.perf.inc("slow_ops")
+            except KeyError:
+                pass                     # owner declared no slow_ops counter
 
     def get_age_histogram(self) -> dict[str, int]:
         with self._lock:

@@ -1,0 +1,292 @@
+"""Span tracer + JIT telemetry: the process-wide timing backbone.
+
+The reference ships three observability mechanisms — OpTracker event
+timelines (src/common/TrackedOp.h), PerfCounters (src/common/perf_counters.h)
+and the blkin/opentracing span hooks (src/common/zipkin_trace.h) — but the
+span layer is the one this TPU-first framework needs most: a single MiB/s
+number cannot tell trace time from compile time from device-resident time
+from host<->device transfer (the BENCH_r05 failure mode: 570s of opaque
+backend probing).  This module provides:
+
+- :class:`Span` / :class:`Tracer`: nested spans with a thread-safe bounded
+  ring buffer, exported as Chrome trace-event JSON (``chrome://tracing`` /
+  Perfetto load ``trace dump`` output directly).
+- per-span-name latency histograms (log-spaced bounds) that
+  ``ceph_tpu.mgr.prometheus`` renders as real histogram series.
+- the JIT telemetry registry behind ``ceph_tpu.ops.traced_jit``: per
+  (function, shape-key) compile counts and trace/compile/first-dispatch
+  wall times, plus the process-wide ``jit`` PerfCounters collection.
+
+Everything here is stdlib-only so the bench driver can import it before
+any JAX backend initializes.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+
+# log-spaced span-latency bounds (seconds); one overflow bucket follows
+LATENCY_BUCKETS_S = (1e-6, 1e-5, 1e-4, 1e-3, 1e-2, 1e-1, 1.0, 10.0)
+
+TRACE_CAPACITY = int(os.environ.get("CEPH_TPU_TRACE_CAPACITY", 16384))
+
+
+class Span:
+    """One timed region; use as a context manager.  ``dur`` (seconds) is
+    valid after ``__exit__``; the Chrome event is emitted on exit so the
+    ring buffer holds only finished spans."""
+
+    __slots__ = ("tracer", "name", "cat", "args", "tid", "ts_us", "dur",
+                 "_t0")
+
+    def __init__(self, tracer: "Tracer", name: str, cat: str, args: dict):
+        self.tracer = tracer
+        self.name = name
+        self.cat = cat
+        self.args = args
+        self.tid = threading.get_ident()
+        self.ts_us = 0.0
+        self.dur = 0.0
+        self._t0 = 0.0
+
+    def set(self, **args) -> "Span":
+        """Attach results discovered mid-span (e.g. bytes moved)."""
+        self.args.update(args)
+        return self
+
+    def __enter__(self) -> "Span":
+        self.tracer._push(self)
+        self._t0 = time.perf_counter()
+        self.ts_us = (self._t0 - self.tracer._t0) * 1e6
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.dur = time.perf_counter() - self._t0
+        self.tracer._pop(self)
+        self.tracer._finish_span(self)
+        return False
+
+
+class Tracer:
+    """Thread-safe span recorder with a bounded ring of Chrome events."""
+
+    def __init__(self, capacity: int = TRACE_CAPACITY):
+        self._events: deque[dict] = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self._local = threading.local()
+        # paired clocks: spans stamp with perf_counter; wall-clock sources
+        # (TrackedOp timelines) map through the epoch pair
+        self._t0 = time.perf_counter()
+        self._wall0 = time.time()
+        self.pid = os.getpid()
+        # span-name -> [bucket_counts..., overflow] plus (sum, count)
+        self._hist: dict[str, dict] = {}
+
+    # -- span stack (per thread, for nesting introspection) ----------------
+
+    def _stack(self) -> list:
+        st = getattr(self._local, "stack", None)
+        if st is None:
+            st = self._local.stack = []
+        return st
+
+    def _push(self, span: Span) -> None:
+        self._stack().append(span)
+
+    def _pop(self, span: Span) -> None:
+        st = self._stack()
+        if st and st[-1] is span:
+            st.pop()
+
+    def current(self) -> Span | None:
+        st = self._stack()
+        return st[-1] if st else None
+
+    def depth(self) -> int:
+        return len(self._stack())
+
+    # -- recording ----------------------------------------------------------
+
+    def span(self, name: str, cat: str = "", **args) -> Span:
+        return Span(self, name, cat, args)
+
+    def instant(self, name: str, cat: str = "", **args) -> None:
+        ev = {"name": name, "cat": cat or "instant", "ph": "i", "s": "t",
+              "ts": (time.perf_counter() - self._t0) * 1e6,
+              "pid": self.pid, "tid": threading.get_ident()}
+        if args:
+            ev["args"] = args
+        with self._lock:
+            self._events.append(ev)
+
+    def complete(self, name: str, start_wall: float, dur_s: float,
+                 cat: str = "", **args) -> None:
+        """A span observed externally on the WALL clock (TrackedOp ops):
+        mapped onto the tracer timeline via the paired epochs."""
+        ev = {"name": name, "cat": cat or "op", "ph": "X",
+              "ts": (start_wall - self._wall0) * 1e6,
+              "dur": dur_s * 1e6,
+              "pid": self.pid, "tid": threading.get_ident()}
+        if args:
+            ev["args"] = args
+        with self._lock:
+            self._events.append(ev)
+        self._hist_add(name, dur_s)
+
+    def _finish_span(self, span: Span) -> None:
+        ev = {"name": span.name, "cat": span.cat or "span", "ph": "X",
+              "ts": span.ts_us, "dur": span.dur * 1e6,
+              "pid": self.pid, "tid": span.tid}
+        if span.args:
+            ev["args"] = dict(span.args)
+        with self._lock:
+            self._events.append(ev)
+        self._hist_add(span.name, span.dur)
+
+    def _hist_add(self, name: str, dur_s: float) -> None:
+        with self._lock:
+            h = self._hist.get(name)
+            if h is None:
+                h = self._hist[name] = {
+                    "counts": [0] * (len(LATENCY_BUCKETS_S) + 1),
+                    "sum": 0.0, "count": 0}
+            for i, bound in enumerate(LATENCY_BUCKETS_S):
+                if dur_s <= bound:
+                    h["counts"][i] += 1
+                    break
+            else:
+                h["counts"][-1] += 1
+            h["sum"] += dur_s
+            h["count"] += 1
+
+    # -- export --------------------------------------------------------------
+
+    def dump(self) -> dict:
+        """Chrome trace-event JSON (the ``trace dump`` admin command):
+        load in chrome://tracing or ui.perfetto.dev as-is."""
+        with self._lock:
+            events = list(self._events)
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+    def reset(self) -> dict:
+        with self._lock:
+            n = len(self._events)
+            self._events.clear()
+            self._hist.clear()
+        return {"success": f"dropped {n} events"}
+
+    def histograms(self) -> dict:
+        """Per-span-name latency histograms: {name: {buckets (bounds, s),
+        counts (len+1, last = overflow), sum, count}}."""
+        with self._lock:
+            return {name: {"buckets": list(LATENCY_BUCKETS_S),
+                           "counts": list(h["counts"]),
+                           "sum": h["sum"], "count": h["count"]}
+                    for name, h in self._hist.items()}
+
+
+_default_tracer: Tracer | None = None
+_default_lock = threading.Lock()
+
+
+def default_tracer() -> Tracer:
+    global _default_tracer
+    if _default_tracer is None:
+        with _default_lock:
+            if _default_tracer is None:
+                _default_tracer = Tracer()
+    return _default_tracer
+
+
+def trace_span(name: str, cat: str = "", **args) -> Span:
+    """Convenience: a span on the process-default tracer."""
+    return default_tracer().span(name, cat, **args)
+
+
+def trace_instant(name: str, cat: str = "", **args) -> None:
+    default_tracer().instant(name, cat, **args)
+
+
+# -- JIT telemetry registry (fed by ceph_tpu.ops.traced_jit) ----------------
+#
+# Keyed by (function label, shape key).  Each entry exists because exactly
+# one compilation happened for that key; re-dispatches bump ``calls``.  The
+# ``jit`` PerfCounters collection aggregates across keys and is registered
+# into every Context's collection so `perf dump` / prometheus carry it.
+
+_jit_lock = threading.Lock()
+_jit_stats: dict[tuple, dict] = {}
+_jit_perf = None
+
+
+def jit_perf_counters():
+    """The process-wide ``jit`` PerfCounters (built lazily: tracer must
+    stay importable before perf_counters in partial environments)."""
+    global _jit_perf
+    with _jit_lock:
+        if _jit_perf is None:
+            from .perf_counters import PerfCountersBuilder
+            _jit_perf = (
+                PerfCountersBuilder("jit")
+                .add_u64_counter("compilations",
+                                 "distinct (function, shape) compiles")
+                .add_u64_counter("cache_hits",
+                                 "dispatches served by a compiled cache key")
+                .add_time_avg("trace_time", "jaxpr trace wall time")
+                .add_time_avg("compile_time", "XLA compile wall time")
+                .add_time_avg("first_dispatch_time",
+                              "first execution incl. completion wait")
+                .create_perf_counters())
+        return _jit_perf
+
+
+def record_compilation(fn_label: str, key, trace_s: float, compile_s: float,
+                       dispatch_s: float) -> None:
+    pc = jit_perf_counters()
+    pc.inc("compilations")
+    pc.tinc("trace_time", trace_s)
+    pc.tinc("compile_time", compile_s)
+    pc.tinc("first_dispatch_time", dispatch_s)
+    with _jit_lock:
+        entry = _jit_stats.get((fn_label, key))
+        if entry is None:
+            _jit_stats[(fn_label, key)] = {
+                "function": fn_label, "key": repr(key), "compiles": 1,
+                "trace_s": trace_s, "compile_s": compile_s,
+                "first_dispatch_s": dispatch_s, "calls": 1}
+        else:
+            # distinct jitted closures can share a label (e.g. one
+            # BulkMapper kernel per CRUSH rule): accumulate, don't clobber
+            entry["compiles"] += 1
+            entry["calls"] += 1
+            entry["trace_s"] += trace_s
+            entry["compile_s"] += compile_s
+            entry["first_dispatch_s"] += dispatch_s
+
+
+def record_cache_hit(fn_label: str, key) -> None:
+    jit_perf_counters().inc("cache_hits")
+    with _jit_lock:
+        entry = _jit_stats.get((fn_label, key))
+        if entry is not None:
+            entry["calls"] += 1
+
+
+def jit_dump() -> dict:
+    """The ``jit dump`` admin command: per-key stats + the aggregate
+    counters, compile-cost-sorted so the expensive kernels lead."""
+    with _jit_lock:
+        entries = [dict(e) for e in _jit_stats.values()]
+    entries.sort(key=lambda e: e["compile_s"], reverse=True)
+    return {"functions": entries,
+            "num_keys": len(entries),
+            "counters": jit_perf_counters().dump()}
+
+
+def jit_reset() -> dict:
+    with _jit_lock:
+        n = len(_jit_stats)
+        _jit_stats.clear()
+    return {"success": f"dropped {n} jit cache-key records"}

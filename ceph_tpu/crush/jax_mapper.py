@@ -373,7 +373,9 @@ class BulkMapper:
 
             return firstn_one if kind == "firstn" else indep_one
 
-        @jax.jit
+        from ..ops.traced_jit import traced_jit
+
+        @traced_jit(name=f"crush.bulk.{kind}")
         def bulk(xs, reweights, ws_pos, hash_ids):
             one = make_one(ws_pos, hash_ids)
             return jax.vmap(lambda x: one(x, reweights))(xs)
@@ -428,8 +430,13 @@ class BulkMapper:
         n_pos, ws_arr, ids_arr = self._compile_choose_args(choose_args)
         bulk = self._kernel(kind, root, int(numrep), int(out_size),
                             int(arg2), leaf, int(n_pos))
-        out, placed = bulk(xs, reweights, jnp.asarray(ws_arr),
-                           jnp.asarray(ids_arr))
         if traced:
-            return out, placed
+            # inside an enclosing jit/shard_map: stay on-device, no spans
+            return bulk(xs, reweights, jnp.asarray(ws_arr),
+                        jnp.asarray(ids_arr))
+        from ..common.tracer import trace_span
+        with trace_span("crush.bulk_map", pgs=int(xs.shape[0]),
+                        rule=int(ruleno), kind=kind, numrep=int(numrep)):
+            out, placed = bulk(xs, reweights, jnp.asarray(ws_arr),
+                               jnp.asarray(ids_arr))
         return np.asarray(out), np.asarray(placed)

@@ -1,12 +1,17 @@
-"""Prometheus exporter: perf counters in the text exposition format.
+"""Prometheus exporter: perf counters + span latencies in the text format.
 
 Analog of the reference mgr's prometheus module (reference:
 src/pybind/mgr/prometheus/module.py — walks every daemon's perf counter
 schema and renders `ceph_<subsystem>_<counter>` metrics).  Here the
 process-wide PerfCounters registry renders to the same text format:
 counters as `ceph_tpu_<collection>_<name>`, averages as `_sum`/`_count`
-pairs, histograms as cumulative `_bucket{le=...}` series — scrapeable by
-an actual Prometheus, or by the tests that pin the format.
+pairs, histograms as cumulative `_bucket{le=...}` series **plus the
+`_sum` series real scrapers require for histogram types** — and the span
+tracer's per-name latency distributions as
+`ceph_tpu_span_latency_seconds` histograms.  `# HELP`/`# TYPE` are
+emitted exactly once per metric name (several collections share counter
+names, e.g. one `ec_backend.<pg>` per PG) and the `collection` label is
+identical across a histogram's `_bucket`/`_count`/`_sum` series.
 """
 from __future__ import annotations
 
@@ -14,6 +19,7 @@ from ..common import default_context
 from ..common.perf_counters import (
     PERFCOUNTER_AVG, PERFCOUNTER_HISTOGRAM, PERFCOUNTER_TIME_AVG,
 )
+from ..common.tracer import default_tracer
 
 
 def _sanitize(name: str) -> str:
@@ -21,32 +27,73 @@ def _sanitize(name: str) -> str:
                    for ch in name)
 
 
+class _MetricFamily:
+    """One exposition block: HELP + TYPE once, then every series."""
+
+    def __init__(self, name: str, kind: str, help_text: str):
+        self.name, self.kind = name, kind
+        self.help = help_text or name
+        self.lines: list[str] = []
+
+    def render(self) -> list[str]:
+        return [f"# HELP {self.name} {self.help}",
+                f"# TYPE {self.name} {self.kind}"] + self.lines
+
+
+def _histogram_series(fam: _MetricFamily, label: str, bounds, counts,
+                      total_sum: float) -> None:
+    """Cumulative buckets + the +Inf bucket + _sum/_count, all under ONE
+    label set (the satellite contract: consistent `collection`/`span`
+    labels across the three series)."""
+    cum = 0
+    for bound, n in zip(bounds, counts):
+        cum += n
+        fam.lines.append(f'{fam.name}_bucket{{{label},le="{bound}"}} {cum}')
+    total = cum + (counts[len(bounds)] if len(counts) > len(bounds) else 0)
+    fam.lines.append(f'{fam.name}_bucket{{{label},le="+Inf"}} {total}')
+    fam.lines.append(f'{fam.name}_sum{{{label}}} {total_sum}')
+    fam.lines.append(f'{fam.name}_count{{{label}}} {total}')
+
+
 def render(cct=None, prefix: str = "ceph_tpu") -> str:
-    """The /metrics payload: every registered collection's metrics."""
+    """The /metrics payload: every registered collection's metrics plus
+    the tracer's span-latency histograms."""
     cct = cct if cct is not None else default_context()
-    lines: list[str] = []
+    families: dict[str, _MetricFamily] = {}
+
+    def family(metric: str, kind: str, help_text: str) -> _MetricFamily:
+        fam = families.get(metric)
+        if fam is None:
+            fam = families[metric] = _MetricFamily(metric, kind, help_text)
+        return fam
+
     for coll_name, pc in sorted(cct.perf._loggers.items()):
-        label = f'{{collection="{coll_name}"}}'
+        label = f'collection="{coll_name}"'
         for key, m in sorted(pc._metrics.items()):
             metric = f"{prefix}_{_sanitize(key)}"
             if m.kind in (PERFCOUNTER_AVG, PERFCOUNTER_TIME_AVG):
-                lines.append(f"# TYPE {metric} summary")
-                lines.append(f"{metric}_sum{label} {m.sum}")
-                lines.append(f"{metric}_count{label} {m.count}")
+                fam = family(metric, "summary", m.description)
+                fam.lines.append(f"{metric}_sum{{{label}}} {m.sum}")
+                fam.lines.append(f"{metric}_count{{{label}}} {m.count}")
             elif m.kind == PERFCOUNTER_HISTOGRAM:
-                lines.append(f"# TYPE {metric} histogram")
-                cum = 0
-                for bound, n in zip(m.buckets, m.bucket_counts):
-                    cum += n
-                    lines.append(
-                        f'{metric}_bucket{{collection="{coll_name}",'
-                        f'le="{bound}"}} {cum}')
-                total = sum(m.bucket_counts)
-                lines.append(
-                    f'{metric}_bucket{{collection="{coll_name}",'
-                    f'le="+Inf"}} {total}')
-                lines.append(f"{metric}_count{label} {total}")
+                fam = family(metric, "histogram", m.description)
+                _histogram_series(fam, label, m.buckets, m.bucket_counts,
+                                  m.sum)
             else:
-                lines.append(f"# TYPE {metric} counter")
-                lines.append(f"{metric}{label} {m.value}")
+                fam = family(metric, "counter", m.description)
+                fam.lines.append(f"{metric}{{{label}}} {m.value}")
+
+    span_metric = f"{prefix}_span_latency_seconds"
+    hists = default_tracer().histograms()
+    if hists:
+        fam = family(span_metric, "histogram",
+                     "span wall time by span name (common/tracer.py)")
+        for name in sorted(hists):
+            h = hists[name]
+            _histogram_series(fam, f'span="{name}"', h["buckets"],
+                              h["counts"], h["sum"])
+
+    lines: list[str] = []
+    for metric in sorted(families):
+        lines.extend(families[metric].render())
     return "\n".join(lines) + "\n"

@@ -1,5 +1,7 @@
+from .traced_jit import traced_jit
 from .rs_kernels import gf_apply, gf_apply_bitslice, gf_apply_lookup, xor_reduce
 from .codec import RSCodec, TECHNIQUES
 
-__all__ = ["gf_apply", "gf_apply_bitslice", "gf_apply_lookup", "xor_reduce",
+__all__ = ["traced_jit",
+           "gf_apply", "gf_apply_bitslice", "gf_apply_lookup", "xor_reduce",
            "RSCodec", "TECHNIQUES"]

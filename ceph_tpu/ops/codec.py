@@ -15,6 +15,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..common.tracer import trace_span
 from ..gf import matrix as gfm
 from ..gf import ref as gfref
 from . import rs_kernels
@@ -66,17 +67,23 @@ class RSCodec:
             b, k, n = data.shape
             out = self.encode(np.swapaxes(data, 0, 1).reshape(k, b * n))
             return np.swapaxes(out.reshape(self.m, b, n), 0, 1)
-        if self.device == "numpy":
-            return gfref.apply_matrix_fast(self.parity_mat, data)
+        with trace_span("codec.encode", k=self.k, m=self.m,
+                        n=int(data.shape[1]), device=self.device):
+            if self.device == "numpy":
+                return gfref.apply_matrix_fast(self.parity_mat, data)
+            self._upload_parity()
+            out = rs_kernels.gf_apply(self._parity_dev, data, self.variant)
+            return np.asarray(jax.device_get(out))
+
+    def _upload_parity(self) -> None:
         if self._parity_dev is None:
-            self._parity_dev = jnp.asarray(self.parity_mat)
-        out = rs_kernels.gf_apply(self._parity_dev, data, self.variant)
-        return np.asarray(jax.device_get(out))
+            with trace_span("codec.table_upload",
+                            bytes=int(self.parity_mat.nbytes)):
+                self._parity_dev = jnp.asarray(self.parity_mat)
 
     def encode_device(self, data: jax.Array) -> jax.Array:
         """Device-to-device encode (no host transfer), for pipeline use."""
-        if self._parity_dev is None:
-            self._parity_dev = jnp.asarray(self.parity_mat)
+        self._upload_parity()
         return rs_kernels.gf_apply(self._parity_dev, data, self.variant)
 
     # -- decode ------------------------------------------------------------
@@ -90,7 +97,10 @@ class RSCodec:
             if hit is not None:
                 self._decode_cache.move_to_end(sig)
                 return hit
-        D, src = gfm.decode_matrix(self.parity_mat, list(erasures), available)
+        with trace_span("codec.decode_matrix_build", k=self.k, m=self.m,
+                        erasures=len(sig[0])):
+            D, src = gfm.decode_matrix(self.parity_mat, list(erasures),
+                                       available)
         with self._lock:
             self._decode_cache[sig] = (D, src)
             if len(self._decode_cache) > DECODE_CACHE_SIZE:
@@ -108,11 +118,15 @@ class RSCodec:
             return {}
         D, src = self.decode_matrix(erasures, available=list(chunks))
         stack = np.stack([np.asarray(chunks[i], dtype=np.uint8) for i in src])
-        if self.device == "numpy":
-            rec = gfref.apply_matrix_fast(D, stack)
-        else:
-            rec = np.asarray(jax.device_get(
-                rs_kernels.gf_apply(jnp.asarray(D), stack, self.variant)))
+        with trace_span("codec.decode", k=self.k, m=self.m,
+                        n=int(stack.shape[1]), erasures=len(erasures),
+                        device=self.device):
+            if self.device == "numpy":
+                rec = gfref.apply_matrix_fast(D, stack)
+            else:
+                rec = np.asarray(jax.device_get(
+                    rs_kernels.gf_apply(jnp.asarray(D), stack,
+                                        self.variant)))
         return {e: rec[i] for i, e in enumerate(erasures)}
 
     def decode_batch(self, stack: np.ndarray, src: list[int],
@@ -130,9 +144,13 @@ class RSCodec:
         b, k, n = stack.shape
         folded = np.ascontiguousarray(
             np.swapaxes(stack, 0, 1).reshape(k, b * n), dtype=np.uint8)
-        if self.device == "numpy":
-            rec = gfref.apply_matrix_fast(D, folded)
-        else:
-            rec = np.asarray(jax.device_get(
-                rs_kernels.gf_apply(jnp.asarray(D), folded, self.variant)))
+        with trace_span("codec.decode_batch", k=self.k, m=self.m,
+                        batch=int(b), n=int(n), erasures=len(erasures),
+                        device=self.device):
+            if self.device == "numpy":
+                rec = gfref.apply_matrix_fast(D, folded)
+            else:
+                rec = np.asarray(jax.device_get(
+                    rs_kernels.gf_apply(jnp.asarray(D), folded,
+                                        self.variant)))
         return np.swapaxes(rec.reshape(len(erasures), b, n), 0, 1)

@@ -32,6 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..gf.tables import MUL_TABLE
+from .traced_jit import traced_jit
 
 def _mul_dev():
     """The 256x256 GF(2^8) product table as a trace-time constant (64 KiB)."""
@@ -73,7 +74,7 @@ def _pack_bits(bits: jax.Array) -> jax.Array:
     return (bits.reshape(r, 8, n) * w).sum(axis=1).astype(jnp.uint8)
 
 
-@jax.jit
+@traced_jit
 def gf_apply_bitslice(mat: jax.Array, data: jax.Array) -> jax.Array:
     """MXU path: out = mat @GF data via GF(2) bf16 matmul."""
     B = _expand_bits_device(mat).astype(jnp.bfloat16)      # [8r, 8k]
@@ -85,7 +86,7 @@ def gf_apply_bitslice(mat: jax.Array, data: jax.Array) -> jax.Array:
     return _pack_bits(bits)
 
 
-@jax.jit
+@traced_jit
 def gf_apply_lookup(mat: jax.Array, data: jax.Array) -> jax.Array:
     """VPU path: per-coefficient 256-entry product-table gathers, XOR-reduced."""
     tables = _mul_dev()[mat.astype(jnp.int32)]             # [r, k, 256]
@@ -97,7 +98,7 @@ def gf_apply_lookup(mat: jax.Array, data: jax.Array) -> jax.Array:
     return jax.lax.reduce(terms, np.uint8(0), jax.lax.bitwise_xor, [0])
 
 
-@jax.jit
+@traced_jit
 def xor_reduce(data: jax.Array) -> jax.Array:
     """XOR of all chunk rows: [k, N] -> [1, N] (m=1 / parity-row-of-ones path,
     cf. the isa plugin's region_xor short-circuit, ErasureCodeIsa.cc:119-131)."""
@@ -225,6 +226,6 @@ def bitplane_xor_matmul(W, d):
     return out.astype(jnp.uint8)
 
 
-@jax.jit
+@traced_jit
 def _xor_apply_xla(W, packets):
     return bitplane_xor_matmul(W, packets)

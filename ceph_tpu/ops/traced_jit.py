@@ -1,0 +1,95 @@
+"""traced_jit: a jax.jit wrapper that accounts for every compilation.
+
+The plain ``@jax.jit`` hides the costs that dominate TPU cold paths: jaxpr
+tracing, XLA compilation, and the first dispatch (which waits out transfer
++ execution).  ``traced_jit`` keeps its own (function, shape/dtype) key
+cache built through the AOT API — ``lower()`` / ``compile()`` — so each
+stage is timed separately, then:
+
+- emits ``jit.trace`` / ``jit.compile`` / ``jit.first_dispatch`` spans on
+  the default tracer,
+- records the per-key breakdown in the process-wide registry behind the
+  ``jit dump`` admin command,
+- bumps the ``jit`` PerfCounters collection (compilations, cache_hits,
+  per-stage time averages).
+
+Calls with traced arguments (the wrapper used inside an enclosing jit,
+e.g. the bench chain or shard_map) inline through the underlying jitted
+function untouched — telemetry covers real dispatches only.  If the AOT
+path is unsupported for a signature, the wrapper falls back to the plain
+jit cache and books the whole first call as compile time.
+"""
+from __future__ import annotations
+
+import functools
+import threading
+import time
+
+import jax
+
+from ..common import tracer as _tracer
+
+
+def _shape_key(args) -> tuple:
+    parts = []
+    for a in args:
+        shape = getattr(a, "shape", None)
+        if shape is not None:
+            parts.append((tuple(shape), str(getattr(a, "dtype", ""))))
+        else:
+            parts.append(repr(a))
+    return tuple(parts)
+
+
+def traced_jit(fn=None, *, name: str | None = None, **jit_kwargs):
+    """Drop-in for ``jax.jit`` with compile/dispatch telemetry."""
+    if fn is None:
+        return lambda f: traced_jit(f, name=name, **jit_kwargs)
+
+    jfn = jax.jit(fn, **jit_kwargs)
+    label = name or getattr(fn, "__name__", repr(fn))
+    compiled_cache: dict[tuple, object] = {}
+    lock = threading.Lock()
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        if kwargs or any(isinstance(a, jax.core.Tracer) for a in args):
+            # inlining under an outer trace (or kwargs the AOT signature
+            # can't key): no real dispatch happens here
+            return jfn(*args, **kwargs)
+        key = _shape_key(args)
+        compiled = compiled_cache.get(key)
+        if compiled is not None:
+            _tracer.record_cache_hit(label, key)
+            return compiled(*args)
+        with lock:
+            compiled = compiled_cache.get(key)
+            if compiled is not None:
+                _tracer.record_cache_hit(label, key)
+                return compiled(*args)
+            tr = _tracer.default_tracer()
+            try:
+                with tr.span("jit.trace", fn=label) as sp_t:
+                    lowered = jfn.lower(*args)
+                with tr.span("jit.compile", fn=label) as sp_c:
+                    compiled = lowered.compile()
+                with tr.span("jit.first_dispatch", fn=label) as sp_d:
+                    out = compiled(*args)
+                    jax.block_until_ready(out)
+                compiled_cache[key] = compiled
+                _tracer.record_compilation(label, key, sp_t.dur, sp_c.dur,
+                                           sp_d.dur)
+            except Exception:
+                # AOT unsupported for this signature: the jit cache still
+                # compiles exactly once per key; book the first call whole
+                t0 = time.perf_counter()
+                out = jfn(*args)
+                jax.block_until_ready(out)
+                compiled_cache[key] = jfn
+                _tracer.record_compilation(label, key, 0.0,
+                                           time.perf_counter() - t0, 0.0)
+            return out
+
+    wrapper.__wrapped_jit__ = jfn
+    wrapper.__traced_label__ = label
+    return wrapper

@@ -1,0 +1,45 @@
+"""Guard: hot paths time through the tracer/perf API, not ad-hoc clocks.
+
+``ceph_tpu/ops/`` and ``ceph_tpu/backend/`` are the encode/decode hot
+paths; timing added there must go through ``trace_span``,
+``PerfCounters.time``/``tinc`` or ``traced_jit`` so it lands in the
+observability surfaces (`trace dump`, `perf dump`, prometheus) instead of
+rotting as a local print.  A bare ``time.time()`` / ``perf_counter()``
+call site is allowed only on the explicit allowlist below (the timing
+wrappers themselves).
+"""
+import re
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+SCAN_DIRS = ("ceph_tpu/ops", "ceph_tpu/backend")
+
+# path -> why the bare clock is legitimate there
+ALLOWLIST = {
+    "ceph_tpu/ops/traced_jit.py":
+        "IS the timing wrapper (AOT fallback books compile wall time)",
+}
+
+_BARE_TIME = re.compile(r"time\.time\(\)|perf_counter\(\)")
+
+
+def test_no_bare_timing_in_hot_paths():
+    offenders = []
+    for sub in SCAN_DIRS:
+        for path in sorted((ROOT / sub).rglob("*.py")):
+            rel = path.relative_to(ROOT).as_posix()
+            if rel in ALLOWLIST:
+                continue
+            for lineno, line in enumerate(
+                    path.read_text().splitlines(), start=1):
+                if _BARE_TIME.search(line):
+                    offenders.append(f"{rel}:{lineno}: {line.strip()}")
+    assert not offenders, (
+        "bare timing calls in hot paths — route them through "
+        "trace_span/PerfCounters/traced_jit (or extend the allowlist "
+        "with a justification):\n" + "\n".join(offenders))
+
+
+def test_allowlist_entries_still_exist():
+    for rel in ALLOWLIST:
+        assert (ROOT / rel).exists(), f"stale allowlist entry: {rel}"

@@ -124,6 +124,60 @@ class TestPrometheus:
         # time averages render as summary sum/count pairs
         assert any("_sum{" in line for line in lines)
         assert any("_count{" in line for line in lines)
+        # HELP precedes TYPE for every family, exactly once per metric
+        helps = [line.split(" ", 2)[2].split(" ", 1)[0] for line in lines
+                 if line.startswith("# HELP ")]
+        types = [line.split(" ", 2)[2].split(" ", 1)[0] for line in lines
+                 if line.startswith("# TYPE ")]
+        assert helps and helps == types
+        assert len(types) == len(set(types)), "duplicate TYPE lines"
+
+    def test_histogram_exposition_pinned(self):
+        """Real Prometheus scrapers require the `_sum` series (and HELP)
+        for histogram types, with ONE label set across _bucket/_count/
+        _sum (regression: _sum was missing and HELP never emitted)."""
+        from ceph_tpu.common import Context, PerfCountersBuilder
+        from ceph_tpu.mgr.prometheus import render
+        cct = Context()
+        pc = (PerfCountersBuilder("histo_test")
+              .add_histogram("op_lat", [1, 10, 100],
+                             "op latency histogram")
+              .create_perf_counters())
+        cct.perf.add(pc)
+        pc.hinc("op_lat", 5)
+        pc.hinc("op_lat", 250)         # overflow -> +Inf only
+        text = render(cct)
+        lines = text.splitlines()
+        assert lines.count("# TYPE ceph_tpu_op_lat histogram") == 1
+        assert "# HELP ceph_tpu_op_lat op latency histogram" in lines
+        # cumulative buckets, +Inf, then _sum and _count — one label set
+        assert 'ceph_tpu_op_lat_bucket{collection="histo_test",' \
+               'le="1"} 0' in lines
+        assert 'ceph_tpu_op_lat_bucket{collection="histo_test",' \
+               'le="10"} 1' in lines
+        assert 'ceph_tpu_op_lat_bucket{collection="histo_test",' \
+               'le="100"} 1' in lines
+        assert 'ceph_tpu_op_lat_bucket{collection="histo_test",' \
+               'le="+Inf"} 2' in lines
+        assert 'ceph_tpu_op_lat_sum{collection="histo_test"} 255.0' in lines
+        assert 'ceph_tpu_op_lat_count{collection="histo_test"} 2' in lines
+
+    def test_span_latency_histograms_rendered(self):
+        """The tracer's per-span-name latency distributions surface as
+        prometheus histograms with the full _bucket/_sum/_count set."""
+        from ceph_tpu.common import Context
+        from ceph_tpu.common.tracer import trace_span
+        from ceph_tpu.mgr.prometheus import render
+        with trace_span("prom.test.span"):
+            pass
+        text = render(Context())
+        assert "# TYPE ceph_tpu_span_latency_seconds histogram" in text
+        assert 'ceph_tpu_span_latency_seconds_bucket{' \
+               'span="prom.test.span",le="+Inf"}' in text
+        assert 'ceph_tpu_span_latency_seconds_sum{' \
+               'span="prom.test.span"}' in text
+        assert 'ceph_tpu_span_latency_seconds_count{' \
+               'span="prom.test.span"}' in text
 
 
 class TestWatchAtomicity:

@@ -1,0 +1,96 @@
+#!/usr/bin/env python3
+"""Render a Chrome trace-event file as a sorted self-time table.
+
+Input: the JSON `trace dump` returns (``{"traceEvents": [...]}``, or a bare
+event array) — save it with e.g.
+
+    python - <<'PY'
+    from ceph_tpu.common import default_context
+    open("trace.json", "w").write(
+        default_context().admin_socket.call_json("trace dump"))
+    PY
+
+then ``python tools/trace_report.py trace.json``.  Self time is each
+span's duration minus the duration of spans nested inside it (same
+pid/tid, contained by timestamps), i.e. where the wall clock actually
+went — the number that ranks optimization targets, which total time
+(double-counting every parent) cannot.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import defaultdict
+
+
+def load_events(path: str) -> list[dict]:
+    with open(path) as f:
+        doc = json.load(f)
+    events = doc["traceEvents"] if isinstance(doc, dict) else doc
+    return [e for e in events if e.get("ph") == "X"]
+
+
+def self_times(events: list[dict]) -> dict[str, dict]:
+    """name -> {count, total_us, self_us}; nesting resolved per (pid, tid)
+    with a containment stack sweep over ts-sorted complete events."""
+    agg: dict[str, dict] = defaultdict(
+        lambda: {"count": 0, "total_us": 0.0, "self_us": 0.0})
+    by_track: dict[tuple, list[dict]] = defaultdict(list)
+    for ev in events:
+        by_track[(ev.get("pid"), ev.get("tid"))].append(ev)
+    for track in by_track.values():
+        # parents first at equal start times (longer duration wins)
+        track.sort(key=lambda e: (e["ts"], -e.get("dur", 0.0)))
+        stack: list[dict] = []          # enclosing spans, innermost last
+        for ev in track:
+            dur = float(ev.get("dur", 0.0))
+            ts = float(ev["ts"])
+            while stack and stack[-1]["ts"] + stack[-1].get("dur", 0.0) \
+                    <= ts:
+                stack.pop()
+            if stack:                   # nested: charge the parent less
+                parent = agg[stack[-1]["name"]]
+                parent["self_us"] -= dur
+            a = agg[ev["name"]]
+            a["count"] += 1
+            a["total_us"] += dur
+            a["self_us"] += dur
+            stack.append(ev)
+    return dict(agg)
+
+
+def render_table(agg: dict[str, dict], limit: int = 0) -> str:
+    rows = sorted(agg.items(), key=lambda kv: kv[1]["self_us"],
+                  reverse=True)
+    if limit:
+        rows = rows[:limit]
+    width = max([len("span")] + [len(name) for name, _ in rows])
+    lines = [f"{'span':<{width}}  {'count':>7}  {'total ms':>10}  "
+             f"{'self ms':>10}  {'avg ms':>9}"]
+    for name, a in rows:
+        avg = a["total_us"] / a["count"] / 1e3 if a["count"] else 0.0
+        lines.append(
+            f"{name:<{width}}  {a['count']:>7}  "
+            f"{a['total_us'] / 1e3:>10.3f}  {a['self_us'] / 1e3:>10.3f}  "
+            f"{avg:>9.3f}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="sorted self-time table from a Chrome trace file")
+    ap.add_argument("trace", help="trace JSON ({'traceEvents': ...} or [])")
+    ap.add_argument("--limit", type=int, default=0,
+                    help="show only the top N spans by self time")
+    args = ap.parse_args(argv)
+    events = load_events(args.trace)
+    if not events:
+        print("no complete ('ph': 'X') events in trace", file=sys.stderr)
+        return 1
+    print(render_table(self_times(events), args.limit))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
