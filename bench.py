@@ -240,6 +240,40 @@ def cpu_baseline(data, k, m, erasures):
 
 _emit_lock = threading.Lock()
 _emitted = False
+_SERVING: dict | None = None     # the serving-engine comparison block
+
+
+def serving_section(platform: str | None) -> dict:
+    """Closed-loop serving comparison (coalesced vs op-at-a-time on the
+    SAME device) for the JSON artifact's `serving` block: throughput +
+    p50/p99 at fixed concurrency through ceph_tpu.exec.ServingEngine.
+    Degrades to a clearly-marked CPU line (numpy codec) when no backend
+    initialized, and to an error marker rather than failing the bench."""
+    try:
+        from ceph_tpu.backend import StripeInfo
+        from ceph_tpu.exec.workload import compare_batched_unbatched
+        from ceph_tpu.plugins.registry import ErasureCodePluginRegistry
+        device = "jax" if platform is not None else "numpy"
+        ec = ErasureCodePluginRegistry.instance().factory(
+            "jax_rs", "", {"plugin": "jax_rs", "k": "4", "m": "2",
+                           "technique": "reed_sol_van", "device": device})
+        with phase("serving"):
+            res = compare_batched_unbatched(
+                ec, StripeInfo(4, 1024), n_ops=256, concurrency=64,
+                op_bytes=4096, warmup_ops=64, timeout=240.0)
+        res["device"] = "tpu" if platform == "tpu" else "cpu"
+        if res["device"] == "cpu":
+            res["note"] = ("no tpu: dispatch overhead measured on the "
+                           f"{'jax-cpu' if platform else 'numpy'} path")
+        print(f"# serving: batched {res['batched']['ops_s']:.0f} ops/s "
+              f"(p99 {res['batched']['p99_ms']:.2f} ms) vs unbatched "
+              f"{res['unbatched']['ops_s']:.0f} ops/s (p99 "
+              f"{res['unbatched']['p99_ms']:.2f} ms) -> "
+              f"{res['speedup']}x on {res['device']}", file=sys.stderr)
+        return res
+    except Exception as e:                 # never fail the artifact
+        print(f"# serving bench failed: {e!r}", file=sys.stderr)
+        return {"device": "none", "error": repr(e)[:200]}
 
 
 def emit(value, vs_baseline, extra):
@@ -257,6 +291,8 @@ def emit(value, vs_baseline, extra):
         "vs_baseline": round(vs_baseline, 3),
     }
     line.update(extra)
+    if _SERVING is not None:
+        line.setdefault("serving", _SERVING)
     # always carried, even on the watchdog/fallback paths: the per-phase
     # breakdown and the per-attempt probe record accumulated so far.  A
     # phase still OPEN when the watchdog fires is exactly the one that
@@ -411,6 +447,11 @@ def main() -> int:
 
     with phase("probe"):
         platform = probe_backend()
+    # serving comparison (coalesced vs op-at-a-time) on whatever device
+    # is up — its own subsystem, measured before the device codec pass so
+    # a tunnel death mid-codec still leaves the serving block in the line
+    global _SERVING
+    _SERVING = serving_section(platform)
     if platform == "tpu":
         try:
             combined, extra = measure_device(data, k, m, erasures, batch)

@@ -89,6 +89,28 @@ class ECBackend(PGBackend):
         self.extent_cache = ExtentCache()
         self.in_progress_reads: dict[int, ReadOp] = {}
         self.hinfo_cache: dict[str, HashInfo] = {}
+        # optional serving engine (ceph_tpu/exec): when attached, encode/
+        # decode dispatches route through its admission+coalescing queue
+        # so CONCURRENT ops across PGs fuse into one device batch
+        self.serving = None
+
+    def attach_serving(self, engine) -> None:
+        """Route this backend's codec dispatches through a
+        :class:`~ceph_tpu.exec.ServingEngine` (throttled admission,
+        deadline-driven cross-op coalescing, QoS-ordered batching)."""
+        self.serving = engine
+
+    def _serving_encode(self, logical) -> dict[int, np.ndarray]:
+        if self.serving is not None:
+            return self.serving.encode(logical, sinfo=self.sinfo,
+                                       ec_impl=self.ec_impl)
+        return ecutil.encode(self.sinfo, self.ec_impl, logical)
+
+    def _serving_decode(self, by_chunk) -> bytes:
+        if self.serving is not None:
+            return self.serving.decode(by_chunk, sinfo=self.sinfo,
+                                       ec_impl=self.ec_impl)
+        return ecutil.decode(self.sinfo, self.ec_impl, by_chunk)
 
     # -- EC metadata ---------------------------------------------------------
 
@@ -357,9 +379,10 @@ class ECBackend(PGBackend):
             else:
                 with trace_span("ec.encode", oid=oid,
                                 bytes=int(logical.nbytes),
-                                backend=self.instance_name), \
+                                backend=self.instance_name,
+                                served=self.serving is not None), \
                         self.perf.time("encode_time"):
-                    encoded = ecutil.encode(self.sinfo, self.ec_impl, logical)
+                    encoded = self._serving_encode(logical)
             self.perf.inc("stripe_bytes_encoded", int(logical.nbytes))
             if op.tracked:
                 op.tracked.mark_event("encoded")
@@ -636,7 +659,7 @@ class ECBackend(PGBackend):
                 with trace_span("ec.decode", oid=oid, kind="rmw_read",
                                 backend=self.instance_name), \
                         self.perf.time("decode_time"):
-                    data = ecutil.decode(self.sinfo, self.ec_impl, by_chunk)
+                    data = self._serving_decode(by_chunk)
                 op.remote_reads.setdefault(oid, {})[logical_off] = data
 
     def _complete_read_op(self, rop: ReadOp) -> None:
@@ -656,7 +679,7 @@ class ECBackend(PGBackend):
             with trace_span("ec.decode", oid=oid, kind="client_read",
                             backend=self.instance_name), \
                     self.perf.time("decode_time"):
-                logical = ecutil.decode(self.sinfo, self.ec_impl, chosen)
+                logical = self._serving_decode(chosen)
             c_off, _ = rop.shard_extents[oid]
             base = self.sinfo.aligned_chunk_offset_to_logical_offset(c_off)
             obj_size = self.object_size(oid)

@@ -256,7 +256,10 @@ def encode_many(sinfo: StripeInfo, ec_impl,
     dispatch covers the lot; results split back per buffer.
 
     Returns one ``{chunk: bytes}`` dict per input buffer, identical to
-    calling :func:`encode` per buffer."""
+    calling :func:`encode` per buffer.  An empty batch is a no-op (the
+    coalescer's drain can race a flush to zero ops)."""
+    if not bufs:
+        return []
     k = ec_impl.get_data_chunk_count()
     n = ec_impl.get_chunk_count()
     arrs = []
@@ -306,6 +309,60 @@ def decode(sinfo: StripeInfo, ec_impl,
         np.frombuffer(decoded, dtype=np.uint8).reshape(k, shard_len),
         sinfo.chunk_size)
     return logical.tobytes()
+
+
+def decode_many(sinfo: StripeInfo, ec_impl,
+                batches: list[dict[int, np.ndarray]],
+                pad_chunks=None, chunk_size: int | None = None
+                ) -> list[bytes]:
+    """Decode MANY ops' shard chunk-dicts with ONE ``decode_concat`` per
+    distinct available-chunk signature — the decode-side sibling of
+    :func:`encode_many`.  Ops sharing a survivor set share a decode
+    matrix, so their shard streams concatenate along the byte axis into
+    one device dispatch; results split back per op, bit-identical to
+    calling :func:`decode` per dict.
+
+    ``pad_chunks(stripes) -> padded_stripes`` optionally rounds each
+    group's total stripe count up (size bucketing: zero chunks decode to
+    zero bytes — linear code — and the pad slices off exactly), keeping
+    the jitted device path's shape set bounded."""
+    if not batches:
+        return []
+    results: list[bytes | None] = [None] * len(batches)
+    by_sig: dict[frozenset, list[int]] = {}
+    for i, chunks in enumerate(batches):
+        by_sig.setdefault(frozenset(chunks), []).append(i)
+    k = ec_impl.get_data_chunk_count()
+    for sig, idxs in by_sig.items():
+        streams: dict[int, list[np.ndarray]] = {c: [] for c in sig}
+        lens: list[int] = []
+        for i in idxs:
+            chunks = {c: _as_u8(v) for c, v in batches[i].items()}
+            sizes = {len(v) for v in chunks.values()}
+            assert len(sizes) == 1, "uneven shard buffers"
+            lens.append(sizes.pop())
+            for c in sig:
+                streams[c].append(chunks[c])
+        total = sum(lens)
+        quantum = chunk_size if chunk_size else sinfo.chunk_size
+        if pad_chunks is not None and total % quantum == 0:
+            padded = pad_chunks(total // quantum) * quantum
+            if padded > total:
+                pad = np.zeros(padded - total, dtype=np.uint8)
+                for c in sig:
+                    streams[c].append(pad)
+        concat = {c: (np.concatenate(v) if len(v) > 1 else v[0])
+                  for c, v in streams.items()}
+        decoded = np.frombuffer(
+            ec_impl.decode_concat(concat), dtype=np.uint8).reshape(k, -1)
+        off = 0
+        for i, ln in zip(idxs, lens):
+            logical = _from_shard_major(
+                np.ascontiguousarray(decoded[:, off:off + ln]),
+                sinfo.chunk_size)
+            results[i] = logical.tobytes()
+            off += ln
+    return results
 
 
 def decode_shards(sinfo: StripeInfo, ec_impl, available: dict[int, np.ndarray],

@@ -185,11 +185,40 @@ class MiniCluster:
         # collections (OSD.cc:3971 load_pgs iterates one store)
         from .osd.osd_daemon import OSDDaemon
         self.osds = {}
+        # osd_queue_throttle_ops > 0 bounds every daemon's op queue: past
+        # it, ms_dispatch answers ('throttled', epoch) instead of queueing
+        qcap = self.cct.conf.get("osd_queue_throttle_ops")
         for o in range(n_osds):
             st = self._osd_store(o)
-            d = OSDDaemon(o, meta_store=st)
+            throttle = None
+            if qcap:
+                from .exec import Throttle
+                throttle = Throttle(f"osd.{o}.q", qcap, cct=self.cct)
+            d = OSDDaemon(o, meta_store=st, op_throttle=throttle)
             d.store = st
             self.osds[o] = d
+        # optional serving engine (enable_serving): cross-PG encode/decode
+        # coalescing + admission throttles for every EC backend
+        self.serving = None
+
+    def enable_serving(self, start: bool = False, **kw):
+        """Attach a :class:`~ceph_tpu.exec.ServingEngine` to every EC
+        backend (current and future pools): their encode/decode
+        dispatches then flow through throttled admission and the op
+        coalescer.  ``start=True`` runs it threaded (deadline batching
+        across concurrent submitters); the default single-thread mode
+        keeps the cluster deterministic — ops coalesce when submitted in
+        bursts and flush inline otherwise."""
+        from .exec import ServingEngine
+        kw.setdefault("name", f"serving.c{self.cluster_id}")
+        self.serving = ServingEngine(cct=self.cct, **kw)
+        if start:
+            self.serving.start()
+        for pool in self.pools.values():
+            if pool["ec"] is not None:
+                for g in pool["pgs"].values():
+                    g.backend.attach_serving(self.serving)
+        return self.serving
 
     # -- pool creation (the mon's osd pool create path) --------------------
 
@@ -269,6 +298,8 @@ class MiniCluster:
                               bus=self.bus)
             self.osds[acting[0]].register_pg(pgid, pgs[ps])
             self._arm_hit_sets(pgs[ps], pool)
+            if self.serving is not None and ec is not None:
+                pgs[ps].backend.attach_serving(self.serving)
         self.pools[pool.pool_id] = {"pool": pool, "pgs": pgs, "ec": ec}
         self.pool_ids[name] = pool.pool_id
         self._save_meta()
@@ -463,7 +494,7 @@ class MiniCluster:
                 self.osdmap.epoch, _snap_done, drain=deliver)
             sync_phase[0] = False
             if res is not None:
-                raise IOError(f"put of {oid} bounced as stale: {res}")
+                raise IOError(f"put of {oid} rejected ({res[0]}): {res}")
             if failed:
                 err = IOError(f"put of {oid} failed: result {failed[0]}")
                 err.errno = failed[0]
@@ -568,10 +599,20 @@ class MiniCluster:
                 self.objects.get(pool_id, set()).discard(oid)
             if on_done:
                 on_done(reply)
-        res = daemon.ms_dispatch(
-            g.pgid, MOSDOp(oid=oid, ops=ops, epoch=epoch, snapid=snapid,
-                           snapc=self._snap_context(pool_id),
-                           internal=internal), _done)
+        m = MOSDOp(oid=oid, ops=ops, epoch=epoch, snapid=snapid,
+                   snapc=self._snap_context(pool_id), internal=internal)
+        res = daemon.ms_dispatch(g.pgid, m, _done)
+        if res is not None and res[0] == "throttled" and not primary_dead:
+            # bounded daemon queue hit (osd_queue_throttle_ops): the
+            # cooperative analog of client backoff-and-resend is draining
+            # the queue — running the backlog releases its throttle units
+            # — then resending once.  Only a DEAD primary's parked queue
+            # can stay full past a drain.  Deliberate trade-off: with
+            # deliver=False batching, this runs the parked ops early and
+            # fragments the batch — when demand overruns the bound,
+            # bounded memory wins over maximal coalescing.
+            daemon.drain()
+            res = daemon.ms_dispatch(g.pgid, m, _done)
         if res is not None:
             return res
         if drain:
@@ -617,7 +658,7 @@ class MiniCluster:
                                        drain=deliver, snapid=snapid,
                                        internal=internal)
         if res is not None:
-            raise IOError(f"op on {oid} bounced as stale: {res}")
+            raise IOError(f"op on {oid} rejected ({res[0]}): {res}")
         if not deliver:
             return None
         if not out:
@@ -901,6 +942,8 @@ class MiniCluster:
         """Unhook every PG backend from the (possibly shared) Context so a
         discarded cluster is collectable and does not shadow later ones;
         durable stores checkpoint and close."""
+        if self.serving is not None:
+            self.serving.stop()
         for p in self.pools.values():
             for g in p["pgs"].values():
                 g.shutdown()
@@ -1008,6 +1051,8 @@ class MiniCluster:
         # BE the laundered rot, and dropping the flag would let it scrub
         # clean forever without an operator restore
         new.backend.inconsistent_objects |= damaged
+        if self.serving is not None and ec is not None:
+            new.backend.attach_serving(self.serving)
         self._arm_hit_sets(new, self.pools[pool_id]["pool"])
         self.pools[pool_id]["pgs"][ps] = new
         # re-home the PG on its (possibly new) primary's daemon

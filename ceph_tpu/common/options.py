@@ -126,6 +126,36 @@ OPTIONS: list[Option] = [
                        "codec; above (or batched via the pipeline/queue "
                        "paths), on device — BASELINE_RESULTS.json config 2 "
                        "measures the crossover"),
+    # -- serving engine (ceph_tpu/exec/): admission + dynamic batching ----
+    Option("osd_serving_throttle_bytes", TYPE_SIZE, LEVEL_ADVANCED,
+           default=64 << 20,
+           description="serving admission throttle: max payload bytes "
+                       "queued or in flight (backpressure past this)",
+           see_also=["osd_serving_throttle_ops", "osd_serving_fail_fast"]),
+    Option("osd_serving_throttle_ops", TYPE_UINT, LEVEL_ADVANCED,
+           default=1024, min=1,
+           description="serving admission throttle: max ops queued or in "
+                       "flight",
+           see_also=["osd_serving_throttle_bytes"]),
+    Option("osd_serving_fail_fast", TYPE_BOOL, LEVEL_ADVANCED,
+           default=False,
+           description="when a serving throttle is full, refuse the op "
+                       "(ThrottleFull) instead of blocking the submitter"),
+    Option("osd_batch_max_delay_ms", TYPE_FLOAT, LEVEL_ADVANCED,
+           default=2.0, min=0.0,
+           description="op coalescer deadline: max milliseconds an op "
+                       "waits for batch companions before dispatch",
+           see_also=["osd_batch_max_ops"]),
+    Option("osd_batch_max_ops", TYPE_UINT, LEVEL_ADVANCED,
+           default=64, min=1,
+           description="op coalescer: max ops fused into one device "
+                       "dispatch",
+           see_also=["osd_batch_max_delay_ms"]),
+    Option("osd_queue_throttle_ops", TYPE_UINT, LEVEL_ADVANCED,
+           default=0,
+           description="OSD daemon op-queue admission bound (0 = "
+                       "unlimited); past it ms_dispatch answers "
+                       "('throttled', epoch) and the client backs off"),
     Option("log_file", TYPE_STR, LEVEL_BASIC, default="",
            description="path to log file"),
     Option("log_max_recent", TYPE_UINT, LEVEL_ADVANCED, default=500,

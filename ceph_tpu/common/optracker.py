@@ -18,6 +18,7 @@ from __future__ import annotations
 import itertools
 import threading
 import time
+import weakref
 from collections import deque
 from dataclasses import dataclass, field
 
@@ -99,10 +100,17 @@ class OpTracker:
         self.perf = perf
         if conf is not None and "osd_op_complaint_time" in conf.schema:
             self.complaint_time = float(conf.get("osd_op_complaint_time"))
-            conf.add_observer(
-                "osd_op_complaint_time",
-                lambda _name, v, _t=self: setattr(_t, "complaint_time",
-                                                  float(v)))
+            # WEAK observer: the ConfigProxy outlives trackers (one per
+            # PG backend, many per long-lived Context) and has no
+            # removal API — a strong closure would pin every dead
+            # tracker + its op history forever
+            ref = weakref.ref(self)
+
+            def _obs(_name, v, _ref=ref):
+                t = _ref()
+                if t is not None:
+                    t.complaint_time = float(v)
+            conf.add_observer("osd_op_complaint_time", _obs)
 
     def create_request(self, description: str) -> TrackedOp:
         op = TrackedOp(self, next(self._seq), description)

@@ -1,0 +1,16 @@
+"""Serving subsystem (SURVEY north-star: heavy traffic, not just fast
+kernels): admission throttles, dmClock-ordered queues, the deadline-driven
+op coalescer that fuses concurrent submissions into single device
+dispatches, and completion futures/finishers — the reference's
+``Throttle``/``WorkQueue``/``Finisher`` trio rebuilt around
+inference-style dynamic batching."""
+from .throttle import Throttle, ThrottleFull
+from .finisher import Finisher
+from .batcher import BatchFuture, dispatch_batch, bucket_pad_stripes
+from .engine import ServingEngine, live_engines
+
+__all__ = [
+    "Throttle", "ThrottleFull", "Finisher", "BatchFuture",
+    "dispatch_batch", "bucket_pad_stripes", "ServingEngine",
+    "live_engines",
+]

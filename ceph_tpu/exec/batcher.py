@@ -1,0 +1,171 @@
+"""Batch futures + the batch-forming math for the op coalescer.
+
+The serving half of the TPU thesis: `ecutil.encode_many` can already fuse
+MANY ops into ONE device dispatch, but only when a caller hands it an
+explicit batch.  This module turns CONCURRENT single-op submissions into
+those batches:
+
+- :class:`BatchFuture` — the completion handle an async submitter gets
+  back (the role the reference's ``Context``/``C_OSD_*`` completion
+  callbacks play on ECBackend's write path), with
+  ``result()/done()/add_done_callback()`` shaped like
+  ``concurrent.futures``.
+- :func:`group_ops` — partition a dequeued batch by codec identity
+  (ops from different pools must not fuse: different k/m/chunk layout).
+- :func:`bucket_pad_stripes` — round a batch's total stripe count up to
+  the next power-of-two size bucket.  Dynamic batch totals would give
+  the jitted device path a fresh shape (→ recompile) per batch; padding
+  to geometric buckets keeps the shape set logarithmic, and RS parity is
+  positionwise-linear so zero padding encodes to zero parity — sliced
+  off exactly (the same trick inference servers use for dynamic
+  batching).
+- :func:`dispatch_batch` — run one formed batch through
+  ``ecutil.encode_many`` / ``ecutil.decode_many`` under tracer spans.
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from ..backend import ecutil
+from ..common.tracer import trace_span
+
+ENCODE = "encode"
+DECODE = "decode"
+
+
+class BatchFuture:
+    """Completion handle for one submitted op (concurrent.futures shape)."""
+
+    __slots__ = ("kind", "payload", "sinfo", "ec_impl", "op_class",
+                 "cost_bytes", "t_submit", "t_submit_wall", "t_dispatch",
+                 "t_done", "eager", "_event", "_result", "_error",
+                 "_callbacks", "_lock")
+
+    def __init__(self, kind: str, payload, sinfo, ec_impl, op_class: str,
+                 cost_bytes: int, t_submit: float, t_submit_wall: float,
+                 eager: bool = False):
+        self.kind = kind
+        self.payload = payload
+        self.sinfo = sinfo
+        self.ec_impl = ec_impl
+        self.op_class = op_class
+        self.cost_bytes = cost_bytes
+        self.t_submit = t_submit
+        self.t_submit_wall = t_submit_wall
+        self.t_dispatch = 0.0
+        self.t_done = 0.0
+        # eager: a submitter is BLOCKED on this op (sync encode()/
+        # decode()); the coalescer dispatches what has arrived instead
+        # of waiting out the deadline for hypothetical companions
+        self.eager = eager
+        self._event = threading.Event()
+        self._result = None
+        self._error: BaseException | None = None
+        self._callbacks: list = []
+        self._lock = threading.Lock()
+
+    # -- consumer side -------------------------------------------------------
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: float | None = None):
+        if not self._event.wait(timeout):
+            raise TimeoutError(
+                f"serving op not complete within {timeout}s")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+    def exception(self, timeout: float | None = None):
+        if not self._event.wait(timeout):
+            raise TimeoutError(
+                f"serving op not complete within {timeout}s")
+        return self._error
+
+    def add_done_callback(self, fn) -> None:
+        """``fn(future)`` on completion; runs immediately when already
+        done (concurrent.futures semantics), else on the finisher."""
+        with self._lock:
+            if not self._event.is_set():
+                self._callbacks.append(fn)
+                return
+        fn(self)
+
+    # -- engine side ---------------------------------------------------------
+
+    def _finish(self, result=None, error: BaseException | None = None):
+        with self._lock:
+            self._result = result
+            self._error = error
+            self._event.set()
+            callbacks, self._callbacks = self._callbacks, []
+        for fn in callbacks:
+            fn(self)
+
+
+def group_ops(ops: list[BatchFuture]) -> list[list[BatchFuture]]:
+    """Partition by (codec, stripe geometry, kind) — only ops sharing the
+    codec can share a device dispatch; decode ops additionally need the
+    same available-chunk set to share a decode matrix, which
+    ``ecutil.decode_many`` subdivides itself."""
+    groups: dict[tuple, list[BatchFuture]] = {}
+    for op in ops:
+        key = (id(op.ec_impl), op.sinfo.k, op.sinfo.chunk_size, op.kind)
+        groups.setdefault(key, []).append(op)
+    return list(groups.values())
+
+
+def bucket_pad_stripes(total_stripes: int) -> int:
+    """Next power-of-two stripe count >= total (the size bucket)."""
+    if total_stripes <= 1:
+        return 1
+    return 1 << (total_stripes - 1).bit_length()
+
+
+def _encode_group(group: list[BatchFuture], pad_to_bucket: bool) -> None:
+    sinfo, ec = group[0].sinfo, group[0].ec_impl
+    bufs = [op.payload for op in group]
+    total = sum(len(b) for b in bufs) // sinfo.stripe_width
+    padded = bucket_pad_stripes(total) if pad_to_bucket else total
+    if padded > total:
+        bufs = bufs + [np.zeros((padded - total) * sinfo.stripe_width,
+                                dtype=np.uint8)]
+    with trace_span("serving.batch_encode", ops=len(group),
+                    stripes=total, padded_stripes=padded):
+        encoded = ecutil.encode_many(sinfo, ec, bufs)
+    for op, chunks in zip(group, encoded):
+        op._result = chunks
+
+
+def _decode_group(group: list[BatchFuture], pad_to_bucket: bool) -> None:
+    sinfo, ec = group[0].sinfo, group[0].ec_impl
+    with trace_span("serving.batch_decode", ops=len(group)):
+        decoded = ecutil.decode_many(
+            sinfo, ec, [op.payload for op in group],
+            pad_chunks=bucket_pad_stripes if pad_to_bucket else None,
+            chunk_size=sinfo.chunk_size)
+    for op, data in zip(group, decoded):
+        op._result = data
+
+
+def dispatch_batch(ops: list[BatchFuture],
+                   pad_to_bucket: bool = True) -> None:
+    """Run one formed batch: fused per codec group; results (or a shared
+    error) land on each future's ``_result``/``_error`` — the ENGINE
+    completes them (throttle release + finisher callbacks stay with the
+    component that owns those resources)."""
+    for group in group_ops(ops):
+        try:
+            if group[0].kind == ENCODE:
+                _encode_group(group, pad_to_bucket)
+            else:
+                _decode_group(group, pad_to_bucket)
+        except BaseException as e:             # noqa: BLE001 — one bad op
+            # (unaligned buffer, codec error) fails its GROUP, never the
+            # coalescer thread; per-op granularity would re-dispatch the
+            # good ops but a group shares one device call — fail together
+            for op in group:
+                op._error = e

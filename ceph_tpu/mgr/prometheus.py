@@ -55,6 +55,42 @@ def _histogram_series(fam: _MetricFamily, label: str, bounds, counts,
     fam.lines.append(f'{fam.name}_count{{{label}}} {total}')
 
 
+def _mclock_depth_gauges(family, prefix: str) -> None:
+    """Queue depths of every live mClock queue — the OSD daemons' sharded
+    op queues and the serving engines' admission queues — as one gauge
+    family (`ceph_tpu_mclock_queue_depth`), labelled by owner.  Lazy
+    imports keep the exporter loadable in partial environments."""
+    metric = f"{prefix}_mclock_queue_depth"
+    fam = None
+    try:
+        from ..osd.osd_daemon import live_daemons
+    except Exception:                       # pragma: no cover
+        live_daemons = list
+    try:
+        from ..exec.engine import live_engines
+    except Exception:                       # pragma: no cover
+        live_engines = list
+    for d in sorted(live_daemons(), key=lambda d: d.whoami):
+        for shard, depths in sorted(d.queue_depths().items()):
+            for op_class, depth in sorted(depths.items()):
+                if fam is None:
+                    fam = family(metric, "gauge",
+                                 "queued items per mClock class")
+                fam.lines.append(
+                    f'{metric}{{owner="osd.{d.whoami}",shard="{shard}",'
+                    f'op_class="{_sanitize(op_class)}"}} {depth}')
+    for e in sorted(live_engines(), key=lambda e: e.name):
+        for op_class, depth in sorted(e.depths().items()):
+            if op_class.startswith("_"):
+                continue                    # the _total/_bytes extras
+            if fam is None:
+                fam = family(metric, "gauge",
+                             "queued items per mClock class")
+            fam.lines.append(
+                f'{metric}{{owner="serving.{_sanitize(e.name)}",'
+                f'shard="0",op_class="{_sanitize(op_class)}"}} {depth}')
+
+
 def render(cct=None, prefix: str = "ceph_tpu") -> str:
     """The /metrics payload: every registered collection's metrics plus
     the tracer's span-latency histograms."""
@@ -82,6 +118,8 @@ def render(cct=None, prefix: str = "ceph_tpu") -> str:
             else:
                 fam = family(metric, "counter", m.description)
                 fam.lines.append(f"{metric}{{{label}}} {m.value}")
+
+    _mclock_depth_gauges(family, prefix)
 
     span_metric = f"{prefix}_span_latency_seconds"
     hists = default_tracer().histograms()

@@ -142,6 +142,16 @@ class MClockQueue:
         self.served_weight += 1
         return req.item
 
+    def depths(self) -> dict:
+        """Queue depth per client/class (+ strict-priority backlog) — the
+        gauge surface the prometheus exporter renders as
+        ``ceph_tpu_mclock_queue_depth``."""
+        d = {str(client): len(rec.queue)
+             for client, rec in self.clients.items() if rec.queue}
+        if self._strict:
+            d["strict"] = len(self._strict)
+        return d
+
     def next_eligible_time(self, now: float) -> float | None:
         """Earliest future time anything becomes servable (for clock
         advancement in tests/ticks)."""

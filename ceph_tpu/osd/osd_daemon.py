@@ -27,6 +27,7 @@ PG stay FIFO within their class), not parallelism.
 """
 from __future__ import annotations
 
+import weakref
 from dataclasses import dataclass, field
 from typing import Callable
 
@@ -35,19 +36,28 @@ from .mclock import (
 )
 from .osd_ops import MOSDOp, MOSDOpReply
 
+# live daemons, for the prometheus mclock-depth gauge export
+_DAEMONS: "weakref.WeakSet[OSDDaemon]" = weakref.WeakSet()
+
+
+def live_daemons() -> list["OSDDaemon"]:
+    return list(_DAEMONS)
+
 
 @dataclass
 class _QueuedOp:
     pgid: object
     run: Callable[[], None]
     cost: float = 1.0
+    t_enqueue: float = 0.0          # daemon-clock stamp for queue-wait
+    throttled: int = 0              # op-throttle units to release on run
 
 
 class OSDDaemon:
     """One OSD's daemon shell hosting the PGs whose primary it is."""
 
     def __init__(self, whoami: int, num_shards: int = 2, clock=None,
-                 meta_store=None):
+                 meta_store=None, op_throttle=None):
         self.whoami = whoami
         self.num_shards = max(1, num_shards)
         self.clock = clock          # VirtualClock or None (monotonic int)
@@ -57,6 +67,16 @@ class OSDDaemon:
         self.meta_store = meta_store    # FileStore/MemStore for superblock
         self.shards = [MClockOpClassQueue() for _ in range(self.num_shards)]
         self.booted = False
+        # optional admission throttle (exec.Throttle over op count): past
+        # the bound, ms_dispatch answers ('throttled', epoch) and the
+        # client backs off — the daemon-queue face of the same
+        # backpressure the serving engine applies at the codec
+        self.op_throttle = op_throttle
+        # queue accounting for the exporter: enqueued/dequeued totals and
+        # summed queue wait (daemon-clock seconds)
+        self.queue_stats = {"enqueued": 0, "dequeued": 0,
+                            "throttled_rejects": 0, "wait_sum": 0.0}
+        _DAEMONS.add(self)
 
     # -- superblock (OSDSuperblock; src/osd/OSD.cc read_superblock) --------
 
@@ -133,12 +153,23 @@ class OSDDaemon:
             return ("stale", self.epoch)
         if m.epoch < g.epoch:
             return ("stale", self.epoch)
+        if self.op_throttle is not None and \
+                not self.op_throttle.get_or_fail(1):
+            # bounded daemon queue: refuse instead of growing (the
+            # reference's messenger policy throttles the same way; the
+            # client treats it like a transient and resends with backoff)
+            self.queue_stats["throttled_rejects"] += 1
+            return ("throttled", self.epoch)
         cost = 1.0 + sum(len(op.params.get("data", b""))
                          for op in m.ops) / 65536.0
+        now = self._now()
+        self.queue_stats["enqueued"] += 1
         self._shard_for(pgid).enqueue(
             op_class,
-            _QueuedOp(pgid, lambda: g.engine.do_op(m, on_reply), cost),
-            self._now(), cost=cost)
+            _QueuedOp(pgid, lambda: g.engine.do_op(m, on_reply), cost,
+                      t_enqueue=now,
+                      throttled=1 if self.op_throttle is not None else 0),
+            now, cost=cost)
         return None
 
     def queue_background(self, pgid, fn: Callable[[], None],
@@ -147,8 +178,26 @@ class OSDDaemon:
         """Recovery/scrub work rides the same queue under its own QoS
         class (the reference queues PGRecovery/PGScrub items alongside
         client ops, src/osd/OSD.cc:9700+)."""
+        now = self._now()
+        self.queue_stats["enqueued"] += 1
         self._shard_for(pgid).enqueue(
-            op_class, _QueuedOp(pgid, fn, cost), self._now(), cost=cost)
+            op_class, _QueuedOp(pgid, fn, cost, t_enqueue=now), now,
+            cost=cost)
+
+    def queue_depths(self) -> dict:
+        """Per-shard mClock depths (the prometheus gauge surface)."""
+        return {i: s.depths() for i, s in enumerate(self.shards)
+                if not s.empty()}
+
+    def _run_item(self, item: _QueuedOp) -> None:
+        self.queue_stats["dequeued"] += 1
+        self.queue_stats["wait_sum"] += max(
+            0.0, self._now() - item.t_enqueue)
+        try:
+            item.run()
+        finally:
+            if item.throttled and self.op_throttle is not None:
+                self.op_throttle.put(item.throttled)
 
     # -- dispatch loop (dequeue_op) ----------------------------------------
 
@@ -177,7 +226,7 @@ class OSDDaemon:
                     item = shard.dequeue(self._now())
                     if item is None:
                         continue
-                item.run()
+                self._run_item(item)
                 ran += 1
                 progressed = True
                 if max_ops is not None and ran >= max_ops:

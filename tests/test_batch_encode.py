@@ -59,6 +59,103 @@ class TestEncodeMany:
                            [payload(STRIPE * 2, seed=i) for i in range(16)])
         assert calls["n"] == 1, "encode_many did not coalesce"
 
+    # -- edge cases (the serving coalescer leans on every one of these) --
+
+    def test_empty_batch_is_a_noop(self):
+        """A drained-to-zero batch (flush racing the coalescer) must not
+        touch the device at all."""
+        ec = ErasureCodePluginRegistry.instance().factory(
+            "jax_rs", "", dict(PROFILE))
+        sinfo = StripeInfo(4, CHUNK)
+        calls, _ = counting(ec)
+        assert ecutil.encode_many(sinfo, ec, []) == []
+        assert calls["n"] == 0
+
+    def test_single_op_batch_matches_encode(self):
+        ec = ErasureCodePluginRegistry.instance().factory(
+            "jax_rs", "", dict(PROFILE))
+        sinfo = StripeInfo(4, CHUNK)
+        buf = payload(STRIPE * 3, seed=11)
+        [got] = ecutil.encode_many(sinfo, ec, [buf])
+        want = ecutil.encode(sinfo, ec, buf)
+        for c in want:
+            assert np.array_equal(got[c], want[c]), f"chunk {c}"
+
+    def test_mixed_stripe_counts_split_back_exactly(self):
+        """Buffers of 1/2/5/16 stripes in ONE call: each op's chunk
+        slices must carry exactly its own stripes (the split-offset
+        bookkeeping is the coalescer's correctness backbone)."""
+        ec = ErasureCodePluginRegistry.instance().factory(
+            "jax_rs", "", dict(PROFILE))
+        sinfo = StripeInfo(4, CHUNK)
+        bufs = [payload(STRIPE * s, seed=s) for s in (1, 2, 5, 16)]
+        batched = ecutil.encode_many(sinfo, ec, bufs)
+        for buf, got, stripes in zip(bufs, batched, (1, 2, 5, 16)):
+            want = ecutil.encode(sinfo, ec, buf)
+            for c in want:
+                assert len(got[c]) == stripes * CHUNK
+                assert np.array_equal(got[c], want[c]), f"chunk {c}"
+
+    def test_non_stripe_aligned_tail_rejected(self):
+        """encode_many's contract is stripe-aligned buffers: a ragged
+        tail must fail loudly here — padding is the SUBMITTER's job (the
+        serving engine pads to stripe width before admission)."""
+        ec = ErasureCodePluginRegistry.instance().factory(
+            "jax_rs", "", dict(PROFILE))
+        sinfo = StripeInfo(4, CHUNK)
+        with pytest.raises(AssertionError, match="stripe aligned"):
+            ecutil.encode_many(sinfo, ec, [payload(STRIPE + 100, seed=2)])
+
+    def test_non_chunk_aligned_tail_padded_by_engine(self):
+        """The serving path accepts the ragged tail and zero-pads it to
+        the stripe boundary — byte-identical to encoding the padded
+        buffer directly."""
+        from ceph_tpu.exec import ServingEngine
+        ec = ErasureCodePluginRegistry.instance().factory(
+            "jax_rs", "", dict(PROFILE))
+        sinfo = StripeInfo(4, CHUNK)
+        eng = ServingEngine(ec_impl=ec, sinfo=sinfo, name="edge.pad")
+        ragged = payload(STRIPE + CHUNK // 2, seed=3)   # half-chunk tail
+        fut = eng.submit_encode(ragged)
+        eng.flush()
+        got = fut.result(1)
+        want = ecutil.encode(
+            sinfo, ec, ragged + b"\0" * (STRIPE - CHUNK // 2))
+        for c in want:
+            assert np.array_equal(got[c], want[c]), f"chunk {c}"
+
+
+class TestDecodeMany:
+    def test_decode_many_matches_per_op_decode(self):
+        ec = ErasureCodePluginRegistry.instance().factory(
+            "jax_rs", "", dict(PROFILE))
+        sinfo = StripeInfo(4, CHUNK)
+        bufs = [payload(STRIPE * s, seed=s) for s in (1, 3, 2)]
+        encoded = [ecutil.encode(sinfo, ec, b) for b in bufs]
+        # two survivor signatures -> two decode dispatches, three ops
+        picks = [(0, 1, 4, 5), (0, 1, 4, 5), (1, 2, 3, 4)]
+        got = ecutil.decode_many(
+            sinfo, ec, [{c: e[c] for c in p}
+                        for e, p in zip(encoded, picks)])
+        assert got == bufs
+
+    def test_decode_many_empty(self):
+        ec = ErasureCodePluginRegistry.instance().factory(
+            "jax_rs", "", dict(PROFILE))
+        assert ecutil.decode_many(StripeInfo(4, CHUNK), ec, []) == []
+
+    def test_decode_many_pad_buckets_exact(self):
+        """Zero padding to a size bucket must slice off bit-exactly."""
+        ec = ErasureCodePluginRegistry.instance().factory(
+            "jax_rs", "", dict(PROFILE))
+        sinfo = StripeInfo(4, CHUNK)
+        bufs = [payload(STRIPE * s, seed=40 + s) for s in (1, 2)]  # 3 total
+        encoded = [ecutil.encode(sinfo, ec, b) for b in bufs]
+        got = ecutil.decode_many(
+            sinfo, ec, [{c: e[c] for c in (0, 1, 2, 3)} for e in encoded],
+            pad_chunks=lambda n: 1 << (n - 1).bit_length())   # 3 -> 4
+        assert got == bufs
+
 
 class TestPutMany:
     def test_put_many_one_dispatch_across_pgs(self):

@@ -263,3 +263,23 @@ class TestTraceReportTool:
         assert mod.main([str(f)]) == 0
         out = capsys.readouterr().out
         assert "outer" in out and "inner" in out and "self ms" in out
+        assert "p50 ms" in out and "p99 ms" in out
+
+    def test_percentile_columns(self, tmp_path):
+        """p50/p99 over each span name's per-occurrence durations (the
+        serving-latency view): 100 spans of 1..100us -> p50=50, p99=99."""
+        mod = self._tool()
+        events = [{"name": "op", "ph": "X", "ts": float(i * 1000),
+                   "dur": float(i + 1), "pid": 1, "tid": 1}
+                  for i in range(100)]
+        f = tmp_path / "trace.json"
+        f.write_text(json.dumps({"traceEvents": events}))
+        agg = mod.self_times(mod.load_events(str(f)))
+        assert mod.percentile_us(agg["op"]["durs_us"], 50) == 50.0
+        assert mod.percentile_us(agg["op"]["durs_us"], 99) == 99.0
+        assert mod.percentile_us(agg["op"]["durs_us"], 100) == 100.0
+        assert mod.percentile_us([], 50) == 0.0
+        table = mod.render_table(agg)
+        header, row = table.splitlines()[:2]
+        assert "p50 ms" in header and "p99 ms" in header
+        assert "0.050" in row and "0.099" in row

@@ -162,6 +162,34 @@ class TestPrometheus:
         assert 'ceph_tpu_op_lat_sum{collection="histo_test"} 255.0' in lines
         assert 'ceph_tpu_op_lat_count{collection="histo_test"} 2' in lines
 
+    def test_mclock_queue_depth_gauges_rendered(self):
+        """OSD daemon mClock queue depths export as ONE gauge family
+        (`ceph_tpu_mclock_queue_depth`, owner/shard/op_class labels) with
+        the same HELP-once/TYPE-once invariants as every other family —
+        scraped mid-queue, before a drain empties the gauges."""
+        from ceph_tpu.common import Context
+        from ceph_tpu.mgr.prometheus import render
+        from ceph_tpu.osd.mclock import BG_SCRUB
+        from ceph_tpu.osd.osd_daemon import OSDDaemon
+        d = OSDDaemon(whoami=77, num_shards=1)
+        for i in range(3):
+            d.queue_background("pg", lambda: None, op_class=BG_SCRUB)
+        try:
+            text = render(Context())
+            lines = text.splitlines()
+            assert lines.count(
+                "# TYPE ceph_tpu_mclock_queue_depth gauge") == 1
+            assert any(line.startswith(
+                "# HELP ceph_tpu_mclock_queue_depth ") for line in lines)
+            assert 'ceph_tpu_mclock_queue_depth{owner="osd.77",' \
+                   'shard="0",op_class="bg_scrub"} 3' in lines
+            # HELP/TYPE stay unique across the whole payload
+            types = [line.split(" ", 2)[2].split(" ", 1)[0]
+                     for line in lines if line.startswith("# TYPE ")]
+            assert len(types) == len(set(types)), "duplicate TYPE lines"
+        finally:
+            d.drain()                     # leave no cross-test gauges
+
     def test_span_latency_histograms_rendered(self):
         """The tracer's per-span-name latency distributions surface as
         prometheus histograms with the full _bucket/_sum/_count set."""

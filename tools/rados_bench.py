@@ -1,0 +1,157 @@
+#!/usr/bin/env python3
+"""rados_bench: open/closed-loop workload generator for the serving engine.
+
+The serving-side sibling of ``rados bench`` (the cluster-level
+write/seq bench lives at ``python -m ceph_tpu.bench.rados_bench``): this
+tool drives CONCURRENT encode ops through ``ceph_tpu.exec.ServingEngine``
+and reports throughput plus p50/p95/p99 latency — the numbers that decide
+whether the op coalescer is earning its deadline.
+
+    # closed loop, 64 clients, compare coalesced vs op-at-a-time:
+    python tools/rados_bench.py --compare --concurrency 64 --ops 512
+
+    # closed loop against one engine configuration:
+    python tools/rados_bench.py --concurrency 64 --ops 1024 \
+        --batch-max-ops 64 --op-size 16K --device jax
+
+    # open loop at a fixed arrival rate (tail latency without
+    # coordinated omission):
+    python tools/rados_bench.py --mode open --rate 2000 --seconds 5
+
+    # machine-readable:
+    python tools/rados_bench.py --compare --json
+
+``--unbatched`` pins ``batch_max_ops=1`` (every op is its own device
+dispatch) — the baseline the coalesced number is judged against, on the
+same device.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def build_codec(args):
+    from ceph_tpu.backend import StripeInfo
+    from ceph_tpu.common import parse_size
+    from ceph_tpu.plugins.registry import ErasureCodePluginRegistry
+    profile = {"plugin": args.plugin, "k": str(args.k), "m": str(args.m),
+               "technique": args.technique}
+    if args.plugin == "jax_rs":
+        profile["device"] = args.device
+    ec = ErasureCodePluginRegistry.instance().factory(
+        args.plugin, "", profile)
+    return ec, StripeInfo(args.k, parse_size(args.chunk_size))
+
+
+def human(result: dict, out) -> None:
+    w = out.write
+    if "batched" in result:
+        for label in ("unbatched", "batched"):
+            r = result[label]
+            w(f"{label:>10}: {r['ops_s']:>9.1f} ops/s  "
+              f"{r['mb_s']:>8.2f} MB/s  p50 {r['p50_ms']:.3f} ms  "
+              f"p95 {r['p95_ms']:.3f} ms  p99 {r['p99_ms']:.3f} ms  "
+              f"(mean batch {r['mean_batch_size']})\n")
+        w(f"{'speedup':>10}: {result['speedup']}x coalesced vs "
+          f"op-at-a-time\n")
+        return
+    w(f"Mode:               {result['mode']}\n")
+    w(f"Ops completed:      {result['ops']}\n")
+    if "rejected" in result:
+        w(f"Ops rejected:       {result['rejected']}\n")
+    w(f"Op size:            {result['op_bytes']}\n")
+    w(f"Total time (s):     {result['elapsed_s']}\n")
+    w(f"Throughput (ops/s): {result['ops_s']}\n")
+    w(f"Bandwidth (MB/s):   {result['mb_s']}\n")
+    w(f"Latency p50 (ms):   {result['p50_ms']}\n")
+    w(f"Latency p95 (ms):   {result['p95_ms']}\n")
+    w(f"Latency p99 (ms):   {result['p99_ms']}\n")
+    w(f"Mean batch size:    {result['mean_batch_size']}\n")
+
+
+def main(argv=None) -> int:
+    from ceph_tpu.utils.platform import honour_jax_platforms_env
+    honour_jax_platforms_env()
+    ap = argparse.ArgumentParser(
+        prog="rados_bench", description=__doc__.splitlines()[0])
+    ap.add_argument("--mode", choices=["closed", "open"], default="closed")
+    ap.add_argument("--ops", type=int, default=512,
+                    help="closed loop: total ops to complete")
+    ap.add_argument("--concurrency", type=int, default=64,
+                    help="closed loop: logical clients in flight")
+    ap.add_argument("--rate", type=float, default=1000.0,
+                    help="open loop: offered arrival rate (ops/s)")
+    ap.add_argument("--seconds", type=float, default=5.0,
+                    help="open loop: arrival window")
+    ap.add_argument("--op-size", default="4K")
+    ap.add_argument("--chunk-size", default="1K")
+    ap.add_argument("--k", type=int, default=4)
+    ap.add_argument("--m", type=int, default=2)
+    ap.add_argument("--plugin", default="jax_rs")
+    ap.add_argument("--device", default="jax",
+                    help="jax_rs device: jax|numpy|auto (jax measures the "
+                         "real dispatch path the coalescer amortizes)")
+    ap.add_argument("--technique", default="reed_sol_van")
+    ap.add_argument("--batch-max-ops", type=int, default=None,
+                    help="coalescer cap (default: osd_batch_max_ops)")
+    ap.add_argument("--batch-max-delay-ms", type=float, default=None)
+    ap.add_argument("--unbatched", action="store_true",
+                    help="op-at-a-time baseline (batch_max_ops=1)")
+    ap.add_argument("--compare", action="store_true",
+                    help="run batched AND unbatched, report the speedup")
+    ap.add_argument("--warmup", type=int, default=64,
+                    help="warmup ops per engine (compiles size buckets)")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    args = ap.parse_args(argv)
+
+    from ceph_tpu.common import parse_size
+    from ceph_tpu.exec import ServingEngine
+    from ceph_tpu.exec.workload import (closed_loop,
+                                        compare_batched_unbatched,
+                                        make_payloads, open_loop)
+    ec, sinfo = build_codec(args)
+    op_bytes = parse_size(args.op_size)
+    print(f"# k={args.k} m={args.m} chunk={sinfo.chunk_size} "
+          f"op={op_bytes} plugin={args.plugin} device={args.device}",
+          file=sys.stderr)
+
+    if args.compare:
+        result = compare_batched_unbatched(
+            ec, sinfo, n_ops=args.ops, concurrency=args.concurrency,
+            op_bytes=op_bytes, warmup_ops=args.warmup,
+            batch_max_ops=args.batch_max_ops)
+    else:
+        engine = ServingEngine(
+            ec_impl=ec, sinfo=sinfo, name="rados_bench",
+            max_ops=max(1024, args.concurrency * 2),
+            max_bytes=max(64 << 20, args.concurrency * op_bytes * 4),
+            batch_max_ops=1 if args.unbatched else args.batch_max_ops,
+            batch_max_delay_ms=args.batch_max_delay_ms).start()
+        try:
+            payloads = make_payloads(op_bytes)
+            if args.warmup:
+                closed_loop(engine, args.warmup,
+                            min(args.concurrency, args.warmup), payloads)
+            if args.mode == "closed":
+                result = closed_loop(engine, args.ops, args.concurrency,
+                                     payloads)
+            else:
+                result = open_loop(engine, args.rate, args.seconds,
+                                   payloads)
+        finally:
+            engine.stop()
+
+    if args.as_json:
+        print(json.dumps(result))
+    else:
+        human(result, sys.stdout)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -14,12 +14,15 @@ then ``python tools/trace_report.py trace.json``.  Self time is each
 span's duration minus the duration of spans nested inside it (same
 pid/tid, contained by timestamps), i.e. where the wall clock actually
 went — the number that ranks optimization targets, which total time
-(double-counting every parent) cannot.
+(double-counting every parent) cannot.  p50/p99 columns give each span
+name's per-occurrence duration distribution — the serving-latency view
+(a `serving.op` row's p99 IS the op tail) that a mean-only table hides.
 """
 from __future__ import annotations
 
 import argparse
 import json
+import math
 import sys
 from collections import defaultdict
 
@@ -31,11 +34,27 @@ def load_events(path: str) -> list[dict]:
     return [e for e in events if e.get("ph") == "X"]
 
 
+def percentile_us(durs_us: list[float], q: float) -> float:
+    """Nearest-rank percentile (q in [0, 100]) over raw durations.
+
+    Mirrors ``ceph_tpu/exec/workload.py:percentile`` — this tool stays
+    stdlib-only/standalone on purpose; change BOTH if the rank
+    definition ever moves."""
+    if not durs_us:
+        return 0.0
+    s = sorted(durs_us)
+    rank = max(1, math.ceil(q / 100.0 * len(s)))
+    return s[min(rank, len(s)) - 1]
+
+
 def self_times(events: list[dict]) -> dict[str, dict]:
-    """name -> {count, total_us, self_us}; nesting resolved per (pid, tid)
-    with a containment stack sweep over ts-sorted complete events."""
+    """name -> {count, total_us, self_us, durs_us}; nesting resolved per
+    (pid, tid) with a containment stack sweep over ts-sorted complete
+    events.  ``durs_us`` holds every occurrence's total duration (the
+    p50/p99 source)."""
     agg: dict[str, dict] = defaultdict(
-        lambda: {"count": 0, "total_us": 0.0, "self_us": 0.0})
+        lambda: {"count": 0, "total_us": 0.0, "self_us": 0.0,
+                 "durs_us": []})
     by_track: dict[tuple, list[dict]] = defaultdict(list)
     for ev in events:
         by_track[(ev.get("pid"), ev.get("tid"))].append(ev)
@@ -56,6 +75,7 @@ def self_times(events: list[dict]) -> dict[str, dict]:
             a["count"] += 1
             a["total_us"] += dur
             a["self_us"] += dur
+            a["durs_us"].append(dur)
             stack.append(ev)
     return dict(agg)
 
@@ -67,13 +87,16 @@ def render_table(agg: dict[str, dict], limit: int = 0) -> str:
         rows = rows[:limit]
     width = max([len("span")] + [len(name) for name, _ in rows])
     lines = [f"{'span':<{width}}  {'count':>7}  {'total ms':>10}  "
-             f"{'self ms':>10}  {'avg ms':>9}"]
+             f"{'self ms':>10}  {'avg ms':>9}  {'p50 ms':>9}  "
+             f"{'p99 ms':>9}"]
     for name, a in rows:
         avg = a["total_us"] / a["count"] / 1e3 if a["count"] else 0.0
+        durs = a.get("durs_us", [])
         lines.append(
             f"{name:<{width}}  {a['count']:>7}  "
             f"{a['total_us'] / 1e3:>10.3f}  {a['self_us'] / 1e3:>10.3f}  "
-            f"{avg:>9.3f}")
+            f"{avg:>9.3f}  {percentile_us(durs, 50) / 1e3:>9.3f}  "
+            f"{percentile_us(durs, 99) / 1e3:>9.3f}")
     return "\n".join(lines)
 
 
