@@ -42,7 +42,8 @@ from contextlib import contextmanager
 
 import numpy as np
 
-# stdlib-only import (no jax): safe before any backend probe
+# stdlib-only imports (no jax): safe before any backend probe
+from ceph_tpu.common.device_telemetry import jax_version
 from ceph_tpu.common.tracer import default_tracer
 
 HBM_BYTES_PER_S = 819e9          # TPU v5e HBM bandwidth (public spec)
@@ -59,6 +60,15 @@ WATCHDOG_S = int(os.environ.get("BENCH_WATCHDOG_S", 1200))
 
 
 _chain_cache: dict = {}
+
+# Hardware attribution for EVERY emitted line (including watchdog and
+# fallback paths): jax version is readable without importing jax; the
+# platform/device fields fill in from whatever the subprocess probe saw.
+# Without this block the BENCH trajectory is unattributable — a regression
+# could be a slower kernel or a different device and the artifact alone
+# could not tell.
+_DEVICE_INFO: dict = {"platform": None, "device_kind": None,
+                      "num_devices": 0, "jax_version": jax_version()}
 
 # -- per-phase accounting -----------------------------------------------------
 # Every phase lands in the bench JSON (`phases`: name -> seconds) AND on the
@@ -171,11 +181,26 @@ def probe_backend() -> str | None:
         try:
             r = subprocess.run(
                 [sys.executable, "-c",
-                 "import jax; print(jax.devices()[0].platform)"],
+                 "import jax, json; ds = jax.devices(); "
+                 "print(json.dumps({'platform': ds[0].platform, "
+                 "'device_kind': getattr(ds[0], 'device_kind', None), "
+                 "'num_devices': len(ds)}))"],
                 capture_output=True, text=True,
                 timeout=PROBE_ATTEMPT_TIMEOUT_S)
             if r.returncode == 0 and r.stdout.strip():
-                platform = r.stdout.strip().splitlines()[-1]
+                last = r.stdout.strip().splitlines()[-1]
+                try:
+                    info = json.loads(last)
+                    # validate BEFORE mutating: a stray final stdout line
+                    # like 'null' parses as non-dict JSON, and a partial
+                    # update would leave _DEVICE_INFO half-written
+                    if not isinstance(info, dict) or "platform" not in info:
+                        raise ValueError(last)
+                    _DEVICE_INFO.update(info)
+                    platform = info["platform"]
+                except ValueError:                 # plain-string fallback
+                    platform = last
+                    _DEVICE_INFO["platform"] = platform
                 reason = None
             else:
                 reason = (r.stderr or "").strip().splitlines()[-1:] \
@@ -289,6 +314,10 @@ def emit(value, vs_baseline, extra):
         "value": round(value, 1),
         "unit": "MiB/s",
         "vs_baseline": round(vs_baseline, 3),
+        # hardware attribution (common/device_telemetry): platform +
+        # device kind/count from the subprocess probe, jax version from
+        # package metadata — present on every path, watchdog included
+        "device_info": dict(_DEVICE_INFO),
     }
     line.update(extra)
     if _SERVING is not None:

@@ -564,6 +564,9 @@ class PGBackend:
                              "write_bytes: RMW pads to whole stripes)")
             .add_u64_counter("read_bytes", "logical bytes returned")
             .add_u64_counter("recoveries", "recovery ops completed")
+            .add_u64_counter("recovery_bytes",
+                             "chunk bytes pushed to recovery targets "
+                             "(the mgr digest's recovery B/s source)")
             .add_u64_counter("recovery_failures", "recovery ops failed")
             .add_u64_counter("log_repairs_clean",
                              "shard repairs satisfied by log equality alone")
@@ -1070,6 +1073,7 @@ class PGBackend:
                 continue
             data, attrs, omap, header = payloads[chunk]
             rop.pending_pushes.add(shard)
+            self.perf.inc("recovery_bytes", len(data))
             self.bus.send(shard, PushOp(self.whoami, rop.oid, data,
                                         attrs=attrs, omap=omap,
                                         omap_header=header))

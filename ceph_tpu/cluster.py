@@ -200,6 +200,129 @@ class MiniCluster:
         # optional serving engine (enable_serving): cross-PG encode/decode
         # coalescing + admission throttles for every EC backend
         self.serving = None
+        # telemetry spine (mgr/stats + mgr/health + flight recorder):
+        # status() renders the stats digest, health() is a thin view over
+        # the check engine, and any check entering WARN/ERR snapshots a
+        # flight bundle (to data_dir/flight in durable mode)
+        self._init_telemetry()
+
+    def _init_telemetry(self) -> None:
+        from .common.flight_recorder import FlightRecorder
+        from .mgr.health import HealthCheckEngine
+        from .mgr.stats import StatsAggregator
+        self.stats = StatsAggregator(cct=self.cct,
+                                     name=f"c{self.cluster_id}")
+        self.flight = FlightRecorder(
+            cct=self.cct,
+            out_dir=(self.data_dir / "flight")
+            if self.data_dir is not None else None,
+            capacity=self.cct.conf.get("mgr_flight_capacity"))
+        self.health_engine = HealthCheckEngine(
+            name=f"c{self.cluster_id}", cct=self.cct,
+            on_transition=self._on_health_transition)
+        self._register_health_checks()
+        # transition-triggered dumps see the evaluation already cached;
+        # MANUAL dumps (admin/CLI) on a process that never ran health()
+        # fall back to a read-only evaluation (no hooks — evaluating
+        # inside a dump must not recurse into another dump)
+        self.flight.add_source(
+            "health", lambda: self.health_engine.last_evaluation
+            or self.health_engine.evaluate(fire_transitions=False))
+        self.flight.add_source("stats", lambda: self.stats.digest())
+        self.flight.register_admin()
+
+    def _on_health_transition(self, key, info, evaluation) -> None:
+        """A check newly raised or escalated: capture the run-up NOW
+        (tracer ring + perf + health + stats), while the state that
+        tripped it is still live."""
+        self.flight.dump(reason=f"health-{key}-{info['severity']}")
+
+    def _register_health_checks(self) -> None:
+        """The named check set (mon/health_check.h keys where the concept
+        matches).  Cluster-shape checks close over self; the generic
+        perf-surface checks come from mgr.health factories."""
+        from .mgr.health import (CheckResult, HEALTH_ERR,
+                                 recompile_storm_check, slow_ops_check,
+                                 throttle_saturated_check)
+        eng = self.health_engine
+
+        def osd_down():
+            down = [o for o in range(self.osdmap.max_osd)
+                    if not self.osdmap.is_up(o)]
+            if down:
+                return CheckResult(
+                    f"{len(down)} osds down",
+                    detail=[f"osd.{o} is down" for o in down],
+                    count=len(down))
+            return None
+
+        # ONE per-PG state walk per evaluation, shared by the two state
+        # checks (keyed on the engine's eval_seq — without the memo every
+        # health()/scrape would re-classify every PG once per check)
+        walk = {"seq": -1, "states": {}}
+
+        def _pgs_in_state(state: str) -> list[str]:
+            if walk["seq"] != eng.eval_seq:
+                states: dict[str, list[str]] = {}
+                for p in self.pools.values():
+                    for g in p["pgs"].values():
+                        states.setdefault(self.pg_state(g),
+                                          []).append(repr(g.pgid))
+                walk["seq"] = eng.eval_seq
+                walk["states"] = states
+            return walk["states"].get(state, [])
+
+        def pg_degraded():
+            pgs = _pgs_in_state("active+degraded")
+            if pgs:
+                return CheckResult(
+                    f"{len(pgs)} pgs degraded",
+                    detail=[f"pg {pgid} is active+degraded"
+                            for pgid in pgs], count=len(pgs))
+            return None
+
+        def pg_availability():
+            pgs = _pgs_in_state("inactive")
+            if pgs:
+                return CheckResult(
+                    f"{len(pgs)} pgs inactive",
+                    detail=[f"pg {pgid} is inactive (< min_size current "
+                            f"shards)" for pgid in pgs], count=len(pgs))
+            return None
+
+        def object_damaged():
+            oids = [f"{pid}/{oid}" for pid, p in self.pools.items()
+                    for g in p["pgs"].values()
+                    for oid in sorted(getattr(g.backend,
+                                              "inconsistent_objects", ()))]
+            if oids:
+                return CheckResult(
+                    f"{len(oids)} objects with unlocatable inconsistency",
+                    detail=oids, count=len(oids))
+            return None
+
+        eng.register("OSD_DOWN", osd_down,
+                     description="one or more OSDs are marked down")
+        eng.register("PG_DEGRADED", pg_degraded,
+                     description="PGs serving with fewer than size "
+                                 "current shards")
+        eng.register("PG_AVAILABILITY", pg_availability,
+                     severity=HEALTH_ERR,
+                     description="PGs below min_size: writes blocked")
+        eng.register("OBJECT_DAMAGED", object_damaged,
+                     description="objects flagged inconsistent with no "
+                                 "locatable bad shard")
+        eng.register("SLOW_OPS", slow_ops_check(self.stats),
+                     description="ops exceeded osd_op_complaint_time "
+                                 "within the stats window")
+        eng.register("THROTTLE_SATURATED",
+                     throttle_saturated_check(self.cct),
+                     description="an admission throttle is pinned near "
+                                 "its limit (sustained backpressure)")
+        eng.register("RECOMPILE_STORM",
+                     recompile_storm_check(self.cct, self.stats),
+                     description="jit compilations within the stats "
+                                 "window exceeded the storm threshold")
 
     def enable_serving(self, start: bool = False, **kw):
         """Attach a :class:`~ceph_tpu.exec.ServingEngine` to every EC
@@ -367,6 +490,9 @@ class MiniCluster:
             "osds_per_host": self.osds_per_host,
             "chunk_size": self.chunk_size,
             "store_backend": self.store_backend,
+            # operator state the data path cannot rebuild: muted health
+            # checks survive a reopen (the mon persists mutes the same way)
+            "health_mutes": sorted(self.health_engine.muted),
             "pools": [{"name": p["pool"].name,
                        "type": p["pool"].type,
                        "size": p["pool"].size,
@@ -396,6 +522,8 @@ class MiniCluster:
         c = cls(n_osds=meta["n_osds"], osds_per_host=meta["osds_per_host"],
                 chunk_size=meta["chunk_size"], cct=cct, data_dir=data_dir,
                 store_backend=meta.get("store_backend", "file"))
+        for key in meta.get("health_mutes", ()):
+            c.health_engine.mute(key)
         for p in meta["pools"]:
             if p["type"] == POOL_TYPE_REPLICATED:
                 pid = c.create_replicated_pool(p["name"], p["size"],
@@ -716,30 +844,29 @@ class MiniCluster:
         return "active+clean"
 
     def health(self) -> dict:
-        """'ceph health detail' shape: HEALTH_OK / HEALTH_WARN /
-        HEALTH_ERR with the reference's check keys (OSD_DOWN,
-        PG_DEGRADED, PG_AVAILABILITY — src/mon/health_check.h)."""
-        checks: dict[str, str] = {}
-        st = self.status()
-        down = st["osdmap"]["num_osds"] - st["osdmap"]["num_up_osds"]
-        if down:
-            checks["OSD_DOWN"] = f"{down} osds down"
-        by_state = st["pgmap"]["pgs_by_state"]
-        if by_state.get("active+degraded"):
-            checks["PG_DEGRADED"] = \
-                f"{by_state['active+degraded']} pgs degraded"
-        if by_state.get("inactive"):
-            checks["PG_AVAILABILITY"] = \
-                f"{by_state['inactive']} pgs inactive"
-        damaged = sum(len(getattr(g.backend, "inconsistent_objects", ()))
-                      for p in self.pools.values()
-                      for g in p["pgs"].values())
-        if damaged:
-            checks["OBJECT_DAMAGED"] = \
-                f"{damaged} objects with unlocatable inconsistency"
-        status = ("HEALTH_ERR" if "PG_AVAILABILITY" in checks
-                  else "HEALTH_WARN" if checks else "HEALTH_OK")
-        return {"status": status, "checks": checks}
+        """'ceph health' shape: a THIN view over the HealthCheckEngine —
+        {"status", "checks": {key: summary}}, muted checks split out
+        under "muted" (only when any exist, so the healthy shape stays
+        exactly {"status", "checks"})."""
+        from .mgr.health import thin_view
+        return thin_view(self.health_engine.evaluate())
+
+    def health_detail(self) -> dict:
+        """The full engine evaluation (per-check severity + detail lines
+        + mute state) — 'ceph health detail' / the flight-recorder
+        source."""
+        return self.health_engine.evaluate()
+
+    def mute_health(self, key: str) -> None:
+        """'ceph health mute <KEY>': mute AND persist in one step — any
+        surface that mutes through the engine alone would lose the mute
+        at the next reopen."""
+        self.health_engine.mute(key)
+        self._save_meta()
+
+    def unmute_health(self, key: str) -> None:
+        self.health_engine.unmute(key)
+        self._save_meta()
 
     # -- scrub (PG::scrub scheduling through the daemons' op queues) --------
 
@@ -944,6 +1071,11 @@ class MiniCluster:
         durable stores checkpoint and close."""
         if self.serving is not None:
             self.serving.stop()
+        # telemetry spine down FIRST: a prometheus scrape racing the
+        # teardown must not evaluate checks over half-closed PGs
+        self.stats.close()
+        self.health_engine.close()
+        self.flight.close()
         for p in self.pools.values():
             for g in p["pgs"].values():
                 g.shutdown()
@@ -1128,13 +1260,18 @@ class MiniCluster:
     def status(self) -> dict:
         """ceph -s shape: osdmap summary + pgmap with per-state counts
         (the PGMap the mon's stats service aggregates — active+clean /
-        active+degraded / inactive from each PG's shard availability)."""
+        active+degraded / inactive from each PG's shard availability)
+        plus the rate digest (client IO B/s and op/s, recovery B/s,
+        serving batch throughput).  Each call ticks the StatsAggregator,
+        so consecutive status calls bracket the rate window the way the
+        mgr's periodic reports do."""
         n_pgs = 0
         states = {"active+clean": 0, "active+degraded": 0, "inactive": 0}
         for p in self.pools.values():
             for g in p["pgs"].values():
                 n_pgs += 1
                 states[self.pg_state(g)] += 1
+        self.stats.sample()
         return {
             "osdmap": {"epoch": self.osdmap.epoch,
                        "num_osds": self.osdmap.max_osd,
@@ -1144,5 +1281,6 @@ class MiniCluster:
             "pgmap": {"num_pgs": n_pgs,
                       "num_pools": len(self.pools),
                       "pgs_by_state": {k: v for k, v in states.items()
-                                       if v}},
+                                       if v},
+                      "io_rates": self.stats.digest()},
         }

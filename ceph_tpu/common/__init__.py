@@ -14,6 +14,8 @@ from .tracer import (Span, Tracer, default_tracer, trace_span,
                      trace_instant, jit_dump, jit_perf_counters)
 from .optracker import OpTracker, TrackedOp
 from .context import Context, default_context
+from .flight_recorder import FlightRecorder
+from . import device_telemetry
 
 __all__ = [
     "ConfigProxy", "Option", "OPTIONS", "SCHEMA", "parse_size",
@@ -25,4 +27,5 @@ __all__ = [
     "Span", "Tracer", "default_tracer", "trace_span", "trace_instant",
     "jit_dump", "jit_perf_counters",
     "Context", "default_context",
+    "FlightRecorder", "device_telemetry",
 ]

@@ -35,6 +35,13 @@ class AdminSocket:
         with self._lock:
             self._hooks.pop(command, None)
 
+    def get(self, command: str):
+        """The registered hook fn, or None — lets a takeover-registered
+        command's owner check it still holds the name before removing."""
+        with self._lock:
+            hook = self._hooks.get(command)
+        return hook[0] if hook else None
+
     def call(self, command: str, **kwargs):
         with self._lock:
             hook = self._hooks.get(command)

@@ -55,6 +55,21 @@ class Context:
         self.admin_socket.register(
             "jit dump", lambda **kw: tracer_mod.jit_dump(),
             "per-(function, shape) JIT compile/dispatch telemetry")
+
+        def _device_dump(initialize: str = "", **kw):
+            from . import device_telemetry
+            # SAFE by default: initializing a backend from an admin call
+            # can wedge the process over a dead tunnel (the hang
+            # device_telemetry exists to avoid).  Operators opt in with
+            # initialize=true when they accept that risk.
+            return device_telemetry.refresh(
+                self, initialize=str(initialize).lower()
+                in ("1", "true", "yes"))
+        self.admin_socket.register(
+            "device dump", _device_dump,
+            "JAX/XLA device inventory + memory/compile-cache telemetry "
+            "(pass initialize=true to force backend init — may hang on "
+            "a dead tunnel)")
         self.admin_socket.register(
             "jit reset", lambda **kw: tracer_mod.jit_reset(),
             "clear the per-(function, shape) JIT telemetry records")

@@ -156,6 +156,32 @@ OPTIONS: list[Option] = [
            description="OSD daemon op-queue admission bound (0 = "
                        "unlimited); past it ms_dispatch answers "
                        "('throttled', epoch) and the client backs off"),
+    # -- mgr telemetry (stats aggregation + health checks) ----------------
+    Option("mgr_stats_period", TYPE_FLOAT, LEVEL_ADVANCED, default=1.0,
+           min=0.01,
+           description="seconds between background StatsAggregator "
+                       "samples (the mgr's tick interval)",
+           see_also=["mgr_stats_window"]),
+    Option("mgr_stats_window", TYPE_UINT, LEVEL_ADVANCED, default=120,
+           min=2,
+           description="perf-counter samples retained in the rolling "
+                       "rate window (rates span first..last sample)",
+           see_also=["mgr_stats_period"]),
+    Option("mgr_throttle_saturation_ratio", TYPE_FLOAT, LEVEL_ADVANCED,
+           default=0.9, min=0.0, max=1.0,
+           description="THROTTLE_SATURATED health check fires when a "
+                       "throttle's in-use/limit ratio reaches this"),
+    Option("mgr_recompile_storm_compiles", TYPE_UINT, LEVEL_ADVANCED,
+           default=8, min=1,
+           description="RECOMPILE_STORM health check fires when jit "
+                       "compilations within the stats window reach this "
+                       "many AND this rate per minute (shape churn "
+                       "defeating the size buckets)"),
+    Option("mgr_flight_capacity", TYPE_UINT, LEVEL_ADVANCED, default=8,
+           min=1,
+           description="flight-recorder bundles kept in the in-memory "
+                       "ring (disk dumps are additionally bounded by "
+                       "the operator's data dir)"),
     Option("log_file", TYPE_STR, LEVEL_BASIC, default="",
            description="path to log file"),
     Option("log_max_recent", TYPE_UINT, LEVEL_ADVANCED, default=500,

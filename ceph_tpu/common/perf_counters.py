@@ -176,6 +176,13 @@ class PerfCountersCollection:
         with self._lock:
             return self._loggers.get(name)
 
+    def snapshot(self) -> dict[str, PerfCounters]:
+        """Locked copy of the registry — the safe way to iterate
+        collections while other threads register/remove them (health
+        checks, exporters, `top`)."""
+        with self._lock:
+            return dict(self._loggers)
+
     def perf_dump(self) -> dict:
         with self._lock:
             loggers = dict(self._loggers)

@@ -150,10 +150,13 @@ class ServingEngine:
         """Run threaded: coalescer + finisher threads."""
         if self._thread is None:
             self._stopping = False
-            # re-register counters a previous stop() unhooked (restart)
+            # re-register counters a previous stop() unhooked (restart),
+            # and rejoin the live-engine registry stop() discarded from —
+            # a restarted engine must keep exporting its queue gauges
             self.cct.perf.add(self.perf)
             self.cct.perf.add(self.byte_throttle.perf)
             self.cct.perf.add(self.op_throttle.perf)
+            _ENGINES.add(self)
             self.finisher.start()
             self._thread = threading.Thread(
                 target=self._loop, name=f"coalescer-{self.name}",

@@ -4,7 +4,17 @@ The reference runs these as Python modules inside ceph-mgr
 (src/pybind/mgr/{balancer,pg_autoscaler}); here they are library functions
 over OSDMap — same decision logic, emitted as OSDMap incrementals."""
 from .balancer import calc_pg_upmaps, calc_weight_set, osd_deviation
+from .health import (CheckResult, HEALTH_ERR, HEALTH_OK, HEALTH_WARN,
+                     HealthCheckEngine, iter_throttles,
+                     live_health_engines, recompile_storm_check,
+                     slow_ops_check, throttle_saturated_check)
 from .pg_autoscaler import autoscale_recommendations, nearest_power_of_two
+from .stats import StatsAggregator, live_aggregators
 
 __all__ = ["calc_pg_upmaps", "calc_weight_set", "osd_deviation",
-           "autoscale_recommendations", "nearest_power_of_two"]
+           "autoscale_recommendations", "nearest_power_of_two",
+           "CheckResult", "HEALTH_OK", "HEALTH_WARN", "HEALTH_ERR",
+           "HealthCheckEngine", "iter_throttles", "live_health_engines",
+           "slow_ops_check", "throttle_saturated_check",
+           "recompile_storm_check",
+           "StatsAggregator", "live_aggregators"]

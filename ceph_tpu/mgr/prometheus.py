@@ -91,10 +91,64 @@ def _mclock_depth_gauges(family, prefix: str) -> None:
                 f'shard="0",op_class="{_sanitize(op_class)}"}} {depth}')
 
 
+def _health_gauges(family, prefix: str) -> None:
+    """``ceph_tpu_health_status{owner=...,check=...}`` — one gauge per
+    REGISTERED check per live engine (0=ok, 1=warn, 2=err).  Evaluated
+    live at scrape time, so a scrape that catches a fresh WARN/ERR also
+    trips the owner's flight recorder — by design."""
+    try:
+        from .health import live_health_engines
+    except Exception:                       # pragma: no cover
+        return
+    metric = f"{prefix}_health_status"
+    fam = None
+    for e in sorted(live_health_engines(), key=lambda e: e.name):
+        for key, rank in sorted(e.severity_gauges().items()):
+            if fam is None:
+                fam = family(metric, "gauge",
+                             "health check severity "
+                             "(0=ok/muted 1=warn 2=err)")
+            fam.lines.append(
+                f'{metric}{{owner="{_sanitize(e.name)}",'
+                f'check="{_sanitize(key)}"}} {rank}')
+
+
+def _stats_rate_gauges(family, prefix: str) -> None:
+    """``ceph_tpu_stats_rate{owner=...,stat=...}`` — the PGMap-style
+    digest (client IO B/s and op/s, recovery B/s, serving batch
+    throughput, jit churn) of every live StatsAggregator.  Each scrape
+    ticks the aggregator, so scrape cadence IS the rate window cadence
+    (how the reference mgr's prometheus module drives PGMap deltas)."""
+    try:
+        from .stats import live_aggregators
+    except Exception:                       # pragma: no cover
+        return
+    metric = f"{prefix}_stats_rate"
+    fam = None
+    for agg in sorted(live_aggregators(), key=lambda a: a.name):
+        agg.sample()
+        for stat, v in sorted(agg.digest_flat().items()):
+            if fam is None:
+                fam = family(metric, "gauge",
+                             "rolling-window rate digest "
+                             "(mgr/stats.py StatsAggregator)")
+            fam.lines.append(
+                f'{metric}{{owner="{_sanitize(agg.name)}",'
+                f'stat="{stat}"}} {round(v, 3)}')
+
+
 def render(cct=None, prefix: str = "ceph_tpu") -> str:
     """The /metrics payload: every registered collection's metrics plus
     the tracer's span-latency histograms."""
     cct = cct if cct is not None else default_context()
+    # refresh the device gauges BEFORE the collection walk renders them
+    # (never initializes a backend: scrape must not be the thing that
+    # dials a wedged tunnel)
+    try:
+        from ..common import device_telemetry
+        device_telemetry.refresh(cct)
+    except Exception:                       # pragma: no cover
+        pass
     families: dict[str, _MetricFamily] = {}
 
     def family(metric: str, kind: str, help_text: str) -> _MetricFamily:
@@ -103,7 +157,7 @@ def render(cct=None, prefix: str = "ceph_tpu") -> str:
             fam = families[metric] = _MetricFamily(metric, kind, help_text)
         return fam
 
-    for coll_name, pc in sorted(cct.perf._loggers.items()):
+    for coll_name, pc in sorted(cct.perf.snapshot().items()):
         label = f'collection="{coll_name}"'
         for key, m in sorted(pc._metrics.items()):
             metric = f"{prefix}_{_sanitize(key)}"
@@ -120,6 +174,8 @@ def render(cct=None, prefix: str = "ceph_tpu") -> str:
                 fam.lines.append(f"{metric}{{{label}}} {m.value}")
 
     _mclock_depth_gauges(family, prefix)
+    _health_gauges(family, prefix)
+    _stats_rate_gauges(family, prefix)
 
     span_metric = f"{prefix}_span_latency_seconds"
     hists = default_tracer().histograms()

@@ -1,22 +1,27 @@
 """`ceph` CLI: the admin command surface over a durable cluster.
 
 Analog of the reference's `ceph` tool verbs (reference: src/ceph.in →
-mon/mgr command handlers): `-s`/`status`, `health [detail]`,
-`osd tree` (the CRUSH hierarchy with weights/status, OSDMonitor's
-'osd tree' dump shape), `osd df`, `pg dump` (PGMap's per-PG table:
-state, objects, log version, up/acting), `df`.  Like the rados CLI,
-every invocation reopens the FileStore-backed cluster under
-``--data-dir`` — boot peering and log replay included — so the admin
-view reflects exactly what is durable.
+mon/mgr command handlers): `-s`/`status` (now with the PGMap rate lines
+— client IO B/s and op/s, recovery B/s — and the health-mute state),
+`health [detail]`, `health mute|unmute <KEY>` (persisted in the cluster
+meta like the mon's mutes), `top` (live rate/queue/health digest;
+``--iterations``/``--interval`` pace it), `flight dump` (capture an
+anomaly flight-recorder bundle), `osd tree` (the CRUSH hierarchy with
+weights/status, OSDMonitor's 'osd tree' dump shape), `osd df`,
+`pg dump` (PGMap's per-PG table: state, objects, log version,
+up/acting), `df`.  Like the rados CLI, every invocation reopens the
+FileStore-backed cluster under ``--data-dir`` — boot peering and log
+replay included — so the admin view reflects exactly what is durable.
 
     python -m ceph_tpu.tools.ceph_cli --data-dir D status
-    python -m ceph_tpu.tools.ceph_cli --data-dir D osd tree
-    python -m ceph_tpu.tools.ceph_cli --data-dir D pg dump
+    python -m ceph_tpu.tools.ceph_cli --data-dir D health mute SLOW_OPS
+    python -m ceph_tpu.tools.ceph_cli --data-dir D top --iterations 3
 """
 from __future__ import annotations
 
 import argparse
 import sys
+import time
 
 
 def render_osd_tree(cluster) -> str:
@@ -84,9 +89,14 @@ def main(argv=None) -> int:
     ap.add_argument("--keyring",
                     help="client.admin keyring (default: "
                          "<data-dir>/client.admin.keyring)")
+    ap.add_argument("--iterations", type=int, default=1,
+                    help="top: number of refresh rounds")
+    ap.add_argument("--interval", type=float, default=1.0,
+                    help="top: seconds between refresh rounds")
     ap.add_argument("cmd", nargs="+",
-                    help="status | -s | health [detail] | osd tree | "
-                         "osd df | pg dump | df")
+                    help="status | -s | health [detail] | "
+                         "health mute|unmute KEY | top | flight dump | "
+                         "osd tree | osd df | pg dump | df")
     args = ap.parse_args(argv)
 
     import os
@@ -104,7 +114,33 @@ def main(argv=None) -> int:
         if cmd in ("status", "-s"):
             print(_fmt_status(c.status(), c.health()))
         elif cmd in ("health", "health detail"):
-            _print_health(c.health(), cmd == "health detail")
+            if cmd == "health detail":
+                # ONE evaluation serves both the status line and the
+                # detail listing (two would re-walk every pool/PG and
+                # could disagree if state moved between them)
+                from ..mgr.health import thin_view
+                ev = c.health_detail()
+                _print_health(thin_view(ev), True, detail_ev=ev)
+            else:
+                _print_health(c.health(), False)
+        elif len(args.cmd) == 3 and args.cmd[0] == "health" and \
+                args.cmd[1] in ("mute", "unmute"):
+            key = args.cmd[2]
+            if args.cmd[1] == "mute":
+                if key not in c.health_engine.registered():
+                    print(f"warning: {key!r} is not a registered check "
+                          f"(muting anyway)", file=sys.stderr)
+                c.mute_health(key)      # mute + persist in one step
+            else:
+                c.unmute_health(key)
+            print(f"{args.cmd[1]}d {key}")
+        elif cmd == "top":
+            _run_top(c, args.iterations, args.interval)
+        elif cmd == "flight dump":
+            b = c.flight.dump(reason="cli", force=True)
+            print(f"captured flight bundle seq={b['seq']} "
+                  f"reason={b['reason']}"
+                  + (f" -> {b['path']}" if "path" in b else ""))
         elif cmd == "osd tree":
             print(render_osd_tree(c))
         elif cmd == "osd df":
@@ -142,17 +178,63 @@ def main(argv=None) -> int:
         c.shutdown()
 
 
-def _print_health(h: dict, detail: bool) -> None:
-    print(h["status"])
+def _health_line(h: dict) -> str:
+    """`HEALTH_X (muted: A, B)` — ONE rendering of status + mute state
+    for every surface (status header, health verb, top)."""
+    status = h["status"]
+    if h.get("muted"):
+        status += f" (muted: {', '.join(sorted(h['muted']))})"
+    return status
+
+
+def _print_health(h: dict, detail: bool, detail_ev: dict | None = None
+                  ) -> None:
+    print(_health_line(h))
     if detail:
-        for key, msg in sorted(h["checks"].items()):
-            print(f"[{key}] {msg}")
+        if detail_ev is not None:       # rich engine evaluation (local)
+            for key, c in sorted(detail_ev["checks"].items()):
+                mute = " (MUTED)" if c["muted"] else ""
+                print(f"[{c['severity']}] {key}{mute}: {c['summary']}")
+                for line in c["detail"]:
+                    print(f"    {line}")
+        else:                           # thin view (remote mode)
+            for key, msg in sorted(h["checks"].items()):
+                print(f"[{key}] {msg}")
+
+
+def _fmt_bytes_s(v: float) -> str:
+    for unit in ("B/s", "KiB/s", "MiB/s", "GiB/s"):
+        if v < 1024 or unit == "GiB/s":
+            return f"{v:.1f} {unit}" if unit != "B/s" else f"{v:.0f} B/s"
+        v /= 1024.0
+    return f"{v:.1f} GiB/s"             # pragma: no cover
+
+
+def _fmt_io_lines(rates: dict | None) -> str:
+    """The 'io:' section (PGMap overall_client_io_rate_summary shape);
+    recovery shows only when active, like the reference."""
+    if not rates:
+        return ""
+    cl = rates["client_io"]
+    lines = [f"    client:   {_fmt_bytes_s(cl['rd_bytes_s'])} rd, "
+             f"{_fmt_bytes_s(cl['wr_bytes_s'])} wr, "
+             f"{cl['rd_op_s']:.0f} op/s rd, {cl['wr_op_s']:.0f} op/s wr"]
+    rec = rates["recovery"]
+    if rec["bytes_s"] or rec["op_s"]:
+        lines.append(f"    recovery: {_fmt_bytes_s(rec['bytes_s'])}, "
+                     f"{rec['op_s']:.0f} obj/s")
+    srv = rates["serving"]
+    if srv["op_s"]:
+        lines.append(f"    serving:  {srv['op_s']:.0f} op/s in "
+                     f"{srv['batch_s']:.0f} batch/s, "
+                     f"{_fmt_bytes_s(srv['bytes_s'])}")
+    return "\n  io:\n" + "\n".join(lines)
 
 
 def _fmt_status(st: dict, h: dict) -> str:
     states = ", ".join(f"{n} {s}" for s, n in
                        sorted(st["pgmap"]["pgs_by_state"].items()))
-    return (f"  cluster:\n    health: {h['status']}\n"
+    return (f"  cluster:\n    health: {_health_line(h)}\n"
             f"  services:\n"
             f"    osd: {st['osdmap']['num_osds']} osds: "
             f"{st['osdmap']['num_up_osds']} up "
@@ -160,7 +242,52 @@ def _fmt_status(st: dict, h: dict) -> str:
             f"  data:\n"
             f"    pools:   {st['pgmap']['num_pools']} pools, "
             f"{st['pgmap']['num_pgs']} pgs\n"
-            f"    pgs:     {states}")
+            f"    pgs:     {states}"
+            + _fmt_io_lines(st["pgmap"].get("io_rates")))
+
+
+def render_top(c) -> str:
+    """One `ceph_tpu top` frame: health, rate digest, throttle
+    occupancy, jit churn, daemon queue depth — the operator's
+    is-it-moving-right-now view."""
+    c.stats.sample()
+    d = c.stats.digest()
+    h = c.health()
+    lines = [f"health: {_health_line(h)}"
+             + (f"  checks: {', '.join(sorted(h['checks']))}"
+                if h["checks"] else ""),
+             f"window: {d['window_s']:.1f}s over {d['samples']} samples"]
+    cl = d["client_io"]
+    lines.append(f"client io: {_fmt_bytes_s(cl['rd_bytes_s'])} rd, "
+                 f"{_fmt_bytes_s(cl['wr_bytes_s'])} wr, "
+                 f"{cl['rd_op_s']:.0f}/{cl['wr_op_s']:.0f} op/s rd/wr")
+    lines.append(f"recovery:  {_fmt_bytes_s(d['recovery']['bytes_s'])}, "
+                 f"{d['recovery']['op_s']:.0f} obj/s")
+    lines.append(f"serving:   {d['serving']['op_s']:.0f} op/s, "
+                 f"{d['serving']['batch_s']:.0f} batch/s")
+    lines.append(f"jit:       {d['jit']['compiles']:.0f} compiles, "
+                 f"{d['jit']['cache_hits']:.0f} cache hits (window)")
+    from ..mgr.health import iter_throttles
+    throttles = [f"{name.removeprefix('throttle.')}={int(val)}/{int(mx)}"
+                 for name, val, mx in iter_throttles(c.cct)]
+    if throttles:
+        lines.append("throttles: " + " ".join(throttles))
+    depths = {o: sum(sum(cls.values()) for cls in
+                     daemon.queue_depths().values())
+              for o, daemon in sorted(c.osds.items())}
+    busy = {o: n for o, n in depths.items() if n}
+    if busy:
+        lines.append("queues:    " + " ".join(
+            f"osd.{o}={n}" for o, n in sorted(busy.items())))
+    return "\n".join(lines)
+
+
+def _run_top(c, iterations: int, interval: float) -> None:
+    for i in range(max(1, iterations)):
+        if i:
+            time.sleep(interval)
+            print()
+        print(render_top(c))
 
 
 def _run_remote(args) -> int:

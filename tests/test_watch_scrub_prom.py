@@ -190,6 +190,57 @@ class TestPrometheus:
         finally:
             d.drain()                     # leave no cross-test gauges
 
+    def test_health_status_gauges_rendered(self):
+        """Every REGISTERED check of every live HealthCheckEngine
+        exports ONE `ceph_tpu_health_status` gauge (0=ok 1=warn 2=err),
+        labelled owner+check, with the HELP/TYPE-once invariants."""
+        from ceph_tpu.common import Context
+        from ceph_tpu.mgr.health import HEALTH_ERR, HealthCheckEngine
+        from ceph_tpu.mgr.prometheus import render
+        eng = HealthCheckEngine(name="promtest")
+        eng.register("ALWAYS_OK", lambda: None)
+        eng.register("ALWAYS_BAD", lambda: "2 things bad",
+                     severity=HEALTH_ERR)
+        try:
+            text = render(Context())
+            lines = text.splitlines()
+            assert lines.count("# TYPE ceph_tpu_health_status gauge") == 1
+            assert any(line.startswith("# HELP ceph_tpu_health_status ")
+                       for line in lines)
+            assert 'ceph_tpu_health_status{owner="promtest",' \
+                   'check="ALWAYS_OK"} 0' in lines
+            assert 'ceph_tpu_health_status{owner="promtest",' \
+                   'check="ALWAYS_BAD"} 2' in lines
+            types = [line.split(" ", 2)[2].split(" ", 1)[0]
+                     for line in lines if line.startswith("# TYPE ")]
+            assert len(types) == len(set(types)), "duplicate TYPE lines"
+        finally:
+            eng.close()
+
+    def test_stats_rate_gauges_rendered(self):
+        """Live StatsAggregators export the PGMap-style digest as ONE
+        `ceph_tpu_stats_rate` gauge family (owner + stat labels)."""
+        from ceph_tpu.common import Context
+        from ceph_tpu.mgr.prometheus import render
+        from ceph_tpu.mgr.stats import StatsAggregator
+        agg = StatsAggregator(cct=Context(), name="promstats")
+        try:
+            text = render(Context())
+            assert text.count("# TYPE ceph_tpu_stats_rate gauge") == 1
+            assert 'ceph_tpu_stats_rate{owner="promstats",' \
+                   'stat="client_wr_bytes_s"} 0' in text
+        finally:
+            agg.close()
+
+    def test_device_collection_rendered(self):
+        """The device-telemetry gauges land in the exposition via the
+        ordinary collection walk (refresh happens at render time)."""
+        from ceph_tpu.common import Context
+        from ceph_tpu.mgr.prometheus import render
+        text = render(Context())
+        assert 'ceph_tpu_num_devices{collection="device"}' in text
+        assert 'ceph_tpu_compile_cache_keys{collection="device"}' in text
+
     def test_span_latency_histograms_rendered(self):
         """The tracer's per-span-name latency distributions surface as
         prometheus histograms with the full _bucket/_sum/_count set."""

@@ -100,18 +100,56 @@ def render_table(agg: dict[str, dict], limit: int = 0) -> str:
     return "\n".join(lines)
 
 
+def render_json(agg: dict[str, dict], limit: int = 0) -> str:
+    """Machine-readable twin of the text table (CI/BENCH tooling was
+    scraping the text): same rows, same order, explicit units."""
+    rows = sorted(agg.items(), key=lambda kv: kv[1]["self_us"],
+                  reverse=True)
+    if limit:
+        rows = rows[:limit]
+    spans = []
+    for name, a in rows:
+        durs = a.get("durs_us", [])
+        spans.append({
+            "name": name,
+            "count": a["count"],
+            "total_ms": round(a["total_us"] / 1e3, 6),
+            "self_ms": round(a["self_us"] / 1e3, 6),
+            "avg_ms": round(a["total_us"] / a["count"] / 1e3, 6)
+            if a["count"] else 0.0,
+            "p50_ms": round(percentile_us(durs, 50) / 1e3, 6),
+            "p99_ms": round(percentile_us(durs, 99) / 1e3, 6),
+        })
+    return json.dumps({"spans": spans, "num_spans": len(spans)})
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="sorted self-time table from a Chrome trace file")
     ap.add_argument("trace", help="trace JSON ({'traceEvents': ...} or [])")
     ap.add_argument("--limit", type=int, default=0,
                     help="show only the top N spans by self time")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the table as one JSON document instead of "
+                         "text (same rows/order)")
     args = ap.parse_args(argv)
     events = load_events(args.trace)
     if not events:
-        print("no complete ('ph': 'X') events in trace", file=sys.stderr)
+        # both modes keep the nonzero exit: a trace that captured
+        # nothing is a failure signal CI must not green on
+        if args.json:
+            print(json.dumps({"spans": [], "num_spans": 0,
+                              "error": "no complete ('ph': 'X') events "
+                                       "in trace"}))
+        else:
+            print("no complete ('ph': 'X') events in trace",
+                  file=sys.stderr)
         return 1
-    print(render_table(self_times(events), args.limit))
+    agg = self_times(events)
+    if args.json:
+        print(render_json(agg, args.limit))
+    else:
+        print(render_table(agg, args.limit))
     return 0
 
 
