@@ -266,6 +266,96 @@ def cpu_baseline(data, k, m, erasures):
 _emit_lock = threading.Lock()
 _emitted = False
 _SERVING: dict | None = None     # the serving-engine comparison block
+_RECOVERY: dict | None = None    # the repair-throughput comparison block
+
+
+def _recovery_repair_pass(device: str, batched: bool, n_objects: int,
+                          obj_bytes: int) -> dict:
+    """One degraded-cluster repair: write, kill a shard, overwrite
+    everything while it is down, revive, and time the drain to clean.
+    ``batched`` routes repair through the recovery scheduler (waves
+    fused into decode_shards_many dispatches); otherwise the per-object
+    inline path runs.  Returns MiB/s over the chunk bytes pushed."""
+    from ceph_tpu.cluster import MiniCluster
+    from ceph_tpu.common import Context
+    # fresh Context: the conf knobs below must not leak into the rest
+    # of the bench through the process-global default context
+    c = MiniCluster(n_osds=8, osds_per_host=2, chunk_size=4096,
+                    cct=Context())
+    try:
+        if batched:
+            c.cct.conf.set("osd_recovery_max_active", 16)
+            c.enable_recovery_scheduler()
+        pid = c.create_ec_pool(
+            "r", {"k": "4", "m": "2", "device": device,
+                  "technique": "reed_sol_van"}, pg_num=1)
+        g = c.pools[pid]["pgs"][0]
+        victim = g.acting[1]
+        rng = np.random.default_rng(0)
+        objs = {f"o{i}": rng.integers(0, 256, obj_bytes,
+                                      np.uint8).tobytes()
+                for i in range(n_objects)}
+        for oid, d in objs.items():
+            c.put(pid, oid, d)
+        # two kill-overwrite-revive cycles: the first warms the jit
+        # shape caches (both paths pay a cold compile on their decode
+        # shapes), the second is the steady-state measurement — same
+        # warm-vs-cold discipline as the chain timer above
+        dt = pushed = 0
+        for payload in (b"\x01", b"\x02"):
+            g.bus.mark_down(victim)
+            for oid in objs:              # the writes the victim misses
+                c.put(pid, oid, payload + objs[oid][1:])
+            before = g.backend.perf.get("recovery_bytes")
+            t0 = time.perf_counter()
+            g.bus.mark_up(victim)
+            c.deliver_all()
+            dt = time.perf_counter() - t0
+            pushed = g.backend.perf.get("recovery_bytes") - before
+            assert not g.backend.stale, "repair did not drain"
+        report = c.scrub_pool(pid, repair=False)
+        assert report == {}, f"repair left scrub findings: {report}"
+        return {"mib_s": round(pushed / 2**20 / dt, 2),
+                "objects": n_objects, "pushed_bytes": pushed,
+                "elapsed_s": round(dt, 3)}
+    finally:
+        c.shutdown()
+
+
+def recovery_section(platform: str | None) -> dict:
+    """Degraded-cluster repair throughput for the JSON artifact's
+    `recovery` block: kill-one-shard repair MiB/s, batch-fused
+    (scheduler waves through decode_shards_many) vs per-object, on the
+    SAME device.  Degrades to a cpu-marked line / error marker rather
+    than failing the bench."""
+    try:
+        device = "jax" if platform is not None else "numpy"
+        with phase("recovery"):
+            per_object = _recovery_repair_pass(device, batched=False,
+                                               n_objects=48,
+                                               obj_bytes=64 * 1024)
+            batched = _recovery_repair_pass(device, batched=True,
+                                            n_objects=48,
+                                            obj_bytes=64 * 1024)
+        res = {
+            "device": "tpu" if platform == "tpu" else "cpu",
+            "codec": device,
+            "per_object": per_object,
+            "batched": batched,
+            "speedup": round(batched["mib_s"] /
+                             max(per_object["mib_s"], 1e-9), 2),
+        }
+        if res["device"] == "cpu":
+            res["note"] = ("no tpu: repair dispatch overhead measured "
+                           f"on the {'jax-cpu' if platform else 'numpy'}"
+                           " path")
+        print(f"# recovery: batched {batched['mib_s']:.1f} MiB/s vs "
+              f"per-object {per_object['mib_s']:.1f} MiB/s -> "
+              f"{res['speedup']}x on {res['device']}", file=sys.stderr)
+        return res
+    except Exception as e:                 # never fail the artifact
+        print(f"# recovery bench failed: {e!r}", file=sys.stderr)
+        return {"device": "none", "error": repr(e)[:200]}
 
 
 def serving_section(platform: str | None) -> dict:
@@ -322,6 +412,8 @@ def emit(value, vs_baseline, extra):
     line.update(extra)
     if _SERVING is not None:
         line.setdefault("serving", _SERVING)
+    if _RECOVERY is not None:
+        line.setdefault("recovery", _RECOVERY)
     # always carried, even on the watchdog/fallback paths: the per-phase
     # breakdown and the per-attempt probe record accumulated so far.  A
     # phase still OPEN when the watchdog fires is exactly the one that
@@ -479,8 +571,12 @@ def main() -> int:
     # serving comparison (coalesced vs op-at-a-time) on whatever device
     # is up — its own subsystem, measured before the device codec pass so
     # a tunnel death mid-codec still leaves the serving block in the line
-    global _SERVING
+    global _SERVING, _RECOVERY
     _SERVING = serving_section(platform)
+    # repair-throughput comparison (batched waves vs per-object) on the
+    # same device — like serving, measured before the codec pass so a
+    # tunnel death mid-codec still leaves the block in the line
+    _RECOVERY = recovery_section(platform)
     if platform == "tpu":
         try:
             combined, extra = measure_device(data, k, m, erasures, batch)

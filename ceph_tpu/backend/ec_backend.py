@@ -36,7 +36,7 @@ from . import ecutil
 from .extent import ExtentSet
 from .extent_cache import ExtentCache
 from .memstore import GObject, Transaction
-from .messages import ECSubRead, ECSubReadReply, MessageBus
+from .messages import ECSubRead, ECSubReadReply, MessageBus, PushOp
 from .pg_backend import (Op, OSDShard, PG_META, PGBackend, RecoveryOp,
                          shard_store,
                          RecoveryState, RepairState, ShardRepairOp,
@@ -48,6 +48,26 @@ from ..osd.pg_log import OP_DELETE, OP_MODIFY
 __all__ = ["ECBackend", "OSDShard", "RecoveryState", "RecoveryOp",
            "RepairState", "ShardRepairOp", "Op", "ReadOp", "PG_META",
            "make_cluster"]
+
+
+@dataclass
+class _RecoveryWave:
+    """One batch-fused recovery wave (the recovery scheduler's unit of
+    work): many degraded objects read together — one ECSubRead per source
+    shard carrying every oid — and reconstructed through ONE
+    ``ecutil.decode_shards_many`` dispatch per survivor signature."""
+    tid: int
+    oids: dict[str, set[int]]            # oid -> missing chunks
+    on_each: object                      # on_each(oid, ok)
+    at_version: dict[str, int] = field(default_factory=dict)
+    pending_sources: set[int] = field(default_factory=set)
+    results: dict[str, dict[int, bytes]] = field(default_factory=dict)
+    attrs: dict[str, dict[int, dict]] = field(default_factory=dict)
+    # oids dropping to the battle-tested per-object path (read errors,
+    # version bumps mid-read, too few survivors)
+    fallback: set[str] = field(default_factory=set)
+    pending_pushes: dict[str, set[int]] = field(default_factory=dict)
+    failed: set[str] = field(default_factory=set)
 
 
 @dataclass
@@ -89,6 +109,9 @@ class ECBackend(PGBackend):
         self.extent_cache = ExtentCache()
         self.in_progress_reads: dict[int, ReadOp] = {}
         self.hinfo_cache: dict[str, HashInfo] = {}
+        # batched recovery waves in their READ phase, keyed by read tid
+        # (push-phase tracking lives in PGBackend._wave_pushes)
+        self._recovery_waves: dict[int, _RecoveryWave] = {}
         # optional serving engine (ceph_tpu/exec): when attached, encode/
         # decode dispatches route through its admission+coalescing queue
         # so CONCURRENT ops across PGs fuse into one device batch
@@ -492,6 +515,23 @@ class ECBackend(PGBackend):
             op._rmw_stalled = True
 
     def _on_shard_down_reads(self, shard: int, chunk: int) -> None:
+        # batched recovery waves: a lost SOURCE aborts the wave's read
+        # phase — every object re-drives through the per-object path
+        # (which widens, parks, or fails with the usual semantics)
+        for tid, wave in list(self._recovery_waves.items()):
+            if shard in wave.pending_sources:
+                del self._recovery_waves[tid]
+                for oid in sorted(wave.oids):
+                    self._wave_fallback_one(wave, oid)
+        # a lost PUSH TARGET fails that object, the rest of the wave
+        # proceeds (the _failed_push analog the per-object path applies)
+        for oid, wave in list(self._wave_pushes.items()):
+            pend = wave.pending_pushes.get(oid)
+            if pend and shard in pend:
+                pend.discard(shard)
+                wave.failed.add(oid)
+                if not pend:
+                    self._finish_wave_oid(wave, oid)
         # RMW pipeline reads: re-issue from the remaining shards
         for op in list(self.waiting_reads):
             if shard in op.pending_read_shards:
@@ -571,6 +611,11 @@ class ECBackend(PGBackend):
     def _handle_other_read_reply(self, reply: ECSubReadReply) -> None:
         """(ECBackend.cc:1153-1320): collect; on error widen the shard set
         (send_all_remaining_reads :2386)."""
+        # batched recovery wave reads
+        wave = self._recovery_waves.get(reply.tid)
+        if wave is not None:
+            self._handle_wave_read_reply(wave, reply)
+            return
         # RMW pipeline reads
         op = self._rmw_read_tids.get(reply.tid)
         if op is not None:
@@ -762,22 +807,32 @@ class ECBackend(PGBackend):
                 self.whoami, rop.read_tid, to_read, attrs_to_read={"*"},
                 sub_chunk_count=self.ec_impl.get_sub_chunk_count()))
 
-    def _recovery_push_payloads(self, rop: RecoveryOp
-                                ) -> dict[
-            int, tuple[bytes, dict, dict | None, bytes]]:
-        # reconstruct the missing chunks; chunk_size tells sub-chunk codes
-        # (clay) the helpers are fractional
-        available = {c: np.frombuffer(v, dtype=np.uint8)
-                     for c, v in rop._read_results.items()}
+    def _recovery_prepare_sources(self, oid: str,
+                                  read_results: dict[int, object],
+                                  read_attrs: dict[int, dict],
+                                  missing: set[int]
+                                  ) -> tuple[dict[int, np.ndarray],
+                                             HashInfo, set[int], dict]:
+        """Turn raw recovery-read replies into decode-ready inputs — ONE
+        copy shared by the per-object payload builder and the batched
+        wave: adopt a coherent hinfo, normalize source lengths, drop
+        (and mark for rebuild) crc- or parity-rotten sources, and build
+        the replicated attr set the pushes must carry.  Returns
+        ``(available, hinfo, missing, attrs)`` with ``missing`` possibly
+        EXTENDED by located rotten sources."""
+        missing = set(missing)
+        available = {c: (v if isinstance(v, np.ndarray)
+                         else np.frombuffer(v, dtype=np.uint8))
+                     for c, v in read_results.items()}
         # the hinfo must be COHERENT with the data the sources served:
         # each read reply carries data and attrs from one store state, so
         # a source's attr hinfo describes exactly the bytes it returned —
         # while the local attr can lag (or lead) the read by in-flight
         # sub-writes.  Prefer the newest source hinfo; fall back to the
         # local stored one, then to sizing from the bytes read.
-        hinfo = self._read_hinfo(rop.oid)     # uncached: see _read_hinfo
+        hinfo = self._read_hinfo(oid)         # uncached: see _read_hinfo
         peer_base = max(
-            (a for _c, a in sorted(rop._read_attrs.items())
+            (a for _c, a in sorted(read_attrs.items())
              if a and HINFO_KEY in a),
             key=lambda a: a[HINFO_KEY].get("version", 0), default=None)
         if peer_base is not None and \
@@ -822,11 +877,11 @@ class ECBackend(PGBackend):
             if rotten and len(available) - len(rotten) >= k:
                 for c in rotten:
                     del available[c]
-                rop.missing_shards = set(rop.missing_shards) | set(rotten)
+                missing |= set(rotten)
             elif rotten:
                 # not enough clean sources to rebuild everything: the
                 # reconstruction would embed rot — record damage
-                self.inconsistent_objects.add(rop.oid)
+                self.inconsistent_objects.add(oid)
         if not hinfo.has_chunk_hash() and len(available) > k \
                 and self.ec_impl.get_sub_chunk_count() == 1:
             # verified recovery (see _recovery_issue_reads): cross-check
@@ -834,20 +889,17 @@ class ECBackend(PGBackend):
             # rotten source instead of baking it into the rebuilt chunk
             out_map = {c: True for c in available}
             self._parity_consistency_scrub(
-                rop.oid, {c: v.tobytes() for c, v in available.items()},
+                oid, {c: v.tobytes() for c, v in available.items()},
                 out_map)
             bad = [c for c, ok in out_map.items() if not ok]
             if len(bad) == 1 and len(available) - 1 >= k:
-                rop.missing_shards = set(rop.missing_shards) | set(bad)
+                missing |= set(bad)
                 del available[bad[0]]
             elif bad:
                 # inconsistent but unlocatable (one spare equation can
                 # DETECT rot, never place it): the rebuild may launder
                 # corruption — record the object as damaged
-                self.inconsistent_objects.add(rop.oid)
-        rec = decode_shards(self.sinfo, self.ec_impl, available,
-                            rop.missing_shards,
-                            chunk_size=hinfo.get_total_chunk_size())
+                self.inconsistent_objects.add(oid)
         # pushes REPLACE the target object, so the replicated attrs
         # (user xattrs, object_info, snapset — identical on every shard)
         # must travel too, from a CURRENT copy; without them, repairing a
@@ -857,18 +909,192 @@ class ECBackend(PGBackend):
         # the primary's own shard is the one being repaired); each
         # source's shard-specific hinfo is stripped.
         attrs = {HINFO_KEY: hinfo.to_dict()}
-        base = next((a for _c, a in sorted(rop._read_attrs.items())
+        base = next((a for _c, a in sorted(read_attrs.items())
                      if a), None)
         if base is None:
             try:
                 base = self.local_shard.store.getattrs(
-                    GObject(rop.oid, self.whoami))
+                    GObject(oid, self.whoami))
             except FileNotFoundError:
                 base = {}
         attrs = {**{a: v for a, v in base.items() if a != HINFO_KEY},
                  **attrs}
+        return available, hinfo, missing, attrs
+
+    def _recovery_push_payloads(self, rop: RecoveryOp
+                                ) -> dict[
+            int, tuple[bytes, dict, dict | None, bytes]]:
+        # reconstruct the missing chunks; chunk_size tells sub-chunk codes
+        # (clay) the helpers are fractional
+        available, hinfo, missing, attrs = self._recovery_prepare_sources(
+            rop.oid, rop._read_results, rop._read_attrs,
+            set(rop.missing_shards))
+        rop.missing_shards = missing
+        rec = decode_shards(self.sinfo, self.ec_impl, available,
+                            rop.missing_shards,
+                            chunk_size=hinfo.get_total_chunk_size())
         return {chunk: (bytes(rec[chunk]), dict(attrs), None, b"")
                 for chunk in rop.missing_shards}
+
+    # -- batch-fused recovery waves (the recovery scheduler's dispatch) ----
+
+    def _recover_many(self, oids: dict[str, set[int]], on_each) -> None:
+        """Recover a wave of degraded objects with ONE read per source
+        shard and ONE ``decode_shards_many`` dispatch per survivor
+        signature — instead of the per-object machine's N reads and N
+        decodes.  Objects the batch cannot serve safely (sub-chunk codes,
+        too few survivors, singletons with nothing to fuse) drop to the
+        verified per-object path."""
+        k = self.ec_impl.get_data_chunk_count()
+        cur = self.current_shards()
+        if self.ec_impl.get_sub_chunk_count() != 1 or len(oids) < 2:
+            # clay's fractional repair reads are not positionwise across
+            # objects; a singleton has nothing to fuse — per-object keeps
+            # the minimum-read plan
+            super()._recover_many(oids, on_each)
+            return
+        singles: dict[str, set[int]] = {}
+        batch: dict[str, set[int]] = {}
+        for oid, missing in oids.items():
+            avail = {c for c, s in enumerate(self.acting)
+                     if s in cur and c not in missing}
+            (batch if len(avail) >= k else singles)[oid] = set(missing)
+        if singles:
+            super()._recover_many(singles, on_each)
+        if not batch:
+            return
+        if len(batch) == 1:
+            super()._recover_many(batch, on_each)
+            return
+        self.next_tid += 1
+        tid = self.next_tid
+        wave = _RecoveryWave(tid=tid, oids=batch, on_each=on_each)
+        per_shard: dict[int, dict[str, list[tuple]]] = {}
+        for oid, missing in sorted(batch.items()):
+            wave.at_version[oid] = self.pg_log.last_version_of(oid)
+            for chunk in sorted({c for c, s in enumerate(self.acting)
+                                 if s in cur and c not in missing}):
+                # every available chunk, whole (the verified-recovery
+                # read: spare equations cross-check the sources, and
+                # each source serves its own current full chunk —
+                # _recovery_issue_reads' sizing rationale)
+                per_shard.setdefault(self.acting[chunk],
+                                     {})[oid] = [(0, None, None)]
+        wave.pending_sources = set(per_shard)
+        self._recovery_waves[tid] = wave
+        for shard, to_read in sorted(per_shard.items()):
+            self.bus.send(shard, ECSubRead(self.whoami, tid, to_read,
+                                           attrs_to_read={"*"}))
+
+    def _handle_wave_read_reply(self, wave: _RecoveryWave,
+                                reply: ECSubReadReply) -> None:
+        chunk = {s: c for c, s in enumerate(self.acting)}[reply.from_shard]
+        for oid in reply.errors:
+            if oid in wave.oids:
+                # ENOENT/EIO from one source: the per-object path knows
+                # how to widen/park for this oid — don't fail the wave
+                wave.fallback.add(oid)
+        for oid, bufs in reply.buffers_read.items():
+            if oid in wave.oids:
+                wave.results.setdefault(oid, {})[chunk] = b"".join(
+                    b for _, b in bufs)
+        for oid, attrs in reply.attrs_read.items():
+            if oid in wave.oids:
+                wave.attrs.setdefault(oid, {})[chunk] = attrs
+        wave.pending_sources.discard(reply.from_shard)
+        if not wave.pending_sources:
+            self._finish_wave_reads(wave)
+
+    def _finish_wave_reads(self, wave: _RecoveryWave) -> None:
+        """Every source replied: prepare each object's sources exactly
+        like the per-object path (hinfo adoption, crc/parity verify),
+        then reconstruct ALL of them through decode_shards_many and push."""
+        self._recovery_waves.pop(wave.tid, None)
+        k = self.ec_impl.get_data_chunk_count()
+        ready: list[tuple[str, dict, set, dict]] = []
+        for oid in sorted(wave.oids):
+            if oid in wave.fallback:
+                continue
+            if oid in self._wave_pushes:
+                # ANOTHER wave (a sibling shard repair of the same batch
+                # sharing this oid) registered its pushes first: the
+                # push slot is per-oid, so this wave's copy re-drives
+                # per-object — both pushes land, replies disambiguate by
+                # from_shard (the targets are distinct shards)
+                wave.fallback.add(oid)
+                continue
+            if self.pg_log.last_version_of(oid) != wave.at_version[oid]:
+                # a write committed while the wave read was in flight:
+                # the reconstructed bytes would be stale — re-drive
+                wave.fallback.add(oid)
+                continue
+            available, _hinfo, missing, attrs = \
+                self._recovery_prepare_sources(
+                    oid, wave.results.get(oid, {}),
+                    wave.attrs.get(oid, {}), set(wave.oids[oid]))
+            if len(available) < k or not missing:
+                wave.fallback.add(oid)
+                continue
+            ready.append((oid, available, missing, attrs))
+        rebuilt: list[dict] = []
+        if ready:
+            try:
+                with trace_span("ec.decode_wave", objects=len(ready),
+                                backend=self.instance_name), \
+                        self.perf.time("decode_time"):
+                    rebuilt = ecutil.decode_shards_many(
+                        self.sinfo, self.ec_impl,
+                        [(avail, missing)
+                         for _o, avail, missing, _a in ready])
+            except (IOError, ValueError, AssertionError):
+                # a signature group failed to decode: every object drops
+                # to the per-object path, which localizes the failure
+                wave.fallback.update(oid for oid, *_ in ready)
+                ready, rebuilt = [], []
+        up = self.up_shards()
+        for (oid, _avail, missing, attrs), rec in zip(ready, rebuilt):
+            wave.pending_pushes[oid] = set()
+            self._wave_pushes[oid] = wave
+            for chunk in sorted(missing):
+                shard = self.acting[chunk]
+                if shard not in up:
+                    # target died while the reads were in flight: the op
+                    # fails for this object (_failed_push), the rest of
+                    # the wave proceeds
+                    wave.failed.add(oid)
+                    continue
+                data = bytes(rec[chunk])
+                wave.pending_pushes[oid].add(shard)
+                self.perf.inc("recovery_bytes", len(data))
+                self.bus.send(shard, PushOp(self.whoami, oid, data,
+                                            attrs=dict(attrs), omap=None,
+                                            omap_header=b""))
+            if not wave.pending_pushes[oid]:
+                self._finish_wave_oid(wave, oid)
+        for oid in sorted(wave.fallback):
+            self._wave_fallback_one(wave, oid)
+
+    def _wave_fallback_one(self, wave: _RecoveryWave, oid: str) -> None:
+        def done(rec, _oid=oid, _wave=wave):
+            _wave.on_each(_oid, rec.state == RecoveryState.COMPLETE)
+        # a concurrent per-object recovery may have appeared (e.g. scrub):
+        # the shared helper chains behind it per the one-op-per-object rule
+        self._chain_or_recover(oid, set(wave.oids[oid]), done)
+
+    def _wave_push_reply(self, wave: _RecoveryWave, reply) -> None:
+        pend = wave.pending_pushes.get(reply.oid)
+        if pend is None:
+            return
+        pend.discard(reply.from_shard)
+        if not pend:
+            self._finish_wave_oid(wave, reply.oid)
+
+    def _finish_wave_oid(self, wave: _RecoveryWave, oid: str) -> None:
+        self._wave_pushes.pop(oid, None)
+        wave.pending_pushes.pop(oid, None)
+        ok = oid not in wave.failed
+        self.perf.inc("recoveries" if ok else "recovery_failures")
+        wave.on_each(oid, ok)
 
     # -- deep scrub (ECBackend.cc:2461-2546) -------------------------------
 

@@ -365,6 +365,50 @@ def decode_many(sinfo: StripeInfo, ec_impl,
     return results
 
 
+def decode_shards_many(sinfo: StripeInfo, ec_impl,
+                       batches: list[tuple[dict[int, np.ndarray], set]]
+                       ) -> list[dict[int, np.ndarray]]:
+    """Reconstruct specific shards for MANY objects with ONE
+    ``ec_impl.decode`` per distinct (survivor signature, want set) — the
+    recovery-side sibling of :func:`decode_many`.  Parity is positionwise,
+    so objects sharing both signatures share a decode matrix and their
+    chunk streams concatenate along the byte axis into one device
+    dispatch; results split back per object, bit-identical to calling
+    :func:`decode_shards` per object.
+
+    ``batches`` is ``[(available {chunk: bytes}, want set), ...]``.  Only
+    valid for whole-chunk codes (``get_sub_chunk_count() == 1``) — clay's
+    fractional repair reads are not positionwise across objects; callers
+    gate on that and fall back to per-object :func:`decode_shards`."""
+    if not batches:
+        return []
+    results: list[dict[int, np.ndarray] | None] = [None] * len(batches)
+    by_sig: dict[tuple[frozenset, frozenset], list[int]] = {}
+    for i, (available, want) in enumerate(batches):
+        by_sig.setdefault((frozenset(available), frozenset(want)),
+                          []).append(i)
+    for (sig, want_sig), idxs in by_sig.items():
+        want = set(want_sig)
+        streams: dict[int, list[np.ndarray]] = {c: [] for c in sig}
+        lens: list[int] = []
+        for i in idxs:
+            chunks = {c: _as_u8(v) for c, v in batches[i][0].items()}
+            sizes = {len(v) for v in chunks.values()}
+            assert len(sizes) == 1, "uneven shard buffers"
+            lens.append(sizes.pop())
+            for c in sig:
+                streams[c].append(chunks[c])
+        concat = {c: (np.concatenate(v) if len(v) > 1 else v[0])
+                  for c, v in streams.items()}
+        decoded = ec_impl.decode(want, concat, 0)
+        off = 0
+        for i, ln in zip(idxs, lens):
+            results[i] = {c: np.asarray(decoded[c], dtype=np.uint8)
+                          [off:off + ln] for c in want}
+            off += ln
+    return results
+
+
 def decode_shards(sinfo: StripeInfo, ec_impl, available: dict[int, np.ndarray],
                   want: set, chunk_size: int = 0) -> dict[int, np.ndarray]:
     """Reconstruct specific shards (recovery path, ECUtil.cc:47-118 shape).

@@ -449,6 +449,12 @@ class ShardRepairOp:
     objects_repaired: int = 0
     failed: bool = False
     on_complete: object = None
+    # scheduler hand-off (ceph_tpu/recovery): with a driver attached the
+    # repair planner OFFERS the missing-object list instead of recovering
+    # inline; the driver paces it in waves through repair_wave and the
+    # not-yet-dispatched remainder parks here
+    driver: object = None
+    deferred: list = field(default_factory=list)
 
 
 @dataclass
@@ -543,8 +549,17 @@ class PGBackend:
         self._boot_peering: dict[int, PGLogInfo] | None = None
         self._boot_peering_expect: set[int] = set()
         self.shard_repairs: dict[int, "ShardRepairOp"] = {}
-        self._repair_write_tids: dict[int, tuple["ShardRepairOp", str]] = {}
+        # tid -> (rop, oid, on_done|None) for in-flight repair deletes
+        self._repair_write_tids: dict[int, tuple] = {}
         self._scan_waiters: dict[int, "ShardRepairOp"] = {}
+        # background repair orchestration (ceph_tpu/recovery): when a
+        # scheduler is attached, shard revival and stalled-recovery
+        # re-drives route through its reservation gate instead of firing
+        # inline; None keeps the pre-scheduler inline behavior
+        self.recovery_scheduler = None
+        # oid -> batched recovery wave with pushes in flight (the EC
+        # backend's decode_many-fused recovery path; empty elsewhere)
+        self._wave_pushes: dict[str, object] = {}
         bus.down_listeners.append(self.on_shard_down)
         bus.up_listeners.append(self.on_shard_up)
         # observability (SURVEY.md §5): counters + op tracking + admin cmds
@@ -749,6 +764,7 @@ class PGBackend:
         srop = self.shard_repairs.get(shard)
         if srop is not None:
             srop.failed = True
+            srop.deferred = []
             self._repair_write_tids = {
                 tid: v for tid, v in self._repair_write_tids.items()
                 if v[0] is not srop}
@@ -769,7 +785,13 @@ class PGBackend:
             # while mid-history entries are missing, defeating log catch-up
             self.stale.add(shard)
             if shard not in self.shard_repairs:
-                self.start_shard_repair(shard)
+                if self.recovery_scheduler is not None:
+                    # reservation-gated: the repair starts when the
+                    # scheduler grants this PG its local+remote slots
+                    self.recovery_scheduler.schedule_backend(
+                        self, targets=[shard])
+                else:
+                    self.start_shard_repair(shard)
         self._redrive_parked()
 
     def _redrive_parked(self) -> None:
@@ -777,17 +799,26 @@ class PGBackend:
         revival and on repair completion, when current_shards() grows)."""
         self._redrive_reads()
         stalled, self._stalled_recoveries = self._stalled_recoveries, []
-        for rop in stalled:
-            try:
-                self.continue_recovery_op(rop)
-            except IOError:
-                self._stalled_recoveries.append(rop)
+        if stalled and self.recovery_scheduler is not None:
+            # stalled recoveries must RE-ENTER via the scheduler
+            # (reservation-gated), not bypass it on shard revival
+            self.recovery_scheduler.requeue_stalled(self, stalled)
+        else:
+            for rop in stalled:
+                try:
+                    self.continue_recovery_op(rop)
+                except IOError:
+                    self._stalled_recoveries.append(rop)
         # a stale shard whose repair FAILED (a peer died mid-repair) gets a
         # fresh repair on the next cluster event — the role re-peering on
         # a map change plays in the reference
         for shard in sorted(self.stale & self.up_shards()):
             if shard not in self.shard_repairs:
-                self.start_shard_repair(shard)
+                if self.recovery_scheduler is not None:
+                    self.recovery_scheduler.schedule_backend(
+                        self, targets=[shard])
+                else:
+                    self.start_shard_repair(shard)
         self.check_ops()
 
     # -- write pipeline ----------------------------------------------------
@@ -885,8 +916,10 @@ class PGBackend:
         """(ECBackend.cc:1120-1152) -> try_finish_rmw (:2089)."""
         rep = self._repair_write_tids.pop(reply.tid, None)
         if rep is not None:                 # a shard-repair delete acked
-            rop, oid = rep
+            rop, oid, on_done = rep
             rop.pending.discard(("delete", oid))
+            if on_done:
+                on_done()
             self._maybe_finish_shard_repair(rop)
             return
         op = self.tid_to_op.get(reply.tid)
@@ -1081,6 +1114,15 @@ class PGBackend:
             self._finish_recovery_op(rop, failed=rop.failed)
 
     def handle_push_reply(self, reply: PushReply) -> None:
+        wave = self._wave_pushes.get(reply.oid)
+        if wave is not None and reply.from_shard in \
+                wave.pending_pushes.get(reply.oid, ()):
+            # a batched recovery wave's push.  The from_shard check
+            # disambiguates against a CONCURRENT per-object RecoveryOp
+            # for the same oid (e.g. scrub repair): replies the wave is
+            # not waiting on fall through to the per-object path below
+            self._wave_push_reply(wave, reply)
+            return
         rop = self.recovery_ops.get(reply.oid)
         if rop is None:
             return
@@ -1103,15 +1145,20 @@ class PGBackend:
     # (the role PGLog::merge_log + log-based recovery + backfill play in the
     # reference, src/osd/PGLog.cc)
 
-    def start_shard_repair(self, shard: int, on_complete=None
-                           ) -> ShardRepairOp:
+    def start_shard_repair(self, shard: int, on_complete=None,
+                           driver=None) -> ShardRepairOp:
         """Bring a revived/stale shard current.  Queries its log; replays
         exactly the missed entries when they are within the horizon, falls
         back to a scan+push backfill when not.  COMPLETE means the shard's
         data AND log match the authority's.  Works for the primary's own
         shard too: its local log lags the authority log by exactly the
         writes that committed while it was down, and the recovery pushes
-        self-deliver over the bus."""
+        self-deliver over the bus.
+
+        ``driver`` (a recovery-scheduler job) turns the repair into a
+        PACED one: the planner hands the missing-object list to
+        ``driver.offer_work`` and the driver dispatches it in waves via
+        :meth:`repair_wave` instead of recovering everything inline."""
         existing = self.shard_repairs.get(shard)
         if existing is not None:
             # one repair per shard at a time: revival auto-starts one, an
@@ -1127,7 +1174,7 @@ class PGBackend:
             return existing
         chunk = self.acting.index(shard)
         rop = ShardRepairOp(shard=shard, chunk=chunk,
-                            on_complete=on_complete)
+                            on_complete=on_complete, driver=driver)
         self.shard_repairs[shard] = rop
         self.bus.send(shard, PGLogQuery(self.whoami,
                                         since=self.pg_log.tail))
@@ -1270,6 +1317,10 @@ class PGBackend:
             return
         self.perf.inc("log_repairs")
         rop.state = RepairState.RECOVERING
+        if rop.driver is not None:
+            # scheduler-paced: the driver dispatches repair_wave batches
+            rop.driver.offer_work(self, rop, sorted(todo.items()))
+            return
         for oid, op in sorted(todo.items()):
             self._repair_one(rop, oid, op)
         self._maybe_finish_shard_repair(rop)
@@ -1306,10 +1357,13 @@ class PGBackend:
         # delta _maybe_finish_shard_repair catches up
         rop.caught_up_to = self.pg_log.head
         rop.state = RepairState.RECOVERING
-        for oid in sorted(authority):
-            self._repair_one(rop, oid, OP_MODIFY)
-        for oid in sorted(target_list - authority):
-            self._repair_one(rop, oid, OP_DELETE)
+        items = [(oid, OP_MODIFY) for oid in sorted(authority)] + \
+            [(oid, OP_DELETE) for oid in sorted(target_list - authority)]
+        if rop.driver is not None and items:
+            rop.driver.offer_work(self, rop, items)
+            return
+        for oid, op in items:
+            self._repair_one(rop, oid, op)
         self._maybe_finish_shard_repair(rop)
 
     def _local_oids(self) -> set[str]:
@@ -1320,41 +1374,126 @@ class PGBackend:
         return GObject(oid, self.whoami) in self.local_shard.store.objects
 
     def _repair_one(self, rop: ShardRepairOp, oid: str, op: str) -> None:
-        rop.objects_repaired += 1
         if op == OP_DELETE:
-            self.next_tid += 1
-            tid = self.next_tid
-            rop.pending.add(("delete", oid))
-            self._repair_write_tids[tid] = (rop, oid)
-            t = Transaction().remove(GObject(oid, rop.shard))
-            self.bus.send(rop.shard, ECSubWrite(self.whoami, tid, t))
+            self._repair_delete(rop, oid)
         else:
-            rop.pending.add(("recover", oid))
+            self._repair_recover_one(rop, oid)
 
-            def done(rec, _rop=rop, _oid=oid):
-                _rop.pending.discard(("recover", _oid))
-                if rec.state != RecoveryState.COMPLETE:
-                    _rop.failed = True
-                self._maybe_finish_shard_repair(_rop)
+    def _repair_delete(self, rop: ShardRepairOp, oid: str,
+                       on_done=None) -> None:
+        rop.objects_repaired += 1
+        self.next_tid += 1
+        tid = self.next_tid
+        rop.pending.add(("delete", oid))
+        self._repair_write_tids[tid] = (rop, oid, on_done)
+        t = Transaction().remove(GObject(oid, rop.shard))
+        self.bus.send(rop.shard, ECSubWrite(self.whoami, tid, t))
 
-            existing = self.recovery_ops.get(oid)
-            if existing is not None:
-                # one RecoveryOp per object at a time: chain behind it
-                prev = existing.on_complete
+    def _repair_bookkeeping(self, rop: ShardRepairOp, oid: str,
+                            ok: bool, on_done=None) -> None:
+        """ONE copy of the per-object completion accounting shared by the
+        chained per-object path and the batched wave path."""
+        rop.pending.discard(("recover", oid))
+        if not ok:
+            rop.failed = True
+        if on_done:
+            on_done()
+        self._maybe_finish_shard_repair(rop)
 
-                def chained(rec, _prev=prev, _oid=oid, _rop=rop,
-                            _done=done):
-                    if _prev:
-                        _prev(rec)
-                    self.recover_object(_oid, {_rop.chunk},
-                                        on_complete=_done)
-                existing.on_complete = chained
+    def _chain_or_recover(self, oid: str, missing: set[int],
+                          on_done) -> None:
+        """ONE RecoveryOp per object at a time: start the recovery, or
+        chain behind the in-flight op and re-issue when it completes —
+        the per-object serialization rule every repair path shares."""
+        existing = self.recovery_ops.get(oid)
+        if existing is None:
+            self.recover_object(oid, set(missing), on_complete=on_done)
+            return
+        prev = existing.on_complete
+
+        def chained(rec, _prev=prev, _oid=oid, _missing=frozenset(missing),
+                    _done=on_done):
+            if _prev:
+                _prev(rec)
+            self.recover_object(_oid, set(_missing), on_complete=_done)
+        existing.on_complete = chained
+
+    def _repair_recover_one(self, rop: ShardRepairOp, oid: str,
+                            on_done=None) -> None:
+        rop.objects_repaired += 1
+        rop.pending.add(("recover", oid))
+
+        def done(rec, _rop=rop, _oid=oid, _cb=on_done):
+            self._repair_bookkeeping(
+                _rop, _oid, rec.state == RecoveryState.COMPLETE, _cb)
+
+        self._chain_or_recover(oid, {rop.chunk}, done)
+
+    # -- paced repair waves (driven by ceph_tpu/recovery) ------------------
+
+    def repair_wave(self, rop: ShardRepairOp, items, on_done=None) -> None:
+        """Dispatch ONE wave of repair work: deletes go per-object (they
+        are cheap sub-writes), recovers batch through the subclass's
+        :meth:`_recover_many` (the EC backend fuses them into one
+        ``decode_shards_many`` dispatch).  ``on_done`` fires when every
+        item of THIS wave completed — the scheduler's cue to queue the
+        next wave (overall repair completion still flows through
+        ``_maybe_finish_shard_repair``)."""
+        remaining = {"n": 0}
+
+        def _item_done():
+            remaining["n"] -= 1
+            if remaining["n"] == 0 and on_done:
+                on_done()
+        recovers: list[str] = []
+        for oid, op in items:
+            remaining["n"] += 1
+            if op == OP_DELETE:
+                self._repair_delete(rop, oid, on_done=_item_done)
             else:
-                self.recover_object(oid, {rop.chunk}, on_complete=done)
+                recovers.append(oid)
+        if recovers:
+            self._repair_recover_many(rop, recovers, _item_done)
+        elif remaining["n"] == 0 and on_done:
+            on_done()
+
+    def _repair_recover_many(self, rop: ShardRepairOp, oids: list[str],
+                             each_done) -> None:
+        """Wave recovers: objects already mid-recovery (or mid-wave) take
+        the chained per-object path; the rest batch via _recover_many."""
+        batch: dict[str, set[int]] = {}
+        for oid in oids:
+            if oid in self.recovery_ops or oid in self._wave_pushes:
+                self._repair_recover_one(rop, oid, on_done=each_done)
+            else:
+                rop.objects_repaired += 1
+                rop.pending.add(("recover", oid))
+                batch[oid] = {rop.chunk}
+        if batch:
+            self._recover_many(
+                batch,
+                lambda oid, ok, _rop=rop, _cb=each_done:
+                    self._repair_bookkeeping(_rop, oid, ok, _cb))
+
+    def _recover_many(self, oids: dict[str, set[int]], on_each) -> None:
+        """Recover several objects; ``on_each(oid, ok)`` per object.  The
+        default is the per-object path (replicated pools have nothing to
+        batch); the EC backend overrides with the decode-fused wave."""
+        for oid, missing in sorted(oids.items()):
+            def done(rec, _oid=oid):
+                on_each(_oid, rec.state == RecoveryState.COMPLETE)
+            self.recover_object(oid, set(missing), on_complete=done)
+
+    def _wave_push_reply(self, wave, reply) -> None:
+        """Only the EC backend creates waves; a stray reply here means a
+        lifecycle bug, not a silent drop."""
+        raise TypeError(f"wave push reply for {reply.oid!r} on a backend "
+                        f"without a batched recovery path")
 
     def _maybe_finish_shard_repair(self, rop: ShardRepairOp) -> None:
-        if rop.state != RepairState.RECOVERING or rop.pending:
-            return
+        if rop.state != RepairState.RECOVERING or rop.pending or \
+                rop.deferred:
+            return                  # driver still holds undispatched waves
         # writes that committed while the repair was in flight skipped the
         # stale target (it is out of the fan-out): repair the delta before
         # declaring it current, else its log would claim writes whose data

@@ -200,6 +200,9 @@ class MiniCluster:
         # optional serving engine (enable_serving): cross-PG encode/decode
         # coalescing + admission throttles for every EC backend
         self.serving = None
+        # optional recovery scheduler (enable_recovery_scheduler):
+        # reservation-gated, prioritized, batch-fused background repair
+        self.recovery = None
         # telemetry spine (mgr/stats + mgr/health + flight recorder):
         # status() renders the stats digest, health() is a thin view over
         # the check engine, and any check entering WARN/ERR snapshots a
@@ -343,6 +346,34 @@ class MiniCluster:
                     g.backend.attach_serving(self.serving)
         return self.serving
 
+    def enable_recovery_scheduler(self, **kw):
+        """Attach a :class:`~ceph_tpu.recovery.RecoveryScheduler` to
+        every PG backend (current and future pools): shard revival,
+        peering activation, and stalled-recovery re-drives then route
+        through per-OSD local+remote reservations (``osd_max_backfills``),
+        Ceph-style priorities, and byte-rate-capped waves whose degraded
+        objects reconstruct through one batched decode dispatch."""
+        from .recovery import RecoveryScheduler
+        if self.recovery is None:
+            kw.setdefault("name", f"c{self.cluster_id}")
+            self.recovery = RecoveryScheduler(cct=self.cct, **kw)
+            from .mgr.health import pg_recovery_stalled_check
+            self.health_engine.register(
+                "PG_RECOVERY_STALLED",
+                pg_recovery_stalled_check(self.stats,
+                                          lambda: self.recovery),
+                description="degraded PGs queued for recovery but no "
+                            "reservation is progressing")
+        for pool in self.pools.values():
+            for g in pool["pgs"].values():
+                self._attach_recovery(g, pool["pool"])
+        return self.recovery
+
+    def _attach_recovery(self, g: PGGroup, pool: Pool) -> None:
+        self.recovery.attach_backend(
+            g.backend, pgid=g.pgid, daemon=self.osds[g.backend.whoami],
+            pool_params=pool.params)
+
     # -- pool creation (the mon's osd pool create path) --------------------
 
     def create_ec_pool(self, name: str, profile: dict | None = None,
@@ -423,6 +454,8 @@ class MiniCluster:
             self._arm_hit_sets(pgs[ps], pool)
             if self.serving is not None and ec is not None:
                 pgs[ps].backend.attach_serving(self.serving)
+            if self.recovery is not None:
+                self._attach_recovery(pgs[ps], pool)
         self.pools[pool.pool_id] = {"pool": pool, "pgs": pgs, "ec": ec}
         self.pool_ids[name] = pool.pool_id
         self._save_meta()
@@ -1071,6 +1104,8 @@ class MiniCluster:
         durable stores checkpoint and close."""
         if self.serving is not None:
             self.serving.stop()
+        if self.recovery is not None:
+            self.recovery.close()
         # telemetry spine down FIRST: a prometheus scrape racing the
         # teardown must not evaluate checks over half-closed PGs
         self.stats.close()
@@ -1185,6 +1220,9 @@ class MiniCluster:
         new.backend.inconsistent_objects |= damaged
         if self.serving is not None and ec is not None:
             new.backend.attach_serving(self.serving)
+        if self.recovery is not None:
+            self.recovery.cancel_pg(old.backend, reason="backfill remap")
+            self._attach_recovery(new, self.pools[pool_id]["pool"])
         self._arm_hit_sets(new, self.pools[pool_id]["pool"])
         self.pools[pool_id]["pgs"][ps] = new
         # re-home the PG on its (possibly new) primary's daemon
@@ -1272,7 +1310,7 @@ class MiniCluster:
                 n_pgs += 1
                 states[self.pg_state(g)] += 1
         self.stats.sample()
-        return {
+        st = {
             "osdmap": {"epoch": self.osdmap.epoch,
                        "num_osds": self.osdmap.max_osd,
                        "num_up_osds": sum(
@@ -1284,3 +1322,8 @@ class MiniCluster:
                                        if v},
                       "io_rates": self.stats.digest()},
         }
+        if self.recovery is not None:
+            # recovering/queued PG counts + reservation occupancy (the
+            # 'recovery:' block ceph -s renders next to the IO rates)
+            st["pgmap"]["recovery"] = self.recovery.summary()
+        return st

@@ -100,7 +100,31 @@ OPTIONS: list[Option] = [
            default=8 << 20,
            description="max recovery read size (rounded to stripe width)"),
     Option("osd_recovery_max_active", TYPE_UINT, LEVEL_ADVANCED, default=3,
-           description="concurrent recoveries per OSD"),
+           description="concurrent recoveries per OSD (the recovery "
+                       "scheduler's wave size: objects fused into one "
+                       "batched reconstruct dispatch)",
+           see_also=["osd_max_backfills",
+                     "osd_recovery_max_bytes_per_sec"]),
+    # -- recovery scheduler (ceph_tpu/recovery/): reservations + pacing ----
+    Option("osd_max_backfills", TYPE_UINT, LEVEL_ADVANCED, default=1,
+           min=0,
+           description="max concurrent recovery/backfill reservations "
+                       "per OSD (local and remote AsyncReserver "
+                       "max_allowed; 0 parks every job — useful to "
+                       "pause background repair)",
+           see_also=["osd_recovery_max_active"]),
+    Option("osd_recovery_max_bytes_per_sec", TYPE_SIZE, LEVEL_ADVANCED,
+           default=0,
+           description="token-bucket byte-rate cap on recovery waves "
+                       "per OSD (0 = uncapped); waves run post-paid and "
+                       "the next wave waits out the debt in virtual time",
+           see_also=["osd_recovery_sleep"]),
+    Option("osd_recovery_sleep", TYPE_FLOAT, LEVEL_ADVANCED, default=0.0,
+           min=0.0,
+           description="virtual-time pause between recovery waves "
+                       "(throttles background repair like the "
+                       "reference's recovery sleep)",
+           see_also=["osd_recovery_max_bytes_per_sec"]),
     Option("osd_heartbeat_interval", TYPE_INT, LEVEL_ADVANCED, default=6,
            description="seconds between peer heartbeats", min=1, max=60),
     Option("osd_heartbeat_grace", TYPE_INT, LEVEL_ADVANCED, default=20,

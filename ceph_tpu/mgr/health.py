@@ -284,6 +284,45 @@ def throttle_saturated_check(cct, ratio: float | None = None):
     return check
 
 
+def pg_recovery_stalled_check(stats, scheduler_getter):
+    """PG_RECOVERY_STALLED: degraded PGs sit in the recovery scheduler
+    but NOTHING progresses over the stats window — no reservation is
+    active (``osd_max_backfills`` exhausted or zeroed, a wedged grant
+    holder), or jobs hold grants yet zero objects recovered/replayed.
+    The queue-depth alone cannot distinguish 'busy' from 'stuck'; the
+    window delta of actual repair work is what does."""
+    def check():
+        sched = scheduler_getter()
+        if sched is None:
+            return None
+        queued, active = sched.job_counts()
+        if queued + active == 0:
+            return None
+        if stats.span() < 1.0:
+            # a sub-second window (or a single sample) holds no evidence
+            # of a stall — back-to-back scrapes must not page anyone
+            return None
+        from .stats import PG_PREFIXES
+        progress = (
+            stats.counter_delta("recoveries", PG_PREFIXES) +
+            stats.counter_delta("recovery_failures", PG_PREFIXES) +
+            stats.counter_delta("log_repairs_clean", PG_PREFIXES) +
+            stats.counter_delta("log_repair_objects", PG_PREFIXES) +
+            stats.counter_delta("backfill_objects", PG_PREFIXES) +
+            stats.counter_delta("wave_objects", ("recovery.",)))
+        if progress > 0:
+            return None
+        return CheckResult(
+            f"{queued + active} recovery job(s) "
+            f"({queued} queued, {active} active) with no repair "
+            f"progress in the last {stats.span():.0f}s",
+            detail=[f"job {key}: state={j.state.value} "
+                    f"priority={j.priority} targets={list(j.targets)}"
+                    for key, j in sorted(sched.jobs.items())],
+            count=queued + active)
+    return check
+
+
 def recompile_storm_check(cct, stats, threshold: float | None = None):
     """RECOMPILE_STORM: the traced_jit registry is compiling at more
     than ``mgr_recompile_storm_compiles`` per MINUTE over the stats

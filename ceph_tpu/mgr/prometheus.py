@@ -91,6 +91,34 @@ def _mclock_depth_gauges(family, prefix: str) -> None:
                 f'shard="0",op_class="{_sanitize(op_class)}"}} {depth}')
 
 
+def _recovery_reserver_gauges(family, prefix: str) -> None:
+    """``ceph_tpu_recovery_reserver_queued`` /
+    ``ceph_tpu_recovery_reserver_granted`` — per-OSD local/remote
+    reservation queue depth and in-flight grants of every live
+    RecoveryScheduler (the AsyncReserver occupancy an operator watches
+    to tell 'repair is pacing' from 'repair is wedged')."""
+    try:
+        from ..recovery.scheduler import live_schedulers
+    except Exception:                       # pragma: no cover
+        return
+    fams = {}
+    for sched in sorted(live_schedulers(), key=lambda s: s.name):
+        for kind, osd, depth, granted in sched.reserver_gauges():
+            for suffix, v, help_text in (
+                    ("queued", depth,
+                     "recovery reservations waiting per OSD reserver"),
+                    ("granted", granted,
+                     "recovery reservations in flight per OSD reserver")):
+                metric = f"{prefix}_recovery_reserver_{suffix}"
+                fam = fams.get(metric)
+                if fam is None:
+                    fam = fams[metric] = family(metric, "gauge",
+                                                help_text)
+                fam.lines.append(
+                    f'{metric}{{owner="{_sanitize(sched.name)}",'
+                    f'kind="{kind}",osd="{osd}"}} {v}')
+
+
 def _health_gauges(family, prefix: str) -> None:
     """``ceph_tpu_health_status{owner=...,check=...}`` — one gauge per
     REGISTERED check per live engine (0=ok, 1=warn, 2=err).  Evaluated
@@ -174,6 +202,7 @@ def render(cct=None, prefix: str = "ceph_tpu") -> str:
                 fam.lines.append(f"{metric}{{{label}}} {m.value}")
 
     _mclock_depth_gauges(family, prefix)
+    _recovery_reserver_gauges(family, prefix)
     _health_gauges(family, prefix)
     _stats_rate_gauges(family, prefix)
 

@@ -180,7 +180,15 @@ class StatsAggregator:
             },
             "recovery": {
                 "bytes_s": self.rate("recovery_bytes", PG_PREFIXES),
+                # objects-recovered/s: batched waves and the per-object
+                # machine both land on the backends' `recoveries` counter
                 "op_s": self.rate("recoveries", PG_PREFIXES),
+                # scheduler occupancy (0 when no scheduler is attached):
+                # queued/active PG jobs from the live recovery schedulers
+                "queued_pgs": self.gauge_sum("jobs_queued",
+                                             ("recovery.",)),
+                "active_pgs": self.gauge_sum("jobs_active",
+                                             ("recovery.",)),
             },
             "serving": {
                 "batch_s": self.rate("batches"),
@@ -204,6 +212,8 @@ class StatsAggregator:
             "client_rd_op_s": d["client_io"]["rd_op_s"],
             "recovery_bytes_s": d["recovery"]["bytes_s"],
             "recovery_op_s": d["recovery"]["op_s"],
+            "recovery_queued_pgs": d["recovery"]["queued_pgs"],
+            "recovery_active_pgs": d["recovery"]["active_pgs"],
             "serving_batch_s": d["serving"]["batch_s"],
             "serving_op_s": d["serving"]["op_s"],
             "serving_bytes_s": d["serving"]["bytes_s"],

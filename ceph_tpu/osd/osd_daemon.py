@@ -88,6 +88,19 @@ class OSDDaemon:
         self._ticks += 1e-3
         return self._ticks
 
+    def advance_clock(self, dt: float) -> None:
+        """Consume ``dt`` seconds of virtual time — how 'sleeping' works
+        in the cooperative model.  The recovery scheduler uses this for
+        ``osd_recovery_sleep`` and token-bucket debt between waves: the
+        pacing is real on the daemon clock (queue-wait accounting, mClock
+        tags) without ever blocking the single thread."""
+        if dt <= 0:
+            return
+        if self.clock is not None:
+            self.clock.advance(dt)
+        else:
+            self._ticks += dt
+
     def write_superblock(self) -> None:
         if self.meta_store is None:
             return

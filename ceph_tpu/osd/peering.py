@@ -93,6 +93,12 @@ class PeeringCoordinator:
         super-state's AdvMap reaction."""
         self.epoch = max(self.epoch, epoch)
         b = self.backend
+        sched = getattr(b, "recovery_scheduler", None)
+        if sched is not None:
+            # map change preempts background repair cleanly: the job's
+            # reservations release and the re-activation below queues a
+            # fresh one against the new acting-set reality
+            sched.cancel_pg(b)
         peers = {s for s in b.acting if s != b.whoami and s not in b.bus.down}
         self._infos = {}
         self._expect_infos = set(peers)
@@ -178,11 +184,22 @@ class PeeringCoordinator:
         b = self.backend
         self._enter(PState.ACTIVE)
         self.last_epoch_started = self.epoch
-        # queue recovery for stale/backfill peers through the existing
-        # repair machinery (GetMissing's product; the repair op itself
-        # picks log-replay vs backfill from the peer's reply)
-        for shard in sorted(self.repair_targets | self.backfill_targets):
-            if shard not in b.bus.down:
+        # queue recovery for stale/backfill peers: through the recovery
+        # scheduler's reservation gate when one is attached (priorities,
+        # osd_max_backfills, wave pacing), else inline through the repair
+        # machinery (GetMissing's product; the repair op itself picks
+        # log-replay vs backfill from the peer's reply)
+        targets = [shard
+                   for shard in sorted(self.repair_targets |
+                                       self.backfill_targets)
+                   if shard not in b.bus.down]
+        sched = getattr(b, "recovery_scheduler", None)
+        if sched is not None and targets:
+            sched.schedule_backend(
+                b, targets=targets,
+                backfill=frozenset(self.backfill_targets))
+        else:
+            for shard in targets:
                 b.start_shard_repair(shard)
         # an Active PG serves: re-drive writes parked while peering
         b._redrive_parked()

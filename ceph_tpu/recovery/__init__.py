@@ -1,0 +1,25 @@
+"""Background repair orchestration: reservations, priorities, waves.
+
+``reserver``  — :class:`AsyncReserver`, the prioritized/preemptible
+                reservation gate (common/AsyncReserver.h analog);
+``scheduler`` — :class:`RecoveryScheduler` + :class:`PGRecoveryJob`,
+                the per-OSD recovery admission machine with batch-fused
+                waves (ecutil.decode_shards_many) and token-bucket
+                byte-rate pacing.
+"""
+from .reserver import AsyncReserver, ReserverStats
+from .scheduler import (OSD_BACKFILL_PRIORITY_BASE,
+                        OSD_RECOVERY_INACTIVE_PRIORITY_BASE,
+                        OSD_RECOVERY_PRIORITY_BASE,
+                        OSD_RECOVERY_PRIORITY_FORCED,
+                        OSD_RECOVERY_PRIORITY_MAX,
+                        JobState, PGRecoveryJob, RecoveryScheduler,
+                        live_schedulers)
+
+__all__ = [
+    "AsyncReserver", "ReserverStats", "RecoveryScheduler",
+    "PGRecoveryJob", "JobState", "live_schedulers",
+    "OSD_RECOVERY_PRIORITY_BASE", "OSD_BACKFILL_PRIORITY_BASE",
+    "OSD_RECOVERY_INACTIVE_PRIORITY_BASE", "OSD_RECOVERY_PRIORITY_MAX",
+    "OSD_RECOVERY_PRIORITY_FORCED",
+]

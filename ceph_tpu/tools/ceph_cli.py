@@ -220,9 +220,14 @@ def _fmt_io_lines(rates: dict | None) -> str:
              f"{_fmt_bytes_s(cl['wr_bytes_s'])} wr, "
              f"{cl['rd_op_s']:.0f} op/s rd, {cl['wr_op_s']:.0f} op/s wr"]
     rec = rates["recovery"]
-    if rec["bytes_s"] or rec["op_s"]:
-        lines.append(f"    recovery: {_fmt_bytes_s(rec['bytes_s'])}, "
-                     f"{rec['op_s']:.0f} obj/s")
+    queued = int(rec.get("queued_pgs", 0))
+    active = int(rec.get("active_pgs", 0))
+    if rec["bytes_s"] or rec["op_s"] or queued or active:
+        line = (f"    recovery: {_fmt_bytes_s(rec['bytes_s'])}, "
+                f"{rec['op_s']:.0f} obj/s")
+        if queued or active:
+            line += f" ({active} pgs recovering, {queued} queued)"
+        lines.append(line)
     srv = rates["serving"]
     if srv["op_s"]:
         lines.append(f"    serving:  {srv['op_s']:.0f} op/s in "
@@ -234,6 +239,17 @@ def _fmt_io_lines(rates: dict | None) -> str:
 def _fmt_status(st: dict, h: dict) -> str:
     states = ", ".join(f"{n} {s}" for s, n in
                        sorted(st["pgmap"]["pgs_by_state"].items()))
+    # the recovery scheduler's block (queued/recovering PG jobs and
+    # reservation occupancy), present only when a scheduler is attached
+    rec = st["pgmap"].get("recovery")
+    rec_line = ""
+    if rec and (rec["queued_pgs"] or rec["active_pgs"] or
+                rec["reservations"]["granted"] or
+                rec["reservations"]["queued"]):
+        rec_line = (f"\n    recovery: {rec['active_pgs']} pgs "
+                    f"recovering, {rec['queued_pgs']} queued; "
+                    f"reservations: {rec['reservations']['granted']} "
+                    f"in-flight, {rec['reservations']['queued']} waiting")
     return (f"  cluster:\n    health: {_health_line(h)}\n"
             f"  services:\n"
             f"    osd: {st['osdmap']['num_osds']} osds: "
@@ -243,6 +259,7 @@ def _fmt_status(st: dict, h: dict) -> str:
             f"    pools:   {st['pgmap']['num_pools']} pools, "
             f"{st['pgmap']['num_pgs']} pgs\n"
             f"    pgs:     {states}"
+            + rec_line
             + _fmt_io_lines(st["pgmap"].get("io_rates")))
 
 
@@ -261,8 +278,16 @@ def render_top(c) -> str:
     lines.append(f"client io: {_fmt_bytes_s(cl['rd_bytes_s'])} rd, "
                  f"{_fmt_bytes_s(cl['wr_bytes_s'])} wr, "
                  f"{cl['rd_op_s']:.0f}/{cl['wr_op_s']:.0f} op/s rd/wr")
-    lines.append(f"recovery:  {_fmt_bytes_s(d['recovery']['bytes_s'])}, "
-                 f"{d['recovery']['op_s']:.0f} obj/s")
+    rec = d["recovery"]
+    rec_line = (f"recovery:  {_fmt_bytes_s(rec['bytes_s'])}, "
+                f"{rec['op_s']:.0f} obj/s")
+    if getattr(c, "recovery", None) is not None:
+        s = c.recovery.summary()
+        rec_line += (f", {s['active_pgs']} pgs recovering / "
+                     f"{s['queued_pgs']} queued, "
+                     f"{s['reservations']['granted']} reservations "
+                     f"in-flight")
+    lines.append(rec_line)
     lines.append(f"serving:   {d['serving']['op_s']:.0f} op/s, "
                  f"{d['serving']['batch_s']:.0f} batch/s")
     lines.append(f"jit:       {d['jit']['compiles']:.0f} compiles, "

@@ -241,6 +241,7 @@ class TestStatsAggregator:
             assert set(flat) == {
                 "client_wr_bytes_s", "client_rd_bytes_s", "client_wr_op_s",
                 "client_rd_op_s", "recovery_bytes_s", "recovery_op_s",
+                "recovery_queued_pgs", "recovery_active_pgs",
                 "serving_batch_s", "serving_op_s", "serving_bytes_s",
                 "jit_compiles", "jit_cache_hits"}
         finally:

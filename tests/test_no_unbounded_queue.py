@@ -1,12 +1,16 @@
-"""Guard: every queue constructed in ``ceph_tpu/exec/`` is bounded.
+"""Guard: every queue constructed in ``ceph_tpu/exec/`` and
+``ceph_tpu/recovery/`` is bounded.
 
 The serving subsystem exists to put BOUNDS between demand and the device
 (ISSUE 2's backpressure contract: once a throttle limit is hit,
-submission blocks or fails fast and queue depth/bytes stay bounded).  An
-unbounded ``deque()``/``Queue()`` smuggled into ``exec/`` silently voids
+submission blocks or fails fast and queue depth/bytes stay bounded), and
+the recovery subsystem exists to put bounds between damage and repair
+bandwidth (ISSUE 4: reservations, wave sizes, byte-rate caps).  An
+unbounded ``deque()``/``Queue()`` smuggled into either silently voids
 that contract under overload — this guard fails the build instead
 (mirrors the ``tests/test_no_bare_time.py`` pattern: discipline as a
-test).
+test).  The recovery package's lists are bounded by construction (one
+reservation per distinct PG); the guard keeps stdlib queue types out.
 
 Checked constructors (by AST, so multiline calls and aliases through
 ``collections.deque``/``queue.Queue`` are caught):
@@ -25,7 +29,8 @@ import ast
 from pathlib import Path
 
 ROOT = Path(__file__).resolve().parent.parent
-SCAN_DIR = ROOT / "ceph_tpu" / "exec"
+SCAN_DIRS = (ROOT / "ceph_tpu" / "exec",
+             ROOT / "ceph_tpu" / "recovery")
 
 _QUEUE_CTORS = {"Queue", "LifoQueue", "PriorityQueue"}
 
@@ -71,17 +76,20 @@ def _scan(path: Path) -> list[str]:
     return offenders
 
 
-def test_exec_package_exists_and_scans():
-    files = sorted(SCAN_DIR.rglob("*.py"))
-    assert files, "ceph_tpu/exec/ vanished — update or remove this guard"
+def test_scanned_packages_exist():
+    for scan_dir in SCAN_DIRS:
+        files = sorted(scan_dir.rglob("*.py"))
+        assert files, (f"{scan_dir.name}/ vanished — update or remove "
+                       f"this guard")
 
 
-def test_every_queue_in_exec_is_bounded():
+def test_every_queue_in_scanned_packages_is_bounded():
     offenders = []
-    for path in sorted(SCAN_DIR.rglob("*.py")):
-        offenders.extend(_scan(path))
+    for scan_dir in SCAN_DIRS:
+        for path in sorted(scan_dir.rglob("*.py")):
+            offenders.extend(_scan(path))
     assert not offenders, (
-        "unbounded queues in the serving subsystem — pass an explicit "
+        "unbounded queues in a bounded subsystem — pass an explicit "
         "bound (the backpressure contract):\n" + "\n".join(offenders))
 
 

@@ -1,0 +1,475 @@
+"""Recovery orchestrator: reserver semantics, scheduler integration,
+batch-fused waves, rate caps, stalled-op gating, health surfacing.
+
+Reference analogs: common/AsyncReserver.h (priorities, max_allowed,
+preemption), the OSD's local/remote recovery reservations +
+osd_max_backfills / osd_recovery_max_active / osd_recovery_sleep
+(src/osd/OSD.cc, src/common/options.cc), and the PG recovery priority
+ladder (PeeringState::get_recovery_priority).
+"""
+import numpy as np
+import pytest
+
+from ceph_tpu.cluster import MiniCluster
+from ceph_tpu.recovery import (AsyncReserver,
+                               OSD_RECOVERY_INACTIVE_PRIORITY_BASE,
+                               OSD_RECOVERY_PRIORITY_BASE,
+                               OSD_RECOVERY_PRIORITY_FORCED)
+
+
+class TestAsyncReserver:
+    def test_fifo_within_priority_and_max_allowed(self):
+        r = AsyncReserver("t", max_allowed=2)
+        granted = []
+        for i in range(4):
+            r.request_reservation(f"pg{i}", lambda _i=i: granted.append(_i),
+                                  prio=10)
+        assert granted == [0, 1]
+        assert r.in_flight() == 2 and r.queue_depth() == 2
+        r.cancel_reservation("pg0")
+        assert granted == [0, 1, 2]          # FIFO promotion
+        r.cancel_reservation("pg1")
+        assert granted == [0, 1, 2, 3]
+
+    def test_higher_priority_granted_first(self):
+        r = AsyncReserver("t", max_allowed=1)
+        granted = []
+        r.request_reservation("low1", lambda: granted.append("low1"),
+                              prio=1)
+        r.request_reservation("low2", lambda: granted.append("low2"),
+                              prio=1)
+        r.request_reservation("high", lambda: granted.append("high"),
+                              prio=200)
+        r.cancel_reservation("low1")         # the holder releases
+        assert granted == ["low1", "high"]
+        r.cancel_reservation("high")
+        assert granted == ["low1", "high", "low2"]
+
+    def test_preemption_fires_on_preempt_and_regrants(self):
+        r = AsyncReserver("t", max_allowed=1)
+        events = []
+        r.request_reservation("low", lambda: events.append("grant-low"),
+                              prio=10,
+                              on_preempt=lambda: events.append("preempt"))
+        r.request_reservation("high", lambda: events.append("grant-high"),
+                              prio=220)
+        assert events == ["grant-low", "preempt", "grant-high"]
+        assert r.has_reservation("high") and not r.has_reservation("low")
+        assert r.stats.preemptions == 1
+
+    def test_non_preemptible_holder_is_never_preempted(self):
+        r = AsyncReserver("t", max_allowed=1)
+        events = []
+        r.request_reservation("holder", lambda: events.append("h"),
+                              prio=10)      # no on_preempt: not preemptible
+        r.request_reservation("high", lambda: events.append("high"),
+                              prio=255)
+        assert events == ["h"]
+        assert r.has_reservation("holder")
+        r.cancel_reservation("holder")
+        assert events == ["h", "high"]
+
+    def test_equal_priority_does_not_preempt(self):
+        r = AsyncReserver("t", max_allowed=1)
+        events = []
+        r.request_reservation("a", lambda: events.append("a"), prio=10,
+                              on_preempt=lambda: events.append("pre-a"))
+        r.request_reservation("b", lambda: events.append("b"), prio=10)
+        assert events == ["a"]               # strictly-higher only
+
+    def test_cancel_queued_and_idempotent(self):
+        r = AsyncReserver("t", max_allowed=1)
+        r.request_reservation("a", lambda: None, prio=1)
+        r.request_reservation("b", lambda: None, prio=1)
+        assert r.cancel_reservation("b") is True    # still queued
+        assert r.cancel_reservation("b") is False   # idempotent
+        assert r.queue_depth() == 0
+
+    def test_duplicate_request_rejected(self):
+        r = AsyncReserver("t", max_allowed=1)
+        r.request_reservation("a", lambda: None, prio=1)
+        with pytest.raises(ValueError):
+            r.request_reservation("a", lambda: None, prio=2)
+
+    def test_update_priority_reorders_queue(self):
+        r = AsyncReserver("t", max_allowed=1)
+        granted = []
+        r.request_reservation("hold", lambda: granted.append("hold"),
+                              prio=10)
+        r.request_reservation("x", lambda: granted.append("x"), prio=1)
+        r.request_reservation("y", lambda: granted.append("y"), prio=2)
+        r.update_priority("x", 100)
+        r.cancel_reservation("hold")
+        assert granted == ["hold", "x"]
+
+    def test_set_max_grants_backlog(self):
+        r = AsyncReserver("t", max_allowed=0)
+        granted = []
+        r.request_reservation("a", lambda: granted.append("a"), prio=1)
+        assert granted == []
+        r.set_max(1)
+        assert granted == ["a"]
+        assert r.stats.peak_in_flight == 1
+
+    def test_reentrant_request_from_grant_callback(self):
+        r = AsyncReserver("t", max_allowed=1)
+        granted = []
+
+        def grant_a():
+            granted.append("a")
+            r.request_reservation("b", lambda: granted.append("b"), prio=1)
+            r.cancel_reservation("a")
+        r.request_reservation("a", grant_a, prio=1)
+        assert granted == ["a", "b"]
+
+    def test_dump_shape(self):
+        r = AsyncReserver("t", max_allowed=1)
+        r.request_reservation("a", lambda: None, prio=5)
+        r.request_reservation("b", lambda: None, prio=7)
+        d = r.dump()
+        assert d["in_progress"] == {"'a'": 5}
+        assert d["queues"] == {7: ["'b'"]}
+        assert d["stats"]["grants"] == 1
+
+
+K, M = 2, 2
+CHUNK = 512
+
+
+def _degraded_cluster(n_objects=12, conf=None, pg_num=2):
+    """Cluster with a scheduler, one revived-stale shard per PG holding
+    ``n_objects`` missed writes — NOT yet delivered, so the caller
+    observes the queued/granted states before repair runs.  A FRESH
+    Context per cluster: conf knobs must not leak into other tests
+    through the process-global default context."""
+    from ceph_tpu.common import Context
+    c = MiniCluster(n_osds=9, osds_per_host=3, chunk_size=CHUNK,
+                    cct=Context())
+    for key, value in (conf or {}).items():
+        c.cct.conf.set(key, value)
+    sched = c.enable_recovery_scheduler()
+    pid = c.create_ec_pool(
+        "p", {"k": str(K), "m": str(M), "device": "numpy",
+              "technique": "reed_sol_van"}, pg_num=pg_num)
+    rng = np.random.default_rng(7)
+    data = {}
+    for i in range(n_objects):
+        oid = f"obj{i}"
+        data[oid] = rng.integers(0, 256, 3 * CHUNK * K,
+                                 np.uint8).tobytes()
+        c.put(pid, oid, data[oid])
+    victims = {}
+    for g in c.pools[pid]["pgs"].values():
+        victims[id(g)] = g.acting[1]
+        g.bus.mark_down(g.acting[1])
+    for oid in list(data):
+        data[oid] = rng.integers(0, 256, 3 * CHUNK * K,
+                                 np.uint8).tobytes()
+        c.put(pid, oid, data[oid])
+    for g in c.pools[pid]["pgs"].values():
+        g.bus.mark_up(victims[id(g)])
+    return c, sched, pid, data
+
+
+class TestSchedulerCluster:
+    def test_revival_recovers_reservation_gated(self):
+        c, sched, pid, data = _degraded_cluster()
+        try:
+            c.deliver_all()
+            for g in c.pools[pid]["pgs"].values():
+                assert not g.backend.stale
+                assert not g.backend.shard_repairs
+            for oid, want in data.items():
+                assert c.get(pid, oid, len(want)) == want
+            # jobs drained, reservations released
+            assert sched.jobs == {}
+            assert sched.summary()["reservations"]["granted"] == 0
+            assert sched.perf.get("jobs_completed") >= 1
+            assert sched.perf.get("waves") >= 1
+            assert sched.perf.get("wave_objects") >= len(data) // 2
+            # the reservation gate was actually enforced
+            bound = c.cct.conf.get("osd_max_backfills")
+            for table in (sched._local, sched._remote):
+                for r in table.values():
+                    assert r.stats.peak_in_flight <= bound
+        finally:
+            c.shutdown()
+
+    def test_batched_waves_fuse_decodes(self):
+        """A wave's objects share one decode dispatch per survivor
+        signature — far fewer codec calls than objects recovered."""
+        conf = {"osd_recovery_max_active": 6}
+        c, sched, pid, data = _degraded_cluster(n_objects=12, conf=conf,
+                                                pg_num=1)
+        try:
+            ec = c.pools[pid]["ec"]
+            calls = {"n": 0}
+            orig = ec.decode
+
+            def counting(want, chunks, chunk_size=0):
+                calls["n"] += 1
+                return orig(want, chunks, chunk_size)
+            ec.decode = counting
+            c.deliver_all()
+            ec.decode = orig
+            recovered = sum(
+                g.backend.perf.get("recoveries")
+                for g in c.pools[pid]["pgs"].values())
+            assert recovered >= 12
+            # 12 objects, wave size 6, one survivor signature: ~2 decode
+            # dispatches (vs 12 per-object) — allow slack for re-reads
+            assert 0 < calls["n"] <= recovered // 2
+            for oid, want in data.items():
+                assert c.get(pid, oid, len(want)) == want
+        finally:
+            c.shutdown()
+
+    def test_tight_caps_still_drain(self):
+        """osd_recovery_max_active=1 + a byte-rate cap + recovery sleep:
+        repair completes (post-paid token bucket guarantees progress)
+        and pacing produced one wave per object."""
+        conf = {"osd_recovery_max_active": 1,
+                "osd_recovery_max_bytes_per_sec": 16 * 1024,
+                "osd_recovery_sleep": 0.002}
+        c, sched, pid, data = _degraded_cluster(n_objects=8, conf=conf)
+        try:
+            c.deliver_all()
+            for g in c.pools[pid]["pgs"].values():
+                assert not g.backend.stale
+            for oid, want in data.items():
+                assert c.get(pid, oid, len(want)) == want
+            assert sched.perf.get("waves") >= 8   # one object per wave
+            for oid in data:
+                g = c.pg_group(pid, oid)
+                rep = g.backend.be_deep_scrub(oid)
+                assert all(rep.values()), (oid, rep)
+        finally:
+            c.shutdown()
+
+    def test_zero_backfills_parks_jobs_and_health_fires(self):
+        """osd_max_backfills=0 parks every job (pause background repair);
+        PG_RECOVERY_STALLED fires once the stats window shows no
+        progress; raising the bound drains the backlog and clears it."""
+        conf = {"osd_max_backfills": 0}
+        c, sched, pid, data = _degraded_cluster(n_objects=4, conf=conf)
+        try:
+            c.deliver_all()
+            queued, active = sched.job_counts()
+            assert queued >= 1 and active == 0
+            for g in c.pools[pid]["pgs"].values():
+                assert g.backend.stale        # repair never started
+            # two samples spanning >= 1s of (injected) time: enough
+            # window to judge that nothing progressed
+            c.stats.sample(now=100.0)
+            c.stats.sample(now=110.0)
+            ev = c.health_detail()
+            assert "PG_RECOVERY_STALLED" in ev["checks"]
+            # unblock live: the conf observer re-bounds every existing
+            # reserver (osd_max_backfills is live-tunable)
+            c.cct.conf.set("osd_max_backfills", 1)
+            c.deliver_all()
+            for g in c.pools[pid]["pgs"].values():
+                assert not g.backend.stale
+            assert "PG_RECOVERY_STALLED" not in c.health_detail()["checks"]
+        finally:
+            c.shutdown()
+
+    def test_forced_priority_preempts_running_job(self):
+        """A forced (prio 255) escalation of a job queued behind another
+        PG's remote reservation preempts the holder; the preempted PG
+        requeues and both still converge."""
+        c, sched, pid, data = _degraded_cluster(n_objects=8, pg_num=2)
+        try:
+            # both PGs' jobs are mid-acquisition (nothing delivered
+            # yet); find a remote reserver where one holds and another
+            # queues, and force-escalate the QUEUED one
+            contended = next((r for r in sched._remote.values()
+                              if r.queue_depth() and r.in_flight()),
+                             None)
+            assert contended is not None, \
+                "expected both PGs to contend for a shared remote slot"
+            (job_key, _shard) = next(iter(contended._queued))
+            sched.schedule_backend(sched.jobs[job_key].backend,
+                                   forced=True)
+            assert sched.perf.get("preemptions") >= 1
+            c.deliver_all()
+            for g in c.pools[pid]["pgs"].values():
+                assert not g.backend.stale
+            for oid, want in data.items():
+                assert c.get(pid, oid, len(want)) == want
+            assert sched.jobs == {}
+        finally:
+            c.shutdown()
+
+    def test_target_merged_mid_batch_restarts_and_drains(self):
+        """A shard reviving while another's repair is mid-flight merges
+        into the job and RESTARTS the batch (the new shard may be the
+        one the in-flight recoveries are waiting on); the aborted repair
+        deregisters so the restart starts fresh, and everything drains —
+        no shard left stale with the scheduler idle."""
+        c, sched, pid, data = _degraded_cluster(n_objects=6, pg_num=1)
+        try:
+            g = next(iter(c.pools[pid]["pgs"].values()))
+            v2 = g.acting[2]
+            # partially drive the first victim's repair (log query +
+            # some recovery traffic in flight), then revive a SECOND
+            # stale shard mid-batch
+            g.bus.mark_down(v2)
+            for _ in range(6):
+                for shard in list(g.bus.queues):
+                    g.bus.deliver_one(shard)
+            g.bus.mark_up(v2)
+            c.deliver_all()
+            assert not g.backend.stale
+            assert not g.backend.shard_repairs
+            assert sched.jobs == {}
+            assert sched.summary()["reservations"]["granted"] == 0
+            for oid, want in data.items():
+                assert c.get(pid, oid, len(want)) == want
+        finally:
+            c.shutdown()
+
+    def test_sibling_waves_sharing_an_oid_both_drain(self):
+        """Two stale shards of ONE PG repair concurrently (one batch)
+        and miss the SAME objects: their waves collide on the per-oid
+        push slot — the loser must re-drive per object, not drop its
+        push replies and wedge the job holding every reservation."""
+        from ceph_tpu.common import Context
+        c = MiniCluster(n_osds=14, osds_per_host=7, chunk_size=CHUNK,
+                        cct=Context())
+        sched = c.enable_recovery_scheduler()
+        pid = c.create_ec_pool(
+            "p", {"k": "4", "m": "3", "device": "numpy",
+                  "technique": "reed_sol_van"}, pg_num=1)
+        try:
+            g = next(iter(c.pools[pid]["pgs"].values()))
+            rng = np.random.default_rng(9)
+            data = {f"w{i}": rng.integers(0, 256, 2 * CHUNK * 4,
+                                          np.uint8).tobytes()
+                    for i in range(6)}
+            for oid, d in data.items():
+                c.put(pid, oid, d)
+            v1, v2 = g.acting[1], g.acting[2]
+            g.bus.mark_down(v1)
+            g.bus.mark_down(v2)
+            for oid in data:                # both victims miss these
+                data[oid] = rng.integers(0, 256, 2 * CHUNK * 4,
+                                         np.uint8).tobytes()
+                c.put(pid, oid, data[oid])
+            g.bus.mark_up(v1)
+            g.bus.mark_up(v2)
+            c.deliver_all()
+            assert not g.backend.stale
+            assert sched.jobs == {}
+            assert sched.summary()["reservations"]["granted"] == 0
+            for oid, want in data.items():
+                assert c.get(pid, oid, len(want)) == want
+            rep = c.scrub_pool(pid, repair=False)
+            assert rep == {}, rep
+        finally:
+            c.shutdown()
+
+    def test_stalled_recoveries_requeue_via_scheduler(self):
+        """A recovery parked by unrecoverable shard loss re-enters
+        through the scheduler's reservation gate on revival — it must
+        not bypass it on on_shard_up."""
+        c, sched, pid, data = _degraded_cluster(n_objects=2)
+        try:
+            c.deliver_all()                     # converge first
+            oid = sorted(data)[0]
+            g = c.pg_group(pid, oid)
+            # drop to exactly k current shards, then ask for a recovery
+            # of one of the SURVIVORS' chunks: only k-1 sources remain —
+            # the op parks
+            downed = [s for s in g.acting[2:]][:M]
+            for s in downed:
+                g.bus.mark_down(s)
+            g.backend.recover_object(oid, {1})
+            assert g.backend._stalled_recoveries
+            before = sched.perf.get("stalled_requeued")
+            for s in downed:
+                g.bus.mark_up(s)
+            c.deliver_all()
+            assert sched.perf.get("stalled_requeued") > before
+            assert not g.backend._stalled_recoveries
+            assert not g.backend.recovery_ops
+            assert c.get(pid, oid, len(data[oid])) == data[oid]
+        finally:
+            c.shutdown()
+
+    def test_preemption_survives_batch_restart(self):
+        """A batch restart (new target merged mid-flight) bumps the
+        job's wave generation but must NOT stale the local grant's
+        preempt callback: a later higher-priority claimant still
+        preempts the job (abort + requeue), it does not run alongside
+        the intruder past osd_max_backfills."""
+        from ceph_tpu.recovery import JobState
+        c, sched, pid, data = _degraded_cluster(n_objects=4, pg_num=1)
+        try:
+            g = next(iter(c.pools[pid]["pgs"].values()))
+            job = sched.jobs[g.backend.instance_name]
+            assert job.state is JobState.RUNNING
+            # merge a second target mid-batch: restarts the batch
+            v2 = g.acting[2]
+            g.bus.mark_down(v2)
+            g.bus.mark_up(v2)
+            # a prio-255 claimant takes the local slot: the job must
+            # abort cleanly and requeue, releasing its remote holds
+            granted = []
+            sched.local_reserver(g.backend.whoami).request_reservation(
+                "intruder", lambda: granted.append(1), prio=255)
+            assert granted == [1]
+            assert sched.perf.get("preemptions") >= 1
+            assert job.state is JobState.QUEUED
+            assert not job.remote_held
+            sched.local_reserver(g.backend.whoami).cancel_reservation(
+                "intruder")
+            c.deliver_all()
+            assert not g.backend.stale
+            for oid, want in data.items():
+                assert c.get(pid, oid, len(want)) == want
+        finally:
+            c.shutdown()
+
+    def test_priority_ladder(self):
+        c, sched, pid, _data = _degraded_cluster(n_objects=2)
+        try:
+            g = next(iter(c.pools[pid]["pgs"].values()))
+            b = g.backend
+            prio = sched.pg_priority(b)
+            assert prio >= OSD_RECOVERY_PRIORITY_BASE
+            assert sched.pg_priority(b, forced=True) == \
+                OSD_RECOVERY_PRIORITY_FORCED
+            # pool recovery_priority biases within the band (clamped)
+            assert sched.pg_priority(b, {"recovery_priority": "5"}) == \
+                prio + 5
+            assert sched.pg_priority(b, {"recovery_priority": "99"}) == \
+                prio + 10
+            # drive the PG inactive: priority escalates past every
+            # ordinary recovery
+            downed = [s for s in g.acting[1:]][:M + 1]
+            for s in downed:
+                g.bus.mark_down(s)
+            assert not b.is_active()
+            assert sched.pg_priority(b) >= \
+                OSD_RECOVERY_INACTIVE_PRIORITY_BASE
+            for s in downed:
+                g.bus.mark_up(s)
+            c.deliver_all()
+        finally:
+            c.shutdown()
+
+    def test_status_and_top_render_recovery(self):
+        c, sched, pid, _data = _degraded_cluster(n_objects=2)
+        try:
+            st = c.status()
+            assert "recovery" in st["pgmap"]
+            assert set(st["pgmap"]["recovery"]) == \
+                {"queued_pgs", "active_pgs", "reservations"}
+            rates = st["pgmap"]["io_rates"]["recovery"]
+            assert "queued_pgs" in rates and "op_s" in rates
+            from ceph_tpu.tools.ceph_cli import render_top
+            assert "recovery:" in render_top(c)
+            c.deliver_all()
+        finally:
+            c.shutdown()

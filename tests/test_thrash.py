@@ -29,23 +29,11 @@ ROUNDS = 200
 MAX_DOWN = K + M - 1     # past m: PGs may lose up to 5 of 6 shards
 
 
-@pytest.fixture(scope="module")
-def thrashed():
-    """Run the whole thrash campaign once; individual tests assert on the
-    final state."""
-    rng = np.random.default_rng(20260729)
-    cluster = MiniCluster(n_osds=12, chunk_size=CHUNK)
-    pid = cluster.create_ec_pool(
-        "thrash", {"plugin": "jax_rs", "k": str(K), "m": str(M),
-                   "device": "numpy", "technique": "reed_sol_van"},
-        pg_num=8)
-    # messenger-level fault injection rides along with the kills: every
-    # message may be duplicated and cross-sender delivery order at each
-    # destination is randomized (per-sender FIFO preserved, like TCP)
-    from ceph_tpu.backend.messages import FaultConfig
-    for i, g in enumerate(cluster.pools[pid]["pgs"].values()):
-        g.bus.inject_faults(FaultConfig(seed=i * 7 + 1, reorder=True,
-                                        dup_prob=0.1))
+def _run_campaign(cluster, pid, rng, rounds):
+    """The thrash campaign body, shared by the inline-recovery fixture
+    and the recovery-scheduler soak variant: randomized kills/revives
+    past m under live writes/reads, model-checked, then full revival and
+    convergence.  Returns (model, log)."""
     model: dict[str, bytes] = {}
     down: set[int] = set()
     log = []
@@ -138,7 +126,7 @@ def thrashed():
             log.append(f"  (mid-write of {oid})")
         cluster.deliver_all()
 
-    for _ in range(ROUNDS):
+    for _ in range(rounds):
         action = rng.random()
         if action < 0.40:
             do_write()
@@ -170,8 +158,39 @@ def thrashed():
             g.bus.deliver_all()
             if g.backend.stale or g.backend.shard_repairs:
                 busy = True
+        if cluster.recovery is not None and cluster.recovery.jobs:
+            busy = True
         if not busy:
             break
+    return model, log
+
+
+def _build_cluster(seed_offset=0):
+    # fresh Context per campaign: the scheduled variant's conf knobs
+    # must not leak into other tests via the process-global default
+    from ceph_tpu.common import Context
+    cluster = MiniCluster(n_osds=12, chunk_size=CHUNK, cct=Context())
+    pid = cluster.create_ec_pool(
+        "thrash", {"plugin": "jax_rs", "k": str(K), "m": str(M),
+                   "device": "numpy", "technique": "reed_sol_van"},
+        pg_num=8)
+    # messenger-level fault injection rides along with the kills: every
+    # message may be duplicated and cross-sender delivery order at each
+    # destination is randomized (per-sender FIFO preserved, like TCP)
+    from ceph_tpu.backend.messages import FaultConfig
+    for i, g in enumerate(cluster.pools[pid]["pgs"].values()):
+        g.bus.inject_faults(FaultConfig(seed=i * 7 + 1 + seed_offset,
+                                        reorder=True, dup_prob=0.1))
+    return cluster, pid
+
+
+@pytest.fixture(scope="module")
+def thrashed():
+    """Run the whole thrash campaign once; individual tests assert on the
+    final state."""
+    rng = np.random.default_rng(20260729)
+    cluster, pid = _build_cluster()
+    model, log = _run_campaign(cluster, pid, rng, ROUNDS)
     return cluster, pid, model, log
 
 
@@ -228,3 +247,87 @@ class TestThrash:
                 g.bus.mark_down(victim)
             got = cluster.get(pid, oid, len(want))
             assert got == want
+
+
+@pytest.fixture(scope="module")
+def thrashed_scheduled():
+    """The same campaign under the RECOVERY SCHEDULER with tight caps:
+    every repair is reservation-gated (osd_max_backfills=1), waves carry
+    ONE object (osd_recovery_max_active=1), and a byte-rate cap +
+    recovery sleep pace them — the acked-write/read invariants must hold
+    exactly as in the ungated run, and the cluster must still converge."""
+    rng = np.random.default_rng(20260804)
+    cluster, pid = _build_cluster(seed_offset=1000)
+    cluster.cct.conf.set("osd_recovery_max_active", 1)
+    cluster.cct.conf.set("osd_recovery_max_bytes_per_sec", 64 * 1024)
+    cluster.cct.conf.set("osd_recovery_sleep", 0.001)
+    cluster.enable_recovery_scheduler()
+    model, log = _run_campaign(cluster, pid, rng, 120)
+    return cluster, pid, model, log
+
+
+@pytest.fixture(scope="module")
+def thrashed_scheduled_fused():
+    """The campaign again at the DEFAULT wave size (osd_recovery_max_active=3,
+    no byte cap): waves carry multiple objects, so the batch-fused
+    decode path (_RecoveryWave / decode_shards_many) — not the
+    per-object escape hatch — is what the thrash exercises."""
+    rng = np.random.default_rng(20260805)
+    cluster, pid = _build_cluster(seed_offset=2000)
+    cluster.enable_recovery_scheduler()
+    model, log = _run_campaign(cluster, pid, rng, 120)
+    return cluster, pid, model, log
+
+
+class TestThrashScheduledFused:
+    def test_converged_with_fused_waves(self, thrashed_scheduled_fused):
+        cluster, pid, model, log = thrashed_scheduled_fused
+        assert sum(1 for e in log if e.startswith("kill")) >= 3
+        for g in cluster.pools[pid]["pgs"].values():
+            assert not g.backend.stale
+            assert g.backend.is_active()
+        assert cluster.recovery.jobs == {}
+        assert cluster.recovery.summary()["reservations"]["granted"] == 0
+        sched = cluster.recovery
+        # fusion actually happened: more objects than waves overall
+        assert sched.perf.get("wave_objects") > sched.perf.get("waves")
+
+    def test_acked_writes_survive(self, thrashed_scheduled_fused):
+        cluster, pid, model, _ = thrashed_scheduled_fused
+        for oid, want in sorted(model.items()):
+            assert cluster.get(pid, oid, len(want)) == want
+
+
+class TestThrashScheduled:
+    def test_campaign_ran_and_converged(self, thrashed_scheduled):
+        cluster, pid, model, log = thrashed_scheduled
+        assert sum(1 for e in log if e.startswith("kill")) >= 3
+        assert len(model) >= 8
+        for g in cluster.pools[pid]["pgs"].values():
+            assert not g.backend.stale, \
+                f"{g.pgid}: shards {g.backend.stale} never repaired"
+            assert not g.backend.waiting_state
+            assert g.backend.is_active()
+        # scheduler drained: no jobs held, no reservations leaked
+        assert cluster.recovery.jobs == {}
+        assert cluster.recovery.summary()["reservations"]["granted"] == 0
+
+    def test_repairs_were_reservation_gated(self, thrashed_scheduled):
+        cluster, _pid, _model, _log = thrashed_scheduled
+        sched = cluster.recovery
+        assert sched.perf.get("jobs_completed") >= 1
+        bound = cluster.cct.conf.get("osd_max_backfills")
+        for table in (sched._local, sched._remote):
+            for r in table.values():
+                assert r.stats.peak_in_flight <= bound
+
+    def test_acked_writes_survive_and_scrub_clean(self, thrashed_scheduled):
+        cluster, pid, model, _ = thrashed_scheduled
+        for oid, want in sorted(model.items()):
+            got = cluster.get(pid, oid, len(want))
+            assert got == want, f"{oid} lost acked data under gated repair"
+        for oid in sorted(model):
+            g = cluster.pg_group(pid, oid)
+            report = g.backend.be_deep_scrub(oid)
+            bad = {c for c, clean in report.items() if not clean}
+            assert not bad, f"{oid}: inconsistent chunks {bad}"

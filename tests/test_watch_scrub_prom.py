@@ -217,6 +217,40 @@ class TestPrometheus:
         finally:
             eng.close()
 
+    def test_recovery_reserver_gauges_rendered(self):
+        """Live RecoveryScheduler reservers export per-OSD queue-depth
+        and in-flight gauges (`ceph_tpu_recovery_reserver_queued` /
+        `_granted`, owner/kind/osd labels) with the HELP/TYPE-once
+        invariants — scraped while a reservation is held."""
+        from ceph_tpu.common import Context
+        from ceph_tpu.mgr.prometheus import render
+        from ceph_tpu.recovery import RecoveryScheduler
+        sched = RecoveryScheduler(cct=Context(), name="promrec")
+        try:
+            sched.local_reserver(3).request_reservation(
+                "pgA", lambda: None, prio=180)
+            sched.local_reserver(3).request_reservation(
+                "pgB", lambda: None, prio=180)
+            sched.remote_reserver(5).request_reservation(
+                ("pgA", 5), lambda: None, prio=180)
+            text = render(Context())
+            lines = text.splitlines()
+            assert lines.count(
+                "# TYPE ceph_tpu_recovery_reserver_queued gauge") == 1
+            assert lines.count(
+                "# TYPE ceph_tpu_recovery_reserver_granted gauge") == 1
+            assert 'ceph_tpu_recovery_reserver_granted{owner="promrec",' \
+                   'kind="local",osd="3"} 1' in lines
+            assert 'ceph_tpu_recovery_reserver_queued{owner="promrec",' \
+                   'kind="local",osd="3"} 1' in lines
+            assert 'ceph_tpu_recovery_reserver_granted{owner="promrec",' \
+                   'kind="remote",osd="5"} 1' in lines
+            types = [line.split(" ", 2)[2].split(" ", 1)[0]
+                     for line in lines if line.startswith("# TYPE ")]
+            assert len(types) == len(set(types)), "duplicate TYPE lines"
+        finally:
+            sched.close()
+
     def test_stats_rate_gauges_rendered(self):
         """Live StatsAggregators export the PGMap-style digest as ONE
         `ceph_tpu_stats_rate` gauge family (owner + stat labels)."""
