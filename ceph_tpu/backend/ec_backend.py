@@ -1042,10 +1042,14 @@ class ECBackend(PGBackend):
                 with trace_span("ec.decode_wave", objects=len(ready),
                                 backend=self.instance_name), \
                         self.perf.time("decode_time"):
+                    # scheduler-attached backends carry a shared device
+                    # pipeline: signature groups dispatch async so group
+                    # i+1's host pack overlaps group i's device decode
                     rebuilt = ecutil.decode_shards_many(
                         self.sinfo, self.ec_impl,
                         [(avail, missing)
-                         for _o, avail, missing, _a in ready])
+                         for _o, avail, missing, _a in ready],
+                        pipeline=getattr(self, "recovery_pipeline", None))
             except (IOError, ValueError, AssertionError):
                 # a signature group failed to decode: every object drops
                 # to the per-object path, which localizes the failure

@@ -295,6 +295,145 @@ def _as_u8(v) -> np.ndarray:
     return np.asarray(v, dtype=np.uint8)
 
 
+# -- device-resident pipelined variants ---------------------------------------
+# These route the SAME batched relayouts through ops.pipeline.CodecPipeline:
+# the host pack (the `_to_shard_major` transposes and concatenations below)
+# runs while earlier batches' device kernels are still in flight, and the
+# `device_get` happens only at the pipeline's completion boundary.  They
+# engage only when the plugin exposes a device codec (`device_codec`, the
+# jax_rs capability hook) for a call of this size — everything else (numpy
+# routing, sub-chunk codes, non-RS plugins) returns None and the caller
+# keeps the verified synchronous path.
+
+def _device_codec(ec_impl, nbytes: int):
+    probe = getattr(ec_impl, "device_codec", None)
+    if probe is None or ec_impl.get_sub_chunk_count() != 1:
+        return None
+    return probe(int(nbytes))
+
+
+def encode_many_pipelined(sinfo: StripeInfo, ec_impl,
+                          bufs: list[bytes | np.ndarray], pipeline):
+    """Async :func:`encode_many`: returns a ``PipelineFuture`` resolving
+    to the identical per-buffer ``{chunk: bytes}`` list, or None when the
+    codec has no device path.  Pack (shard-major relayout) runs now and
+    overlaps in-flight device work; parity lands at the completion
+    boundary."""
+    if not bufs:
+        return None
+    k = ec_impl.get_data_chunk_count()
+    n = ec_impl.get_chunk_count()
+    arrs = []
+    for data in bufs:
+        buf = np.frombuffer(data, dtype=np.uint8) \
+            if isinstance(data, (bytes, bytearray)) \
+            else np.asarray(data, dtype=np.uint8)
+        assert len(buf) % sinfo.stripe_width == 0, \
+            f"len {len(buf)} not stripe aligned"
+        arrs.append(buf)
+    codec = _device_codec(ec_impl, sum(len(b) for b in arrs))
+    if codec is None:
+        return None
+    shard_lens = [(len(b) // sinfo.stripe_width) * sinfo.chunk_size
+                  for b in arrs]
+
+    def pack():
+        streams = [_to_shard_major(b, k, sinfo.chunk_size) for b in arrs]
+        return np.concatenate(streams, axis=1) if len(streams) > 1 \
+            else streams[0]
+
+    def dispatch(data_shards):
+        return pipeline.dispatch_encode(codec, data_shards,
+                                        sinfo.chunk_size)
+
+    def unpack(data_shards, parity):
+        out: list[dict[int, np.ndarray]] = []
+        off = 0
+        for ln in shard_lens:
+            chunks = {ec_impl.chunk_index(i): data_shards[i, off:off + ln]
+                      for i in range(k)}
+            for j in range(n - k):
+                chunks[ec_impl.chunk_index(k + j)] = parity[j, off:off + ln]
+            out.append(chunks)
+            off += ln
+        return out
+
+    return pipeline.submit(pack, dispatch, unpack, kind="encode",
+                           ops=len(bufs))
+
+
+def decode_many_pipelined(sinfo: StripeInfo, ec_impl,
+                          batches: list[dict[int, np.ndarray]],
+                          pipeline, pad_chunks=None,
+                          chunk_size: int | None = None):
+    """Async :func:`decode_many`: one pipeline item per distinct
+    available-chunk signature.  Returns ``[(idxs, future), ...]`` where
+    each future resolves to the logical bytes for those batch indices, or
+    None when the codec has no device path."""
+    if not batches:
+        return None
+    total_bytes = sum(sum(_as_u8(v).nbytes for v in chunks.values())
+                      for chunks in batches)
+    codec = _device_codec(ec_impl, total_bytes)
+    if codec is None:
+        return None
+    by_sig: dict[frozenset, list[int]] = {}
+    for i, chunks in enumerate(batches):
+        by_sig.setdefault(frozenset(chunks), []).append(i)
+    pending = []
+    for sig, idxs in sorted(by_sig.items(), key=lambda kv: kv[1][0]):
+        pending.append((list(idxs),
+                        _submit_decode_group(sinfo, ec_impl, codec, batches,
+                                             sig, idxs, pipeline, pad_chunks,
+                                             chunk_size)))
+    return pending
+
+
+def _submit_decode_group(sinfo, ec_impl, codec, batches, sig, idxs,
+                         pipeline, pad_chunks, chunk_size):
+    """One signature group's pack/dispatch/unpack trio, submitted."""
+    k = ec_impl.get_data_chunk_count()
+
+    def pack():
+        concat, lens = _group_streams(
+            [batches[i] for i in idxs], sig, pad_chunks=pad_chunks,
+            quantum=chunk_size if chunk_size else sinfo.chunk_size)
+        # wire ids are PHYSICAL; the codec's rows are LOGICAL
+        avail_l, _ = ec_impl.remap_for_decode(concat, [])
+        erasures_l = [i for i in range(k) if i not in avail_l]
+        stack = None
+        if erasures_l:
+            _D, src = codec.decode_matrix(erasures_l,
+                                          available=list(avail_l))
+            stack = np.stack([avail_l[s] for s in src])
+        return avail_l, erasures_l, stack, lens
+
+    def dispatch(packed):
+        avail_l, erasures_l, stack, _lens = packed
+        if not erasures_l:
+            return None                  # all data rows survived: host-only
+        return pipeline.dispatch_decode(codec, stack, erasures_l,
+                                        list(avail_l))
+
+    def unpack(packed, rec):
+        avail_l, erasures_l, _stack, lens = packed
+        rows = {e: rec[i] for i, e in enumerate(erasures_l)} \
+            if erasures_l else {}
+        data = np.stack([avail_l[i] if i in avail_l else rows[i]
+                         for i in range(k)])
+        out: list[bytes] = []
+        off = 0
+        for ln in lens:
+            out.append(_from_shard_major(
+                np.ascontiguousarray(data[:, off:off + ln]),
+                sinfo.chunk_size).tobytes())
+            off += ln
+        return out
+
+    return pipeline.submit(pack, dispatch, unpack, kind="decode",
+                           ops=len(idxs))
+
+
 def decode(sinfo: StripeInfo, ec_impl,
            to_decode: dict[int, np.ndarray]) -> bytes:
     """Reconstruct the logical buffer from >=k shard chunk streams
@@ -309,6 +448,36 @@ def decode(sinfo: StripeInfo, ec_impl,
         np.frombuffer(decoded, dtype=np.uint8).reshape(k, shard_len),
         sinfo.chunk_size)
     return logical.tobytes()
+
+
+def _group_streams(chunk_dicts: list[dict], sig,
+                   pad_chunks=None, quantum: int | None = None
+                   ) -> tuple[dict[int, np.ndarray], list[int]]:
+    """Assemble one signature group's per-op shard streams into
+    ``({chunk: concatenated [total] bytes}, per-op lens)`` — the ONE copy
+    of the stacking/validation/size-bucket-padding logic shared by the
+    sync and pipelined decode paths (they are asserted bitwise-identical,
+    so they must assemble identically by construction).  ``pad_chunks``
+    optionally rounds the group's total chunk count up (zero chunks
+    decode to zero bytes — linear code — and the pad slices off)."""
+    streams: dict[int, list[np.ndarray]] = {c: [] for c in sig}
+    lens: list[int] = []
+    for chunks in chunk_dicts:
+        chunks = {c: _as_u8(v) for c, v in chunks.items()}
+        sizes = {len(v) for v in chunks.values()}
+        assert len(sizes) == 1, "uneven shard buffers"
+        lens.append(sizes.pop())
+        for c in sig:
+            streams[c].append(chunks[c])
+    total = sum(lens)
+    if pad_chunks is not None and quantum and total % quantum == 0:
+        padded = pad_chunks(total // quantum) * quantum
+        if padded > total:
+            pad = np.zeros(padded - total, dtype=np.uint8)
+            for c in sig:
+                streams[c].append(pad)
+    return ({c: (np.concatenate(v) if len(v) > 1 else v[0])
+             for c, v in streams.items()}, lens)
 
 
 def decode_many(sinfo: StripeInfo, ec_impl,
@@ -334,25 +503,9 @@ def decode_many(sinfo: StripeInfo, ec_impl,
         by_sig.setdefault(frozenset(chunks), []).append(i)
     k = ec_impl.get_data_chunk_count()
     for sig, idxs in by_sig.items():
-        streams: dict[int, list[np.ndarray]] = {c: [] for c in sig}
-        lens: list[int] = []
-        for i in idxs:
-            chunks = {c: _as_u8(v) for c, v in batches[i].items()}
-            sizes = {len(v) for v in chunks.values()}
-            assert len(sizes) == 1, "uneven shard buffers"
-            lens.append(sizes.pop())
-            for c in sig:
-                streams[c].append(chunks[c])
-        total = sum(lens)
-        quantum = chunk_size if chunk_size else sinfo.chunk_size
-        if pad_chunks is not None and total % quantum == 0:
-            padded = pad_chunks(total // quantum) * quantum
-            if padded > total:
-                pad = np.zeros(padded - total, dtype=np.uint8)
-                for c in sig:
-                    streams[c].append(pad)
-        concat = {c: (np.concatenate(v) if len(v) > 1 else v[0])
-                  for c, v in streams.items()}
+        concat, lens = _group_streams(
+            [batches[i] for i in idxs], sig, pad_chunks=pad_chunks,
+            quantum=chunk_size if chunk_size else sinfo.chunk_size)
         decoded = np.frombuffer(
             ec_impl.decode_concat(concat), dtype=np.uint8).reshape(k, -1)
         off = 0
@@ -366,8 +519,8 @@ def decode_many(sinfo: StripeInfo, ec_impl,
 
 
 def decode_shards_many(sinfo: StripeInfo, ec_impl,
-                       batches: list[tuple[dict[int, np.ndarray], set]]
-                       ) -> list[dict[int, np.ndarray]]:
+                       batches: list[tuple[dict[int, np.ndarray], set]],
+                       pipeline=None) -> list[dict[int, np.ndarray]]:
     """Reconstruct specific shards for MANY objects with ONE
     ``ec_impl.decode`` per distinct (survivor signature, want set) — the
     recovery-side sibling of :func:`decode_many`.  Parity is positionwise,
@@ -379,7 +532,12 @@ def decode_shards_many(sinfo: StripeInfo, ec_impl,
     ``batches`` is ``[(available {chunk: bytes}, want set), ...]``.  Only
     valid for whole-chunk codes (``get_sub_chunk_count() == 1``) — clay's
     fractional repair reads are not positionwise across objects; callers
-    gate on that and fall back to per-object :func:`decode_shards`."""
+    gate on that and fall back to per-object :func:`decode_shards`.
+
+    With a ``pipeline``, each (signature, want) group dispatches async
+    through the device pipeline: group i+1's host pack overlaps group i's
+    in-flight device reconstruct, and results fetch at the end — the
+    repair-wave overlap the recovery scheduler rides."""
     if not batches:
         return []
     results: list[dict[int, np.ndarray] | None] = [None] * len(batches)
@@ -387,19 +545,19 @@ def decode_shards_many(sinfo: StripeInfo, ec_impl,
     for i, (available, want) in enumerate(batches):
         by_sig.setdefault((frozenset(available), frozenset(want)),
                           []).append(i)
+    if pipeline is not None:
+        pending = _decode_shards_groups_pipelined(sinfo, ec_impl, batches,
+                                                  by_sig, pipeline)
+        if pending is not None:
+            # every group is dispatched before the first fetch: the host
+            # pack of later groups overlapped earlier device compute
+            for idxs, fut in pending:
+                for i, rec in zip(idxs, fut.result()):
+                    results[i] = rec
+            return results
     for (sig, want_sig), idxs in by_sig.items():
         want = set(want_sig)
-        streams: dict[int, list[np.ndarray]] = {c: [] for c in sig}
-        lens: list[int] = []
-        for i in idxs:
-            chunks = {c: _as_u8(v) for c, v in batches[i][0].items()}
-            sizes = {len(v) for v in chunks.values()}
-            assert len(sizes) == 1, "uneven shard buffers"
-            lens.append(sizes.pop())
-            for c in sig:
-                streams[c].append(chunks[c])
-        concat = {c: (np.concatenate(v) if len(v) > 1 else v[0])
-                  for c, v in streams.items()}
+        concat, lens = _group_streams([batches[i][0] for i in idxs], sig)
         decoded = ec_impl.decode(want, concat, 0)
         off = 0
         for i, ln in zip(idxs, lens):
@@ -407,6 +565,53 @@ def decode_shards_many(sinfo: StripeInfo, ec_impl,
                           [off:off + ln] for c in want}
             off += ln
     return results
+
+
+def _decode_shards_groups_pipelined(sinfo, ec_impl, batches, by_sig,
+                                    pipeline):
+    """Submit every (signature, want) recovery group through the device
+    pipeline; ``[(idxs, future), ...]`` or None when no device path."""
+    total_bytes = sum(sum(_as_u8(v).nbytes for v in avail.values())
+                      for avail, _want in batches)
+    codec = _device_codec(ec_impl, total_bytes)
+    if codec is None:
+        return None
+    n = ec_impl.get_chunk_count()
+    pending = []
+    for (sig, want_sig), idxs in sorted(by_sig.items(),
+                                        key=lambda kv: kv[1][0]):
+        want = sorted(want_sig)
+
+        def pack(sig=sig, want=want, idxs=idxs):
+            concat, lens = _group_streams([batches[i][0] for i in idxs],
+                                          sig)
+            avail_l, want_l = ec_impl.remap_for_decode(concat, want)
+            erasures_l = [i for i in range(n) if i not in avail_l]
+            _D, src = codec.decode_matrix(erasures_l,
+                                          available=list(avail_l))
+            stack = np.stack([avail_l[s] for s in src])
+            return erasures_l, want_l, list(avail_l), stack, lens
+
+        def dispatch(packed):
+            erasures_l, _want_l, avail_ids, stack, _lens = packed
+            return pipeline.dispatch_decode(codec, stack, erasures_l,
+                                            avail_ids)
+
+        def unpack(packed, rec):
+            erasures_l, want_l, _avail_ids, _stack, lens = packed
+            rows = {e: rec[i] for i, e in enumerate(erasures_l)}
+            out: list[dict[int, np.ndarray]] = []
+            off = 0
+            for ln in lens:
+                out.append({ec_impl.chunk_index(w): rows[w][off:off + ln]
+                            for w in want_l})
+                off += ln
+            return out
+
+        pending.append((list(idxs),
+                        pipeline.submit(pack, dispatch, unpack,
+                                        kind="recover", ops=len(idxs))))
+    return pending
 
 
 def decode_shards(sinfo: StripeInfo, ec_impl, available: dict[int, np.ndarray],

@@ -150,6 +150,22 @@ OPTIONS: list[Option] = [
                        "codec; above (or batched via the pipeline/queue "
                        "paths), on device — BASELINE_RESULTS.json config 2 "
                        "measures the crossover"),
+    # -- device codec pipeline (ceph_tpu/ops/pipeline.py) ------------------
+    Option("jax_rs_pipeline_depth", TYPE_UINT, LEVEL_ADVANCED,
+           default=4,
+           description="max dispatched device batches in flight before "
+                       "the codec pipeline forces completion of the "
+                       "oldest; batch N+1's host pack overlaps batch N's "
+                       "device compute (0 = synchronous dispatch)",
+           see_also=["jax_rs_mesh_devices"]),
+    Option("jax_rs_mesh_devices", TYPE_UINT, LEVEL_ADVANCED,
+           default=0,
+           description="split coalesced codec batches across the dp axis "
+                       "of a device mesh over this many devices "
+                       "(parallel/mesh sharded encode/decode steps); "
+                       "0 or 1 = single-chip dispatch, and the option is "
+                       "ignored when fewer devices are present",
+           see_also=["jax_rs_pipeline_depth"]),
     # -- serving engine (ceph_tpu/exec/): admission + dynamic batching ----
     Option("osd_serving_throttle_bytes", TYPE_SIZE, LEVEL_ADVANCED,
            default=64 << 20,

@@ -125,7 +125,21 @@ def bucket_pad_stripes(total_stripes: int) -> int:
     return 1 << (total_stripes - 1).bit_length()
 
 
-def _encode_group(group: list[BatchFuture], pad_to_bucket: bool) -> None:
+def _land_results(ops: list[BatchFuture]):
+    """A pipeline-future done-callback that copies the future's value (one
+    result per op, in order) — or its error, shared — onto the ops."""
+    def land(fut):
+        if fut.error is not None:
+            for op in ops:
+                op._error = fut.error
+        else:
+            for op, result in zip(ops, fut.value):
+                op._result = result
+    return land
+
+
+def _encode_group(group: list[BatchFuture], pad_to_bucket: bool,
+                  pipeline=None) -> list[tuple[list[BatchFuture], object]]:
     sinfo, ec = group[0].sinfo, group[0].ec_impl
     bufs = [op.payload for op in group]
     total = sum(len(b) for b in bufs) // sinfo.stripe_width
@@ -133,39 +147,67 @@ def _encode_group(group: list[BatchFuture], pad_to_bucket: bool) -> None:
     if padded > total:
         bufs = bufs + [np.zeros((padded - total) * sinfo.stripe_width,
                                 dtype=np.uint8)]
+    if pipeline is not None:
+        fut = ecutil.encode_many_pipelined(sinfo, ec, bufs, pipeline)
+        if fut is not None:
+            fut.add_done_callback(_land_results(group))
+            return [(group, fut)]
     with trace_span("serving.batch_encode", ops=len(group),
                     stripes=total, padded_stripes=padded):
         encoded = ecutil.encode_many(sinfo, ec, bufs)
     for op, chunks in zip(group, encoded):
         op._result = chunks
+    return [(group, None)]
 
 
-def _decode_group(group: list[BatchFuture], pad_to_bucket: bool) -> None:
+def _decode_group(group: list[BatchFuture], pad_to_bucket: bool,
+                  pipeline=None) -> list[tuple[list[BatchFuture], object]]:
     sinfo, ec = group[0].sinfo, group[0].ec_impl
+    pad = bucket_pad_stripes if pad_to_bucket else None
+    if pipeline is not None:
+        pending = ecutil.decode_many_pipelined(
+            sinfo, ec, [op.payload for op in group], pipeline,
+            pad_chunks=pad, chunk_size=sinfo.chunk_size)
+        if pending is not None:
+            out = []
+            for idxs, fut in pending:
+                sub = [group[i] for i in idxs]
+                fut.add_done_callback(_land_results(sub))
+                out.append((sub, fut))
+            return out
     with trace_span("serving.batch_decode", ops=len(group)):
         decoded = ecutil.decode_many(
             sinfo, ec, [op.payload for op in group],
-            pad_chunks=bucket_pad_stripes if pad_to_bucket else None,
-            chunk_size=sinfo.chunk_size)
+            pad_chunks=pad, chunk_size=sinfo.chunk_size)
     for op, data in zip(group, decoded):
         op._result = data
+    return [(group, None)]
 
 
-def dispatch_batch(ops: list[BatchFuture],
-                   pad_to_bucket: bool = True) -> None:
+def dispatch_batch(ops: list[BatchFuture], pad_to_bucket: bool = True,
+                   pipeline=None) -> list[tuple[list[BatchFuture], object]]:
     """Run one formed batch: fused per codec group; results (or a shared
     error) land on each future's ``_result``/``_error`` — the ENGINE
     completes them (throttle release + finisher callbacks stay with the
-    component that owns those resources)."""
+    component that owns those resources).
+
+    Returns ``[(ops, pipeline_future | None), ...]``: None means the
+    group ran synchronously and its results are already landed; a future
+    means the group is IN FLIGHT on the device pipeline — results land
+    via a done-callback at the pipeline's completion boundary, and the
+    engine must defer each op's completion until then."""
+    pending: list[tuple[list[BatchFuture], object]] = []
     for group in group_ops(ops):
         try:
             if group[0].kind == ENCODE:
-                _encode_group(group, pad_to_bucket)
+                pending.extend(_encode_group(group, pad_to_bucket, pipeline))
             else:
-                _decode_group(group, pad_to_bucket)
+                pending.extend(_decode_group(group, pad_to_bucket, pipeline))
         except BaseException as e:             # noqa: BLE001 — one bad op
             # (unaligned buffer, codec error) fails its GROUP, never the
             # coalescer thread; per-op granularity would re-dispatch the
             # good ops but a group shares one device call — fail together
             for op in group:
                 op._error = e
+            pending.append((group, None))
+    return pending

@@ -39,6 +39,7 @@ from ..backend import ecutil
 from ..common import default_context
 from ..common.perf_counters import PerfCountersBuilder
 from ..common.tracer import LATENCY_BUCKETS_S, default_tracer
+from ..ops.pipeline import CodecPipeline
 from ..osd.mclock import CLIENT_OP, MClockOpClassQueue
 from .batcher import BatchFuture, DECODE, ENCODE, dispatch_batch
 from .finisher import Finisher
@@ -88,7 +89,8 @@ class ServingEngine:
                  batch_max_delay_ms: float | None = None,
                  batch_max_ops: int | None = None,
                  class_info: dict | None = None,
-                 pad_to_bucket: bool = True):
+                 pad_to_bucket: bool = True,
+                 pipeline_depth: int | None = None):
         self.cct = cct if cct is not None else default_context()
         conf = self.cct.conf
         self.name = name
@@ -112,6 +114,14 @@ class ServingEngine:
             if max_ops is None else max_ops, cct=self.cct)
         self.queue = MClockOpClassQueue(class_info)
         self.finisher = Finisher(name)
+        # the device pipeline: coalesced batches dispatch async through it
+        # (device-routed codecs only), so the NEXT batch's host pack
+        # overlaps the in-flight device compute.  depth 0 = synchronous.
+        depth = int(conf.get("jax_rs_pipeline_depth")
+                    if pipeline_depth is None else pipeline_depth)
+        self.pipeline = CodecPipeline(depth=depth, cct=self.cct,
+                                      name=f"{name}.pipeline") \
+            if depth > 0 else None
         self.perf = _build_perf(name)
         self.cct.perf.add(self.perf)
         self._lock = threading.Lock()
@@ -156,6 +166,8 @@ class ServingEngine:
             self.cct.perf.add(self.perf)
             self.cct.perf.add(self.byte_throttle.perf)
             self.cct.perf.add(self.op_throttle.perf)
+            if self.pipeline is not None:
+                self.pipeline.reopen()
             _ENGINES.add(self)
             self.finisher.start()
             self._thread = threading.Thread(
@@ -182,6 +194,8 @@ class ServingEngine:
         for pc in (self.perf, self.byte_throttle.perf,
                    self.op_throttle.perf):
             self.cct.perf.remove(pc.name)
+        if self.pipeline is not None:
+            self.pipeline.close()       # drains + unhooks its perf
         _ENGINES.discard(self)
 
     @property
@@ -340,9 +354,14 @@ class ServingEngine:
         return ops
 
     def _gather(self) -> list[BatchFuture] | None:
-        """Form one batch under the deadline; None = stopped and empty."""
+        """Form one batch under the deadline; None = stopped and empty.
+        An EMPTY list means: nothing to pack but the device pipeline has
+        batches in flight — the loop completes the oldest instead of
+        sleeping (the completion boundary on the idle edge)."""
         with self._lock:
             while self._depth == 0:
+                if self.pipeline is not None and self.pipeline.in_flight:
+                    return []
                 if self._stopping:
                     return None
                 self._cond.wait()
@@ -365,7 +384,17 @@ class ServingEngine:
         self.perf.inc("batches")
         self.perf.inc("ops_coalesced", len(ops))
         self.perf.hinc("batch_size", len(ops))
-        dispatch_batch(ops, self.pad_to_bucket)
+        for group, fut in dispatch_batch(ops, self.pad_to_bucket,
+                                         pipeline=self.pipeline):
+            if fut is None:             # synchronous: results are landed
+                self._queue_completions(group)
+            else:                       # in flight on the device pipeline:
+                # complete at the completion boundary (the result-landing
+                # callback registered by the batcher runs first)
+                fut.add_done_callback(
+                    lambda _f, _g=tuple(group): self._queue_completions(_g))
+
+    def _queue_completions(self, ops) -> None:
         for op in ops:
             self.finisher.queue(self._complete_op, op)
 
@@ -396,6 +425,10 @@ class ServingEngine:
                 return
             if ops:
                 self._dispatch(ops)
+            elif self.pipeline is not None:
+                # idle edge: nothing to pack — retire the oldest in-flight
+                # device batch (completions ride the finisher as usual)
+                self.pipeline.complete_one()
 
     # -- deterministic driving (tests / inline mode) -----------------------
 
@@ -408,6 +441,8 @@ class ServingEngine:
             ops = self._drain_locked(self.batch_max_ops, force=True)
         if ops:
             self._dispatch(ops)
+        if self.pipeline is not None:
+            self.pipeline.flush()
         self.finisher.drain()
         return len(ops)
 

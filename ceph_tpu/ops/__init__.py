@@ -1,7 +1,8 @@
 from .traced_jit import traced_jit
 from .rs_kernels import gf_apply, gf_apply_bitslice, gf_apply_lookup, xor_reduce
 from .codec import RSCodec, TECHNIQUES
+from .pipeline import CodecPipeline, PipelineFuture
 
 __all__ = ["traced_jit",
            "gf_apply", "gf_apply_bitslice", "gf_apply_lookup", "xor_reduce",
-           "RSCodec", "TECHNIQUES"]
+           "RSCodec", "TECHNIQUES", "CodecPipeline", "PipelineFuture"]

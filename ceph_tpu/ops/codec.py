@@ -9,6 +9,7 @@ matrices are traced, so one compilation covers all erasure signatures.
 from __future__ import annotations
 
 import collections
+import functools
 import threading
 
 import jax
@@ -29,6 +30,38 @@ TECHNIQUES = {
 # Matches the isa decode-table LRU capacity, "sufficient up to (12,4)"
 # (reference: src/erasure-code/isa/ErasureCodeIsaTableCache.h:46-48).
 DECODE_CACHE_SIZE = 2516
+
+
+class _DecodeTables:
+    """One signature's cached decode state: the host matrix, the source
+    chunk order, and — uploaded lazily, then pinned for the LRU entry's
+    lifetime — the device-resident copy.  The device copy is what keeps
+    an LRU *hit* from paying a host->device matrix transfer per call."""
+
+    __slots__ = ("D", "src", "dev")
+
+    def __init__(self, D: np.ndarray, src: list[int]):
+        self.D = D
+        self.src = src
+        self.dev: jax.Array | None = None
+
+
+@functools.partial(jax.jit, static_argnames=("variant",),
+                   donate_argnums=(1,))
+def _gf_apply_donated(mat, data, variant):
+    """Steady-state pipeline apply with the data buffer DONATED: the
+    packed input block is dead after the dispatch (the pipeline packs a
+    fresh one per batch), so XLA may reuse its pages for scratch/output
+    instead of holding both live.  TPU-only — the CPU runtime cannot
+    alias them and would warn per call."""
+    return rs_kernels.gf_apply(mat, data, variant)
+
+
+def _donation_supported() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:            # backend init failure -> act like CPU
+        return False
 
 
 class RSCodec:
@@ -57,6 +90,12 @@ class RSCodec:
         self._parity_dev = None
         self._decode_cache: collections.OrderedDict = collections.OrderedDict()
         self._lock = threading.Lock()
+        # host->device table-transfer counters: the pipeline tests assert
+        # an LRU hit costs ZERO uploads (the serving/recovery hot paths
+        # must never re-upload a decode matrix per call)
+        self.parity_uploads = 0
+        self.decode_table_uploads = 0
+        self._donate = None          # lazily probed: platform supports it?
 
     # -- encode ------------------------------------------------------------
 
@@ -80,16 +119,27 @@ class RSCodec:
             with trace_span("codec.table_upload",
                             bytes=int(self.parity_mat.nbytes)):
                 self._parity_dev = jnp.asarray(self.parity_mat)
+                self.parity_uploads += 1
 
-    def encode_device(self, data: jax.Array) -> jax.Array:
-        """Device-to-device encode (no host transfer), for pipeline use."""
+    def _donation_ok(self) -> bool:
+        if self._donate is None:
+            self._donate = _donation_supported()
+        return self._donate
+
+    def encode_device(self, data: jax.Array,
+                      donate: bool = False) -> jax.Array:
+        """Device-to-device encode (no host transfer), for pipeline use.
+        ``donate=True`` marks ``data`` dead-after-call on platforms that
+        support buffer donation (the pipeline's steady-state path)."""
         self._upload_parity()
+        if donate and self._donation_ok():
+            return _gf_apply_donated(self._parity_dev, data, self.variant)
         return rs_kernels.gf_apply(self._parity_dev, data, self.variant)
 
     # -- decode ------------------------------------------------------------
 
-    def decode_matrix(self, erasures, available=None):
-        """Signature-LRU-cached (decode matrix, source chunk list)."""
+    def _decode_entry(self, erasures, available=None) -> _DecodeTables:
+        """Signature-LRU lookup/build of the shared decode state."""
         sig = (tuple(sorted(int(e) for e in erasures)),
                None if available is None else tuple(sorted(int(a) for a in available)))
         with self._lock:
@@ -101,11 +151,41 @@ class RSCodec:
                         erasures=len(sig[0])):
             D, src = gfm.decode_matrix(self.parity_mat, list(erasures),
                                        available)
+        entry = _DecodeTables(D, src)
         with self._lock:
-            self._decode_cache[sig] = (D, src)
+            entry = self._decode_cache.setdefault(sig, entry)
+            self._decode_cache.move_to_end(sig)
             if len(self._decode_cache) > DECODE_CACHE_SIZE:
                 self._decode_cache.popitem(last=False)
-        return D, src
+        return entry
+
+    def decode_matrix(self, erasures, available=None):
+        """Signature-LRU-cached (decode matrix, source chunk list)."""
+        entry = self._decode_entry(erasures, available)
+        return entry.D, entry.src
+
+    def decode_matrix_device(self, erasures, available=None):
+        """Like :meth:`decode_matrix` but the matrix is the DEVICE-resident
+        copy, uploaded once per LRU entry: an LRU hit costs zero
+        host->device transfers (the re-upload-per-call bug the pipeline
+        tests pin via ``decode_table_uploads``)."""
+        entry = self._decode_entry(erasures, available)
+        return self._entry_device(entry), entry.src
+
+    def _entry_device(self, entry: _DecodeTables) -> jax.Array:
+        """Pin (lazily uploading) an already-fetched entry's device copy —
+        one LRU lookup per decode call, not two."""
+        if entry.dev is None:
+            # upload outside the lock (it can be slow), publish under it:
+            # two threads racing a fresh signature upload twice but count
+            # once, and the pinned copy is whichever published first
+            with trace_span("codec.table_upload", bytes=int(entry.D.nbytes)):
+                dev = jnp.asarray(entry.D)
+            with self._lock:
+                if entry.dev is None:
+                    entry.dev = dev
+                    self.decode_table_uploads += 1
+        return entry.dev
 
     def decode(self, chunks: dict[int, np.ndarray],
                erasures: list[int]) -> dict[int, np.ndarray]:
@@ -116,18 +196,33 @@ class RSCodec:
         erasures = sorted(int(e) for e in erasures)
         if not erasures:
             return {}
-        D, src = self.decode_matrix(erasures, available=list(chunks))
-        stack = np.stack([np.asarray(chunks[i], dtype=np.uint8) for i in src])
+        entry = self._decode_entry(erasures, available=list(chunks))
+        stack = np.stack([np.asarray(chunks[i], dtype=np.uint8)
+                          for i in entry.src])
         with trace_span("codec.decode", k=self.k, m=self.m,
                         n=int(stack.shape[1]), erasures=len(erasures),
                         device=self.device):
             if self.device == "numpy":
-                rec = gfref.apply_matrix_fast(D, stack)
+                rec = gfref.apply_matrix_fast(entry.D, stack)
             else:
                 rec = np.asarray(jax.device_get(
-                    rs_kernels.gf_apply(jnp.asarray(D), stack,
+                    rs_kernels.gf_apply(self._entry_device(entry), stack,
                                         self.variant)))
         return {e: rec[i] for i, e in enumerate(erasures)}
+
+    @staticmethod
+    def _src_index_map(src: list[int],
+                       src_expected: list[int]) -> list[int] | None:
+        """Row gather mapping caller order -> decode_matrix order, or None
+        when it is the identity over a prefix (precomputed in O(k) — the
+        per-element ``src.index(s)`` scan was O(k^2) per batch)."""
+        if src == src_expected:
+            return None
+        pos = {s: i for i, s in enumerate(src)}
+        idx = [pos[s] for s in src_expected]
+        if idx == list(range(len(idx))):
+            return None          # identity after dropping extras: slice, no gather
+        return idx
 
     def decode_batch(self, stack: np.ndarray, src: list[int],
                      erasures: list[int]) -> np.ndarray:
@@ -136,11 +231,12 @@ class RSCodec:
         stack: [B, k, N] survivors in ``src`` order -> [B, len(erasures), N].
         """
         src = [int(s) for s in src]
-        D, src_expected = self.decode_matrix(erasures, available=src)
-        if src != src_expected:
-            # decode_matrix always works in sorted-src order; permute the
-            # caller's rows to match (and drop extras beyond the k used).
-            stack = stack[:, [src.index(s) for s in src_expected], :]
+        entry = self._decode_entry(erasures, available=src)
+        idx = self._src_index_map(src, entry.src)
+        if idx is not None:
+            stack = stack[:, idx, :]
+        elif len(entry.src) != stack.shape[1]:
+            stack = stack[:, :len(entry.src), :]     # drop extras: a view
         b, k, n = stack.shape
         folded = np.ascontiguousarray(
             np.swapaxes(stack, 0, 1).reshape(k, b * n), dtype=np.uint8)
@@ -148,9 +244,52 @@ class RSCodec:
                         batch=int(b), n=int(n), erasures=len(erasures),
                         device=self.device):
             if self.device == "numpy":
-                rec = gfref.apply_matrix_fast(D, folded)
+                rec = gfref.apply_matrix_fast(entry.D, folded)
             else:
                 rec = np.asarray(jax.device_get(
-                    rs_kernels.gf_apply(jnp.asarray(D), folded,
+                    rs_kernels.gf_apply(self._entry_device(entry), folded,
                                         self.variant)))
         return np.swapaxes(rec.reshape(len(erasures), b, n), 0, 1)
+
+    # -- device-resident decode (no host round-trip; pipeline path) --------
+
+    def decode_device(self, stack: jax.Array, erasures: list[int],
+                      available: list[int] | None = None,
+                      donate: bool = False) -> jax.Array:
+        """Device-to-device decode: ``stack`` [k, N] survivors already in
+        the sorted-src order ``decode_matrix(erasures, available)``
+        returns -> recovered rows [len(erasures), N], still on device.
+        No ``device_get`` and no matrix re-upload — the decode matrix
+        rides the signature LRU's device copy."""
+        erasures = sorted(int(e) for e in erasures)
+        D_dev, src = self.decode_matrix_device(erasures, available)
+        if int(stack.shape[0]) != len(src):
+            raise ValueError(
+                f"stack has {stack.shape[0]} rows for {len(src)} sources")
+        if donate and self._donation_ok():
+            return _gf_apply_donated(D_dev, stack, self.variant)
+        return rs_kernels.gf_apply(D_dev, stack, self.variant)
+
+    def decode_batch_device(self, stack: jax.Array, src: list[int],
+                            erasures: list[int],
+                            donate: bool = False) -> jax.Array:
+        """Device-to-device batched decode: ``stack`` [B, k', N] survivors
+        in ``src`` order -> [B, len(erasures), N] on device.  The row
+        permutation, fold and unfold all run as device ops, so nothing
+        touches the host."""
+        src = [int(s) for s in src]
+        erasures = sorted(int(e) for e in erasures)
+        D_dev, src_expected = self.decode_matrix_device(erasures,
+                                                        available=src)
+        idx = self._src_index_map(src, src_expected)
+        if idx is not None:
+            stack = jnp.take(stack, jnp.asarray(idx), axis=1)
+        elif len(src_expected) != int(stack.shape[1]):
+            stack = stack[:, :len(src_expected), :]
+        b, k, n = (int(s) for s in stack.shape)
+        folded = jnp.swapaxes(stack, 0, 1).reshape(k, b * n)
+        if donate and self._donation_ok():
+            rec = _gf_apply_donated(D_dev, folded, self.variant)
+        else:
+            rec = rs_kernels.gf_apply(D_dev, folded, self.variant)
+        return jnp.swapaxes(rec.reshape(len(erasures), b, n), 0, 1)

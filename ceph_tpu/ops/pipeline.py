@@ -1,0 +1,381 @@
+"""CodecPipeline: depth-limited async device dispatch for codec batches.
+
+The transfer-stall fix the ISSUE-5 tentpole names: every synchronous
+``RSCodec.encode``/``decode`` call blocks on ``np.asarray(jax.device_get)``
+right after dispatch, so host-side pack/unpack (``np.stack``, transposes,
+``ascontiguousarray``) and device compute run SERIALLY.  JAX dispatch is
+asynchronous on every backend (a dispatched computation runs in the XLA
+runtime while Python continues), so the pipeline keeps up to ``depth``
+dispatched batches in flight and defers ``block_until_ready`` to an
+explicit completion boundary:
+
+    submit(pack, dispatch, unpack):
+        pack()              host: build the folded uint8 block      [overlaps
+        dispatch(packed)    device: async kernel launch              previous
+        -> PipelineFuture                                            batches'
+    completion (oldest-first once depth is exceeded, or flush(),     device
+    or an out-of-order ``result()``):                                compute]
+        block_until_ready + device_get                 <- the ONLY host sync
+        unpack(packed, host) -> future's result
+
+This module IS the completion boundary: ``tests/test_no_host_sync.py``
+guards that ``exec/`` and ``recovery/`` never call ``jax.device_get`` /
+``block_until_ready`` (or import jax at all) — batch N+1's host prep in
+those layers can therefore never accidentally serialise against batch N's
+device work.
+
+Steady-state dispatches donate the packed input buffer (dead after
+launch; TPU only — see ``codec._gf_apply_donated``), and every stage
+lands on the PR-1 tracer (``pipeline.pack``/``dispatch``/``complete``
+spans) plus an in-flight-depth perf collection.
+
+Multi-chip: when ``jax_rs_mesh_devices`` names >= 2 devices, encode and
+decode dispatches split the coalesced batch across the ``dp`` axis of a
+``parallel.mesh`` device mesh (``sharded_batch_encode_step`` — the
+parity-only serving variant of the dryrun-validated encode step — and
+``sharded_decode_step``), so the serving path rides the same shard_map
+machinery the MULTICHIP dryruns validate.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import weakref
+
+import jax
+import jax.numpy as jnp
+
+from ..common import default_context
+from ..common.perf_counters import PerfCountersBuilder
+from ..common.tracer import trace_span
+
+DEPTH_BUCKETS = [0, 1, 2, 4, 8, 16, 32]
+
+_MISSING = object()
+
+
+class PipelineFuture:
+    """Completion handle for one in-flight device batch.
+
+    ``result()``/``exception()`` FORCE completion when the item is still
+    in flight (out-of-order completion is legal: forcing item 3 before
+    item 1 completes 3 alone; 1 stays dispatched).  Device-side failures
+    (anything ``block_until_ready`` or the unpack stage raises) surface
+    here, never on the dispatching thread.
+
+    ``timeout`` bounds only the wait for ANOTHER thread to finish the
+    item: the forcing path runs the completion itself, and JAX has no
+    timed sync — ``block_until_ready`` waits on the device unboundedly.
+    """
+
+    __slots__ = ("kind", "meta", "_pipeline", "_packed", "_dev", "_unpack",
+                 "_event", "_result", "_error", "_callbacks", "_cb_lock")
+
+    def __init__(self, pipeline: "CodecPipeline", kind: str, meta: dict):
+        self.kind = kind
+        self.meta = meta
+        self._pipeline = weakref.ref(pipeline)
+        self._packed = None
+        self._dev = None
+        self._unpack = None
+        self._event = threading.Event()
+        self._result = None
+        self._error: BaseException | None = None
+        self._callbacks: list = []
+        self._cb_lock = threading.Lock()
+
+    # -- consumer side -----------------------------------------------------
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    @property
+    def value(self):
+        """The result, valid once done (for done-callbacks)."""
+        return self._result
+
+    @property
+    def error(self) -> BaseException | None:
+        """The failure, valid once done (for done-callbacks)."""
+        return self._error
+
+    def _force(self) -> None:
+        if not self._event.is_set():
+            pl = self._pipeline()
+            if pl is not None:
+                pl.complete(self)
+
+    def result(self, timeout: float | None = None):
+        self._force()
+        if not self._event.wait(timeout):
+            raise TimeoutError(f"pipeline item not complete within {timeout}s")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+    def exception(self, timeout: float | None = None):
+        self._force()
+        if not self._event.wait(timeout):
+            raise TimeoutError(f"pipeline item not complete within {timeout}s")
+        return self._error
+
+    def add_done_callback(self, fn) -> None:
+        """``fn(future)`` on completion; immediate when already done."""
+        with self._cb_lock:
+            if not self._event.is_set():
+                self._callbacks.append(fn)
+                return
+        fn(self)
+
+    # -- pipeline side -----------------------------------------------------
+
+    def _finish(self, result, error: BaseException | None) -> None:
+        with self._cb_lock:
+            self._result = result
+            self._error = error
+            self._event.set()
+            callbacks, self._callbacks = self._callbacks, []
+        for fn in callbacks:
+            fn(self)
+
+
+def _build_perf(name: str):
+    return (PerfCountersBuilder(name)
+            .add_u64("in_flight", "dispatched device batches not yet "
+                                  "completed (the pipeline's depth gauge)")
+            .add_u64_counter("submitted", "batches submitted to the pipeline")
+            .add_u64_counter("completed", "batches completed (fetch + unpack)")
+            .add_u64_counter("errors", "batches that failed in pack, "
+                                       "dispatch, device compute, or unpack")
+            .add_u64_counter("mesh_dispatches",
+                             "batches split across the device mesh's dp "
+                             "axis (jax_rs_mesh_devices engaged)")
+            .add_histogram("inflight_depth", DEPTH_BUCKETS,
+                           "in-flight depth observed at each dispatch")
+            .add_time_avg("pack_time", "host pack stage (overlaps in-flight "
+                                       "device compute)")
+            .add_time_avg("dispatch_time", "async device dispatch stage")
+            .add_time_avg("complete_time", "completion boundary: device "
+                                           "sync + host unpack")
+            .create_perf_counters())
+
+
+class CodecPipeline:
+    """Depth-limited async dispatch queue over the device codec.
+
+    ``depth`` bounds in-flight device batches (0 = synchronous: every
+    submit completes before returning — the comparison baseline).  When a
+    submit exceeds the bound, the OLDEST item completes first: that is
+    the pipeline's backpressure AND its completion boundary on the
+    steady-state path.
+    """
+
+    def __init__(self, depth: int | None = None,
+                 name: str = "codec_pipeline", cct=None,
+                 mesh_devices: int | None = None):
+        self.cct = cct if cct is not None else default_context()
+        conf = self.cct.conf
+        self.name = name
+        self.depth = int(conf.get("jax_rs_pipeline_depth")
+                         if depth is None else depth)
+        self.mesh_devices = int(conf.get("jax_rs_mesh_devices")
+                                if mesh_devices is None else mesh_devices)
+        self.perf = _build_perf(name)
+        self.cct.perf.add(self.perf)
+        self._lock = threading.Lock()
+        self._queue: collections.OrderedDict = collections.OrderedDict()
+        self._mesh = None
+        self._mesh_failed = False
+        self._enc_steps: "weakref.WeakKeyDictionary" = \
+            weakref.WeakKeyDictionary()
+        self._dec_step = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def close(self) -> None:
+        """Drain and unhook the perf collection (the repo's discipline:
+        a discarded component must not leave frozen gauges behind)."""
+        self.flush()
+        self.cct.perf.remove(self.perf.name)
+
+    def reopen(self) -> None:
+        """Re-register the perf collection after a close (engine restart)."""
+        self.cct.perf.add(self.perf)
+
+    @property
+    def in_flight(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    # -- submission --------------------------------------------------------
+
+    def submit(self, pack, dispatch, unpack, kind: str = "op",
+               **meta) -> PipelineFuture:
+        """Run ``pack()`` (host) and ``dispatch(packed)`` (async device
+        launch) NOW; defer ``unpack(packed, host_arrays)`` to the
+        completion boundary.  Returns the future; errors in any stage
+        land on it."""
+        fut = PipelineFuture(self, kind, meta)
+        self.perf.inc("submitted")
+        try:
+            with trace_span("pipeline.pack", kind=kind), \
+                    self.perf.time("pack_time"):
+                packed = pack() if pack is not None else None
+            fut._packed = packed
+            with trace_span("pipeline.dispatch", kind=kind), \
+                    self.perf.time("dispatch_time"):
+                fut._dev = dispatch(packed)
+            fut._unpack = unpack
+        except BaseException as e:              # noqa: BLE001 — the future
+            self.perf.inc("errors")             # carries the failure
+            fut._finish(None, e)
+            return fut
+        with self._lock:
+            self._queue[fut] = True
+            depth = len(self._queue)
+        self.perf.hinc("inflight_depth", depth)
+        self.perf.set("in_flight", depth)
+        if self.depth <= 0:
+            self.complete(fut)                  # synchronous mode
+        else:
+            while True:
+                with self._lock:
+                    if len(self._queue) <= self.depth:
+                        break
+                    oldest = next(iter(self._queue))
+                self.complete(oldest)
+        return fut
+
+    # -- completion boundary -----------------------------------------------
+
+    def complete(self, fut: PipelineFuture) -> PipelineFuture:
+        """Complete ONE item (possibly out of order): the only place the
+        serving/recovery data path waits on the device."""
+        with self._lock:
+            present = self._queue.pop(fut, _MISSING) is not _MISSING
+            self.perf.set("in_flight", len(self._queue))
+        if not present:
+            # already completed (or another thread is completing it now)
+            fut._event.wait()
+            return fut
+        result, error = None, None
+        try:
+            with trace_span("pipeline.complete", kind=fut.kind), \
+                    self.perf.time("complete_time"):
+                dev = jax.block_until_ready(fut._dev)
+                host = jax.device_get(dev)
+                result = fut._unpack(fut._packed, host) \
+                    if fut._unpack is not None else host
+        except BaseException as e:              # noqa: BLE001 — device-side
+            error = e                           # failures surface on the
+            self.perf.inc("errors")             # future, not the completer
+        self.perf.inc("completed")
+        fut._packed = fut._dev = fut._unpack = None   # free buffers promptly
+        fut._finish(result, error)
+        return fut
+
+    def complete_one(self) -> bool:
+        """Complete the oldest in-flight item; False when empty."""
+        with self._lock:
+            if not self._queue:
+                return False
+            oldest = next(iter(self._queue))
+        self.complete(oldest)
+        return True
+
+    def flush(self) -> None:
+        """Complete everything in flight (oldest first)."""
+        while self.complete_one():
+            pass
+
+    # -- device dispatch helpers (single-chip or mesh-sharded) -------------
+
+    def _mesh_ctx(self):
+        """The (cached) device mesh when ``jax_rs_mesh_devices`` engages:
+        >= 2 devices requested AND present.  A failed probe latches off —
+        the serving path must not re-raise per batch."""
+        if self.mesh_devices < 2 or self._mesh_failed:
+            return None
+        if self._mesh is None:
+            try:
+                if len(jax.devices()) < self.mesh_devices:
+                    self._mesh_failed = True
+                    return None
+                from ..parallel import mesh as meshmod
+                self._mesh = meshmod.make_mesh(self.mesh_devices)
+            except Exception:
+                self._mesh_failed = True
+                return None
+        return self._mesh
+
+    def dispatch_encode(self, codec, data_shards, chunk_size: int):
+        """``data_shards`` [k, S*chunk] host uint8 (logical row order) ->
+        device parity [m, S*chunk], dispatched async.  Splits the stripe
+        batch over the mesh's dp axis when the mesh engages and the
+        shapes divide; single-chip (donating) dispatch otherwise."""
+        mesh = self._mesh_ctx()
+        if mesh is not None:
+            out = self._mesh_encode(codec, data_shards, int(chunk_size),
+                                    mesh)
+            if out is not None:
+                return out
+        return codec.encode_device(jnp.asarray(data_shards), donate=True)
+
+    def _mesh_encode(self, codec, data_shards, c: int, mesh):
+        k, total = data_shards.shape
+        if c <= 0 or total % c:
+            return None
+        stripes = total // c
+        dp, sp = mesh.shape["dp"], mesh.shape["sp"]
+        if c % sp:
+            return None
+        step = self._enc_steps.get(codec)
+        if step is None:
+            from ..parallel import mesh as meshmod
+            step = meshmod.sharded_batch_encode_step(mesh, codec.parity_mat)
+            self._enc_steps[codec] = step
+        # [k, S*c] -> [S, k, c] (+ zero stripes up to a dp multiple: RS is
+        # positionwise-linear, zero stripes encode to zero parity)
+        data = jnp.asarray(data_shards).reshape(k, stripes, c)
+        data = jnp.swapaxes(data, 0, 1)
+        pad = (-stripes) % dp
+        if pad:
+            data = jnp.pad(data, ((0, pad), (0, 0), (0, 0)))
+        parity = step(data)
+        self.perf.inc("mesh_dispatches")
+        parity = jnp.swapaxes(parity[:stripes], 0, 1)
+        return parity.reshape(codec.m, total)
+
+    def dispatch_decode(self, codec, stack, erasures, available):
+        """``stack`` [k', S*chunk] host uint8 survivors in the sorted-src
+        order ``codec.decode_matrix(erasures, available)`` returns ->
+        device recovered rows [len(erasures), S*chunk], async.  Mesh
+        path: survivors shard over dp, partial GF products psum over ICI
+        (``sharded_decode_step``)."""
+        mesh = self._mesh_ctx()
+        if mesh is not None:
+            out = self._mesh_decode(codec, stack, erasures, available, mesh)
+            if out is not None:
+                return out
+        return codec.decode_device(jnp.asarray(stack), erasures,
+                                   available, donate=True)
+
+    def _mesh_decode(self, codec, stack, erasures, available, mesh):
+        # the DEVICE-resident matrix from the signature LRU: an LRU hit
+        # must cost zero host->device transfers on the mesh path too
+        # (the step's jnp.asarray is a no-op on a device array)
+        D, src = codec.decode_matrix_device(erasures, available)
+        kk, total = stack.shape
+        if kk != len(src):
+            return None
+        sp = mesh.shape["sp"]
+        pad = (-total) % sp
+        if self._dec_step is None:
+            from ..parallel import mesh as meshmod
+            self._dec_step = meshmod.sharded_decode_step(mesh)
+        chunks = jnp.asarray(stack)
+        if pad:
+            chunks = jnp.pad(chunks, ((0, 0), (0, pad)))
+        out = self._dec_step(D, chunks)
+        self.perf.inc("mesh_dispatches")
+        return out[:, :total] if pad else out

@@ -37,6 +37,12 @@ def make_mesh(n_devices: int | None = None, dp: int | None = None) -> Mesh:
     """A (dp, sp) mesh over the first n_devices devices."""
     devices = jax.devices()
     n = n_devices or len(devices)
+    if n > len(devices):
+        # an undersized reshape below would raise an opaque numpy error;
+        # name the real problem (the serving pipeline gates on this
+        # before building a mesh, ad-hoc callers may not)
+        raise ValueError(
+            f"mesh wants {n} devices, only {len(devices)} present")
     if dp is None:
         dp = 1
         for cand in range(int(np.sqrt(n)), 0, -1):
@@ -85,6 +91,32 @@ def sharded_encode_step(mesh: Mesh, parity_mat: np.ndarray):
         local_step, mesh=mesh,
         in_specs=(P("dp", None, "sp"),),
         out_specs=(P("dp", None, "sp"), P("dp"), P("dp", None, "sp")))
+    return jax.jit(step)
+
+
+def sharded_batch_encode_step(mesh: Mesh, parity_mat: np.ndarray):
+    """Parity-only multi-chip encode for the SERVING batch path: the same
+    dp/sp sharding and production kernel selector as
+    :func:`sharded_encode_step`, WITHOUT the placement checksum psum and
+    the dp-ring ppermute — those model scrub/fan-out for the MULTICHIP
+    dryrun, and a serving dispatch that discards them would still pay
+    their ICI traffic (jitted outputs cannot be dead-code-eliminated).
+
+    Returns step(data [B, k, N] sharded [B@dp, k, N@sp]) -> parity
+    [B, m, N], same sharding.
+    """
+    mat = jnp.asarray(parity_mat, dtype=jnp.uint8)
+    m, _k = parity_mat.shape
+
+    def local_step(data_blk):
+        b, kk, n = data_blk.shape
+        vert = data_blk.reshape(b * kk, n)
+        parity = rs_kernels.gf_apply_stripes(mat, vert, b)
+        return parity.reshape(b, m, n)
+
+    step = _shard_map(local_step, mesh=mesh,
+                      in_specs=(P("dp", None, "sp"),),
+                      out_specs=P("dp", None, "sp"))
     return jax.jit(step)
 
 

@@ -86,6 +86,14 @@ class ErasureCodeJaxRS(DeviceRouting, ErasureCode):
             return self.codec
         return self.codec if self.use_device(nbytes) else self._cpu_codec
 
+    def device_codec(self, nbytes: int) -> RSCodec | None:
+        """The device-resident codec the pipeline path may dispatch
+        through for a call of this size, or None when routing says host
+        (numpy device, or an auto call below the threshold).  The
+        capability hook ``ecutil``'s pipelined variants probe for."""
+        codec = self._route(int(nbytes))
+        return codec if codec.device == "jax" else None
+
     # -- counts ------------------------------------------------------------
 
     def get_chunk_count(self) -> int:

@@ -163,12 +163,21 @@ class RecoveryScheduler:
 
     def __init__(self, cct=None, name: str = "recovery"):
         from ..common import PerfCountersBuilder, default_context
+        from ..ops.pipeline import CodecPipeline
         self.cct = cct if cct is not None else default_context()
         self.name = name
         self._local: dict[int, AsyncReserver] = {}
         self._remote: dict[int, AsyncReserver] = {}
         self._buckets: dict[int, _TokenBucket] = {}
         self.jobs: dict[str, PGRecoveryJob] = {}
+        # one device pipeline shared by every attached PG backend: wave
+        # reconstructs dispatch async through it, so a wave's later
+        # signature groups pack on the host while earlier groups' device
+        # decodes are still in flight (depth 0 turns it off)
+        depth = int(self.cct.conf.get("jax_rs_pipeline_depth"))
+        self.pipeline = CodecPipeline(depth=depth, cct=self.cct,
+                                      name=f"recovery.{name}.pipeline") \
+            if depth > 0 else None
         self.perf = (
             PerfCountersBuilder(f"recovery.{name}")
             .add_u64_counter("jobs_scheduled",
@@ -209,6 +218,8 @@ class RecoveryScheduler:
         """Unhook from the Context and the live registry (a shut-down
         cluster must stop exporting reserver gauges)."""
         self.cct.perf.remove(self.perf.name)
+        if self.pipeline is not None:
+            self.pipeline.close()
         _SCHEDULERS.discard(self)
         self.jobs.clear()
 
@@ -248,8 +259,10 @@ class RecoveryScheduler:
     def attach_backend(self, backend, pgid, daemon,
                        pool_params: dict | None = None) -> None:
         """Wire a PG backend: revival/stall/peering repair paths then
-        route through this scheduler instead of firing inline."""
+        route through this scheduler instead of firing inline, and wave
+        reconstructs ride the scheduler's shared device pipeline."""
         backend.recovery_scheduler = self
+        backend.recovery_pipeline = self.pipeline
         backend._recovery_ctx = {"pgid": pgid, "daemon": daemon,
                                  "pool_params": dict(pool_params or {})}
 

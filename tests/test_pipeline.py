@@ -1,0 +1,347 @@
+"""Device-resident codec pipeline: async dispatch, cached decode tables.
+
+Covers the ISSUE-5 acceptance surface:
+
+- encode -> corrupt -> decode round-trips entirely through the
+  device-resident/async API at several depths, bitwise-identical to the
+  synchronous path;
+- out-of-order completion (forcing a later future first) and an injected
+  device-side failure surfacing on the future, not the dispatcher;
+- the signature-LRU's DEVICE decode-matrix cache: an LRU hit performs
+  zero host->device table transfers (``decode_table_uploads`` pinned);
+- the ``decode_batch`` permutation fast path (O(k) index map, no gather
+  on identity-after-drop);
+- the mesh-sharded serving batch path (``jax_rs_mesh_devices``) over the
+  conftest's virtual 8-device mesh, bitwise-identical again.
+"""
+import numpy as np
+import pytest
+
+from ceph_tpu.backend import ecutil
+from ceph_tpu.backend.ecutil import StripeInfo
+from ceph_tpu.exec.engine import ServingEngine
+from ceph_tpu.ops.codec import RSCodec
+from ceph_tpu.ops.pipeline import CodecPipeline
+from ceph_tpu.plugins.registry import ErasureCodePluginRegistry
+
+K, M, CHUNK = 4, 2, 1024
+
+
+@pytest.fixture
+def ec():
+    return ErasureCodePluginRegistry.instance().factory(
+        "jax_rs", "", {"plugin": "jax_rs", "k": str(K), "m": str(M),
+                       "technique": "reed_sol_van", "device": "jax"})
+
+
+@pytest.fixture
+def sinfo():
+    return StripeInfo(K, CHUNK)
+
+
+def _payloads(n, nbytes=4096, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, 256, nbytes, np.uint8).tobytes()
+            for _ in range(n)]
+
+
+# -- round trips at several depths, bitwise vs the synchronous path ----------
+
+@pytest.mark.parametrize("depth", [1, 2, 4, 8])
+def test_engine_roundtrip_bitwise_identical_to_sync(ec, sinfo, depth):
+    payloads = _payloads(10, seed=depth)
+    sync = ServingEngine(ec_impl=ec, sinfo=sinfo,
+                         name=f"sync{depth}", pipeline_depth=0)
+    pipe = ServingEngine(ec_impl=ec, sinfo=sinfo,
+                         name=f"pipe{depth}", pipeline_depth=depth)
+    try:
+        futs_s = [sync.submit_encode(p) for p in payloads]
+        sync.flush()
+        futs_p = [pipe.submit_encode(p) for p in payloads]
+        pipe.flush()
+        enc_s = [f.result(10) for f in futs_s]
+        enc_p = [f.result(10) for f in futs_p]
+        for a, b in zip(enc_s, enc_p):
+            assert set(a) == set(b)
+            for c in a:
+                np.testing.assert_array_equal(np.asarray(a[c]),
+                                              np.asarray(b[c]))
+        # corrupt: drop a data chunk and a parity chunk, decode back
+        degraded = [{c: v for c, v in e.items() if c not in (0, K + 1)}
+                    for e in enc_p]
+        dfuts = [pipe.submit_decode(d) for d in degraded]
+        pipe.flush()
+        assert [f.result(10) for f in dfuts] == [bytes(p) for p in payloads]
+    finally:
+        sync.stop()
+        pipe.stop()
+
+
+def test_threaded_engine_roundtrip(ec, sinfo):
+    payloads = _payloads(16, seed=42)
+    eng = ServingEngine(ec_impl=ec, sinfo=sinfo, name="thr",
+                        pipeline_depth=4).start()
+    try:
+        futs = [eng.submit_encode(p) for p in payloads]
+        encs = [f.result(30) for f in futs]
+        outs = [eng.decode({c: v for c, v in e.items() if c != 1},
+                           timeout=30) for e in encs]
+        assert outs == [bytes(p) for p in payloads]
+    finally:
+        eng.stop()
+
+
+# -- raw pipeline semantics --------------------------------------------------
+
+def test_out_of_order_completion():
+    pl = CodecPipeline(depth=8, name="ooo")
+    try:
+        codec = RSCodec(K, M, device="jax")
+        rng = np.random.default_rng(3)
+        blocks = [rng.integers(0, 256, (K, CHUNK), np.uint8)
+                  for _ in range(3)]
+        futs = [pl.submit(lambda b=b: b,
+                          lambda packed: pl.dispatch_encode(codec, packed,
+                                                            CHUNK),
+                          lambda packed, parity: parity)
+                for b in blocks]
+        assert pl.in_flight == 3
+        # force the LAST future first: it completes alone, the earlier
+        # ones stay dispatched
+        p2 = futs[2].result(10)
+        assert futs[2].done() and not futs[0].done()
+        assert pl.in_flight == 2
+        p0 = futs[0].result(10)
+        p1 = futs[1].result(10)
+        assert pl.in_flight == 0
+        for b, p in zip(blocks, (p0, p1, p2)):
+            np.testing.assert_array_equal(p, np.asarray(codec.encode(b)))
+    finally:
+        pl.close()
+
+
+def test_injected_failure_surfaces_on_future():
+    pl = CodecPipeline(depth=4, name="fail")
+    try:
+        # dispatch-stage failure (bad kernel launch)
+        def boom(_packed):
+            raise RuntimeError("device exploded at dispatch")
+        f1 = pl.submit(lambda: None, boom, lambda p, h: h)
+        assert isinstance(f1.exception(1), RuntimeError)
+        with pytest.raises(RuntimeError, match="at dispatch"):
+            f1.result(1)
+        # completion-boundary failure (device-side error surfaces at the
+        # deferred sync, NOT on the dispatching thread)
+        class _Wedged:
+            def block_until_ready(self):
+                raise ValueError("device-side failure at completion")
+        f2 = pl.submit(lambda: None, lambda _p: _Wedged(),
+                       lambda p, h: h)
+        assert not f2.done()            # dispatch itself succeeded
+        with pytest.raises(ValueError, match="at completion"):
+            f2.result(1)
+        assert pl.perf.get("errors") == 2
+        # the pipeline stays usable after failures
+        codec = RSCodec(K, M, device="jax")
+        data = np.arange(K * CHUNK, dtype=np.uint8).reshape(K, CHUNK)
+        f3 = pl.submit(lambda: data,
+                       lambda d: pl.dispatch_encode(codec, d, CHUNK),
+                       lambda p, h: h)
+        np.testing.assert_array_equal(f3.result(10),
+                                      np.asarray(codec.encode(data)))
+    finally:
+        pl.close()
+
+
+def test_engine_surfaces_pipeline_failure_on_batch_future(ec, sinfo,
+                                                          monkeypatch):
+    eng = ServingEngine(ec_impl=ec, sinfo=sinfo, name="efail",
+                        pipeline_depth=4)
+    try:
+        monkeypatch.setattr(
+            CodecPipeline, "dispatch_encode",
+            lambda self, codec, data, chunk: (_ for _ in ()).throw(
+                RuntimeError("injected")))
+        fut = eng.submit_encode(_payloads(1)[0])
+        eng.flush()
+        with pytest.raises(RuntimeError, match="injected"):
+            fut.result(5)
+        assert eng.perf.get("ops_failed") == 1
+    finally:
+        eng.stop()
+
+
+def test_depth_counters_and_backpressure():
+    pl = CodecPipeline(depth=2, name="depth")
+    try:
+        codec = RSCodec(K, M, device="jax")
+        rng = np.random.default_rng(5)
+        futs = []
+        for _ in range(5):
+            d = rng.integers(0, 256, (K, CHUNK), np.uint8)
+            futs.append(pl.submit(
+                lambda d=d: d,
+                lambda p: pl.dispatch_encode(codec, p, CHUNK),
+                lambda p, h: h))
+        # depth-limited: never more than `depth` in flight
+        assert pl.in_flight <= 2
+        assert pl.perf.get("submitted") == 5
+        pl.flush()
+        assert pl.in_flight == 0
+        assert pl.perf.get("completed") == 5
+        assert all(f.done() for f in futs)
+    finally:
+        pl.close()
+
+
+# -- the LRU-hit transfer counter (no decode-matrix re-upload) ---------------
+
+def test_lru_hit_uploads_no_decode_table():
+    codec = RSCodec(K, M, device="jax")
+    rng = np.random.default_rng(7)
+    data = rng.integers(0, 256, (K, CHUNK), np.uint8)
+    parity = np.asarray(codec.encode(data))
+    chunks = {i: data[i] for i in range(1, K)}
+    chunks.update({K + j: parity[j] for j in range(M)})
+    rec1 = codec.decode(dict(chunks), [0])
+    assert codec.decode_table_uploads == 1
+    # LRU hit: same signature, ZERO new table transfers
+    for _ in range(3):
+        rec2 = codec.decode(dict(chunks), [0])
+        np.testing.assert_array_equal(rec2[0], rec1[0])
+    assert codec.decode_table_uploads == 1
+    assert codec.parity_uploads == 1
+    # a different signature uploads exactly one more
+    chunks2 = {i: data[i] for i in (0, 2, 3)}
+    chunks2.update({K + j: parity[j] for j in range(M)})
+    codec.decode(chunks2, [1])
+    assert codec.decode_table_uploads == 2
+    np.testing.assert_array_equal(rec1[0], data[0])
+
+
+def test_decode_batch_uses_cached_device_matrix():
+    codec = RSCodec(K, M, device="jax")
+    rng = np.random.default_rng(9)
+    stacks = rng.integers(0, 256, (3, 8, K, CHUNK), np.uint8)
+    src = [1, 2, 3, K]        # survivors: data 1..3 + first parity
+    for i, stack in enumerate(stacks):
+        codec.decode_batch(stack, src, [0])
+        assert codec.decode_table_uploads == 1, \
+            f"decode_batch re-uploaded the matrix on call {i}"
+
+
+# -- decode_batch permutation fast path --------------------------------------
+
+def test_decode_batch_permuted_and_identity_sources():
+    codec = RSCodec(K, M, device="jax")
+    ref = RSCodec(K, M, device="numpy")
+    rng = np.random.default_rng(11)
+    data = rng.integers(0, 256, (4, K, CHUNK), np.uint8)
+    parity = np.stack([np.asarray(codec.encode(d)) for d in data])
+    full = np.concatenate([data, parity], axis=1)       # [B, K+M, CHUNK]
+    for src in ([1, 2, 3, K],            # identity (already sorted)
+                [K, 3, 1, 2],            # permuted
+                [1, 2, 3, K, K + 1]):    # extras beyond k: dropped
+        stack = full[:, src, :]
+        got = codec.decode_batch(stack, list(src), [0])
+        want = ref.decode_batch(stack, list(src), [0])
+        np.testing.assert_array_equal(got, want)
+        np.testing.assert_array_equal(got[:, 0, :], data[:, 0, :])
+
+
+def test_src_index_map_identity_and_gather():
+    assert RSCodec._src_index_map([1, 2, 3], [1, 2, 3]) is None
+    assert RSCodec._src_index_map([1, 2, 3, 9], [1, 2, 3]) is None
+    assert RSCodec._src_index_map([3, 1, 2], [1, 2, 3]) == [1, 2, 0]
+
+
+# -- device-resident decode variants (no host round-trip) --------------------
+
+def test_decode_device_and_batch_device_match_host():
+    import jax.numpy as jnp
+    codec = RSCodec(K, M, device="jax")
+    ref = RSCodec(K, M, device="numpy")
+    rng = np.random.default_rng(13)
+    data = rng.integers(0, 256, (2, K, CHUNK), np.uint8)
+    parity = np.stack([np.asarray(codec.encode(d)) for d in data])
+    full = np.concatenate([data, parity], axis=1)
+    src = [1, 2, 3, K]
+    out = codec.decode_batch_device(jnp.asarray(full[:, src, :]),
+                                    src, [0])
+    want = ref.decode_batch(full[:, src, :], src, [0])
+    np.testing.assert_array_equal(np.asarray(out), want)
+    # single-stack variant, survivors already in sorted-src order
+    one = codec.decode_device(jnp.asarray(full[0][src]), [0],
+                              available=src)
+    np.testing.assert_array_equal(np.asarray(one)[0], data[0, 0])
+
+
+# -- the mesh-sharded serving batch path -------------------------------------
+
+def test_mesh_serving_batches_bitwise_identical(ec, sinfo):
+    payloads = _payloads(8, seed=17)
+    plain = ServingEngine(ec_impl=ec, sinfo=sinfo, name="m0",
+                          pipeline_depth=4)
+    meshed = ServingEngine(ec_impl=ec, sinfo=sinfo, name="m8",
+                           pipeline_depth=4)
+    meshed.pipeline.mesh_devices = 8       # conftest forces 8 cpu devices
+    try:
+        futs_a = [plain.submit_encode(p) for p in payloads]
+        plain.flush()
+        futs_b = [meshed.submit_encode(p) for p in payloads]
+        meshed.flush()
+        assert meshed.pipeline.perf.get("mesh_dispatches") > 0, \
+            "mesh path did not engage"
+        encs = []
+        for fa, fb in zip(futs_a, futs_b):
+            a, b = fa.result(10), fb.result(10)
+            for c in a:
+                np.testing.assert_array_equal(np.asarray(a[c]),
+                                              np.asarray(b[c]))
+            encs.append(b)
+        degraded = [{c: v for c, v in e.items() if c != 0} for e in encs]
+        before = meshed.pipeline.perf.get("mesh_dispatches")
+        dfuts = [meshed.submit_decode(d) for d in degraded]
+        meshed.flush()
+        assert [f.result(10) for f in dfuts] == [bytes(p) for p in payloads]
+        assert meshed.pipeline.perf.get("mesh_dispatches") > before
+    finally:
+        plain.stop()
+        meshed.stop()
+
+
+def test_mesh_option_ignored_when_too_few_devices(ec, sinfo):
+    eng = ServingEngine(ec_impl=ec, sinfo=sinfo, name="m64",
+                        pipeline_depth=4)
+    eng.pipeline.mesh_devices = 64         # more than the virtual mesh has
+    try:
+        fut = eng.submit_encode(_payloads(1)[0])
+        eng.flush()
+        assert fut.result(10)              # falls back to single-chip
+        assert eng.pipeline.perf.get("mesh_dispatches") == 0
+    finally:
+        eng.stop()
+
+
+# -- recovery wave decode through the pipeline -------------------------------
+
+def test_decode_shards_many_pipelined_matches_sync(ec, sinfo):
+    bufs = _payloads(6, seed=19)
+    encoded = ecutil.encode_many(sinfo, ec, bufs)
+    # two distinct survivor signatures in one wave
+    batches = []
+    for i, chunks in enumerate(encoded):
+        lost = 0 if i % 2 else 1
+        batches.append(({c: v for c, v in chunks.items() if c != lost},
+                        {lost}))
+    sync = ecutil.decode_shards_many(sinfo, ec, batches)
+    pl = CodecPipeline(depth=4, name="wave")
+    try:
+        piped = ecutil.decode_shards_many(sinfo, ec, batches, pipeline=pl)
+    finally:
+        pl.close()
+    for a, b in zip(sync, piped):
+        assert set(a) == set(b)
+        for c in a:
+            np.testing.assert_array_equal(np.asarray(a[c]),
+                                          np.asarray(b[c]))
