@@ -545,7 +545,34 @@ def emit(value, vs_baseline, extra):
         line["phase_in_flight"] = name
     line["phases"] = phases
     line["probe_history"] = list(_PROBE_HISTORY)
+    # perf-regression gate (tools/perf_gate.py): every artifact carries
+    # its own verdict vs the repo's BENCH history — a >threshold drop or
+    # a TPU->CPU platform fallback lands as gate.ok=false in the very
+    # JSON the driver records, instead of a silently degraded number
+    # (the r05 lesson).  Best-effort: the gate must never block the line.
+    try:
+        gate = _run_perf_gate(line)
+        if gate is not None:
+            line["gate"] = gate
+            print(f"# {gate['verdict']}", file=sys.stderr)
+    except Exception as e:                      # noqa: BLE001 — telemetry
+        print(f"# perf gate skipped: {e!r}", file=sys.stderr)
     print(json.dumps(line), flush=True)
+
+
+def _run_perf_gate(line: dict) -> dict | None:
+    """Load tools/perf_gate.py (stdlib-only, not a package) and evaluate
+    this line against the BENCH_r history next to this script."""
+    import importlib.util
+    repo_dir = os.path.dirname(os.path.abspath(__file__))
+    path = os.path.join(repo_dir, "tools", "perf_gate.py")
+    if not os.path.exists(path):
+        return None
+    spec = importlib.util.spec_from_file_location("ceph_tpu_perf_gate",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.gate_for_bench(line, repo_dir)
 
 
 def arm_watchdog(seconds, value, vs_baseline, extra):

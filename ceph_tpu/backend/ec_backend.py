@@ -436,29 +436,21 @@ class ECBackend(PGBackend):
                 self.extent_cache.claim(oid, op.tid, off, data)
                 op.cache_claims.append((oid, op.tid))
             # hash maintenance: pure appends chain the crc (HashInfo::append,
-            # ECUtil.cc:161-177).  A WHOLESALE rewrite has every chunk
-            # byte in hand, so fresh cumulative hashes are re-derived
-            # instead of cleared — hash-less objects are what let a
-            # degraded exactly-k rebuild launder silent rot into parity
-            # with nothing left to cross-check (seed-244 soak: one rotten
-            # source re-encoded into a self-consistent wrong clone).
-            # Only PARTIAL overwrites still clear (mid-stream crc is
-            # unknowable); deep scrub's parity-consistency fallback
-            # covers those.
+            # ECUtil.cc:161-177); every OVERWRITE clears the hashes —
+            # a mid-stream crc is unknowable, and re-deriving fresh
+            # digests from the primary's own encode would certify bytes
+            # nothing independent ever checked (scrub would then "locate"
+            # rot against a self-issued receipt).  Hash-less objects are
+            # covered honestly instead: deep scrub's parity-consistency
+            # fallback detects rot (and locates it when m >= 2), and
+            # verified recovery over inconsistent sources records
+            # OBJECT_DAMAGED when one spare equation can detect but not
+            # place the rot — rather than laundering it as repaired.
             total = hinfo.projected_total_chunk_size
             if pure_append and appended:
                 hinfo.append(old_size, append_chunks)
             elif not pure_append:
-                if len(pieces) == 1 and pieces[0][0] == 0 and \
-                        c_cursor == total:
-                    # explicit reset: a preceding truncate may have
-                    # EMPTIED the hash array (clear() would keep it so)
-                    hinfo.cumulative_shard_hashes = [0xFFFFFFFF] * n
-                    hinfo.total_chunk_size = 0
-                    hinfo.append(0, {c: encoded[c][:total]
-                                     for c in range(n)})
-                else:
-                    hinfo.set_total_chunk_size_clear_hash(total)
+                hinfo.set_total_chunk_size_clear_hash(total)
             self._persist_hinfo(oid, hinfo, shard_txns)
         return shard_txns, log_entries
 
@@ -810,7 +802,8 @@ class ECBackend(PGBackend):
     def _recovery_prepare_sources(self, oid: str,
                                   read_results: dict[int, object],
                                   read_attrs: dict[int, dict],
-                                  missing: set[int]
+                                  missing: set[int],
+                                  verify_parity: bool = True
                                   ) -> tuple[dict[int, np.ndarray],
                                              HashInfo, set[int], dict]:
         """Turn raw recovery-read replies into decode-ready inputs — ONE
@@ -856,9 +849,14 @@ class ECBackend(PGBackend):
         # whole-chunk reads may catch sources mid-update at different
         # lengths: normalize to the adopted hinfo's size — a source whose
         # bytes are from another version then fails its crc (or the
-        # parity-consistency check) and is dropped/rebuilt below
+        # parity-consistency check) and is dropped/rebuilt below.
+        # Sub-chunk codes (clay) are exempt: their repair reads are
+        # INTENTIONALLY shorter than the chunk (fractional sub-chunk
+        # runs), and padding them to full length makes the plugin
+        # mistake them for whole chunks and full-decode garbage — the
+        # seed's wrong-bytes clay recovery (ROADMAP item 1).
         total = hinfo.get_total_chunk_size()
-        if total:
+        if total and self.ec_impl.get_sub_chunk_count() == 1:
             available = {
                 c: (v if len(v) == total else np.frombuffer(
                     v.tobytes()[:total].ljust(total, b"\0"),
@@ -882,24 +880,16 @@ class ECBackend(PGBackend):
                 # not enough clean sources to rebuild everything: the
                 # reconstruction would embed rot — record damage
                 self.inconsistent_objects.add(oid)
-        if not hinfo.has_chunk_hash() and len(available) > k \
+        if verify_parity and not hinfo.has_chunk_hash() \
+                and len(available) > k \
                 and self.ec_impl.get_sub_chunk_count() == 1:
             # verified recovery (see _recovery_issue_reads): cross-check
             # the sources with the spare equations and DROP a located
-            # rotten source instead of baking it into the rebuilt chunk
-            out_map = {c: True for c in available}
-            self._parity_consistency_scrub(
-                oid, {c: v.tobytes() for c, v in available.items()},
-                out_map)
-            bad = [c for c, ok in out_map.items() if not ok]
-            if len(bad) == 1 and len(available) - 1 >= k:
-                missing |= set(bad)
-                del available[bad[0]]
-            elif bad:
-                # inconsistent but unlocatable (one spare equation can
-                # DETECT rot, never place it): the rebuild may launder
-                # corruption — record the object as damaged
-                self.inconsistent_objects.add(oid)
+            # rotten source instead of baking it into the rebuilt chunk.
+            # (The batched wave passes verify_parity=False and runs ONE
+            # fused check per survivor signature instead.)
+            available, missing = self._verify_parity_sources(
+                oid, available, missing)
         # pushes REPLACE the target object, so the replicated attrs
         # (user xattrs, object_info, snapset — identical on every shard)
         # must travel too, from a CURRENT copy; without them, repairing a
@@ -920,6 +910,59 @@ class ECBackend(PGBackend):
         attrs = {**{a: v for a, v in base.items() if a != HINFO_KEY},
                  **attrs}
         return available, hinfo, missing, attrs
+
+    def _verify_parity_sources(self, oid: str,
+                               available: dict[int, np.ndarray],
+                               missing: set[int]
+                               ) -> tuple[dict[int, np.ndarray], set[int]]:
+        """Per-object spare-equation cross-check of hash-less recovery
+        sources: a LOCATED rotten source is dropped and rebuilt; rot the
+        spare equations can detect but not place marks OBJECT_DAMAGED
+        (rebuilding would launder it, and erasing the trace is the seed
+        regression this PR's satellite pins)."""
+        k = self.ec_impl.get_data_chunk_count()
+        out_map = {c: True for c in available}
+        self._parity_consistency_scrub(
+            oid, {c: v.tobytes() for c, v in available.items()}, out_map)
+        bad = [c for c, ok in out_map.items() if not ok]
+        if len(bad) == 1 and len(available) - 1 >= k:
+            missing = missing | set(bad)
+            available = {c: v for c, v in available.items() if c != bad[0]}
+        elif bad:
+            # inconsistent but unlocatable (one spare equation can
+            # DETECT rot, never place it): the rebuild may launder
+            # corruption — record the object as damaged
+            self.inconsistent_objects.add(oid)
+        return available, missing
+
+    def _spare_equations_consistent(self,
+                                    chunks: dict[int, np.ndarray]) -> bool:
+        """ONE-decode detection over > k normalized chunk streams:
+        reconstruct every spare chunk from a k-subset and compare against
+        what the sources served.  For the MDS codes this path serves
+        (jax_rs/isa/jerasure RS, xor) any single-chunk delta propagates
+        into at least one reconstructed spare, so clean == consistent;
+        plugins whose k-subsets are not all decodable (shec/lrc) raise
+        and fall back to the thorough per-target scan.  This is the
+        batched wave's fused verification: linear codes make the check
+        distribute over concatenation, so one call covers every object
+        sharing the survivor signature."""
+        k = self.ec_impl.get_data_chunk_count()
+        ids = sorted(chunks)
+        spares = ids[k:]
+        if not spares:
+            return True                # no redundancy: vacuously consistent
+        length = int(len(chunks[ids[0]]))
+        try:
+            rec = self.ec_impl.decode(
+                set(spares), {i: chunks[i] for i in ids[:k]}, length)
+        except Exception:              # non-MDS subset: thorough fallback
+            out_map = {c: True for c in ids}
+            self._parity_consistency_scrub(
+                "", {c: v.tobytes() for c, v in chunks.items()}, out_map)
+            return all(out_map.values())
+        return all(np.array_equal(np.asarray(rec[s], dtype=np.uint8),
+                                  chunks[s]) for s in spares)
 
     def _recovery_push_payloads(self, rop: RecoveryOp
                                 ) -> dict[
@@ -1012,6 +1055,9 @@ class ECBackend(PGBackend):
         self._recovery_waves.pop(wave.tid, None)
         k = self.ec_impl.get_data_chunk_count()
         ready: list[tuple[str, dict, set, dict]] = []
+        # hash-less objects needing the spare-equation cross-check,
+        # grouped by survivor signature for ONE fused check per group
+        unverified: dict[frozenset, list[int]] = {}
         for oid in sorted(wave.oids):
             if oid in wave.fallback:
                 continue
@@ -1028,14 +1074,41 @@ class ECBackend(PGBackend):
                 # the reconstructed bytes would be stale — re-drive
                 wave.fallback.add(oid)
                 continue
-            available, _hinfo, missing, attrs = \
+            available, hinfo, missing, attrs = \
                 self._recovery_prepare_sources(
                     oid, wave.results.get(oid, {}),
-                    wave.attrs.get(oid, {}), set(wave.oids[oid]))
+                    wave.attrs.get(oid, {}), set(wave.oids[oid]),
+                    verify_parity=False)
             if len(available) < k or not missing:
                 wave.fallback.add(oid)
                 continue
+            if not hinfo.has_chunk_hash() and len(available) > k:
+                unverified.setdefault(frozenset(available),
+                                      []).append(len(ready))
             ready.append((oid, available, missing, attrs))
+        # fused verified recovery: the code is linear, so a signature
+        # group's CONCATENATED streams are spare-equation-consistent iff
+        # every member object is — one decode verifies the whole group
+        # (the per-object scan cost one decode per chunk per object,
+        # which dwarfed the fused reconstruct the wave exists for).
+        # Only an inconsistent group pays the per-object localization.
+        for sig, idxs in sorted(unverified.items(),
+                                key=lambda kv: kv[1][0]):
+            concat = {c: np.concatenate([ready[i][1][c] for i in idxs])
+                      for c in sorted(sig)}
+            if self._spare_equations_consistent(concat):
+                continue
+            for i in idxs:
+                oid, available, missing, attrs = ready[i]
+                # _verify_parity_sources drops at most one source, and
+                # only while >= k remain; missing only ever grows from a
+                # non-empty entry — so the member stays decodable (and a
+                # future violation surfaces via the decode's exception
+                # fallback below)
+                available, missing = self._verify_parity_sources(
+                    oid, dict(available), set(missing))
+                ready[i] = (oid, available, missing, attrs)
+        ready = [r for r in ready if r[0] not in wave.fallback]
         rebuilt: list[dict] = []
         if ready:
             try:

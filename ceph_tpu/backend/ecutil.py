@@ -313,7 +313,8 @@ def _device_codec(ec_impl, nbytes: int):
 
 
 def encode_many_pipelined(sinfo: StripeInfo, ec_impl,
-                          bufs: list[bytes | np.ndarray], pipeline):
+                          bufs: list[bytes | np.ndarray], pipeline,
+                          owner: str | None = None):
     """Async :func:`encode_many`: returns a ``PipelineFuture`` resolving
     to the identical per-buffer ``{chunk: bytes}`` list, or None when the
     codec has no device path.  Pack (shard-major relayout) runs now and
@@ -359,13 +360,14 @@ def encode_many_pipelined(sinfo: StripeInfo, ec_impl,
         return out
 
     return pipeline.submit(pack, dispatch, unpack, kind="encode",
-                           ops=len(bufs))
+                           owner=owner, ops=len(bufs))
 
 
 def decode_many_pipelined(sinfo: StripeInfo, ec_impl,
                           batches: list[dict[int, np.ndarray]],
                           pipeline, pad_chunks=None,
-                          chunk_size: int | None = None):
+                          chunk_size: int | None = None,
+                          owner: str | None = None):
     """Async :func:`decode_many`: one pipeline item per distinct
     available-chunk signature.  Returns ``[(idxs, future), ...]`` where
     each future resolves to the logical bytes for those batch indices, or
@@ -385,12 +387,13 @@ def decode_many_pipelined(sinfo: StripeInfo, ec_impl,
         pending.append((list(idxs),
                         _submit_decode_group(sinfo, ec_impl, codec, batches,
                                              sig, idxs, pipeline, pad_chunks,
-                                             chunk_size)))
+                                             chunk_size, owner)))
     return pending
 
 
 def _submit_decode_group(sinfo, ec_impl, codec, batches, sig, idxs,
-                         pipeline, pad_chunks, chunk_size):
+                         pipeline, pad_chunks, chunk_size,
+                         owner: str | None = None):
     """One signature group's pack/dispatch/unpack trio, submitted."""
     k = ec_impl.get_data_chunk_count()
 
@@ -431,7 +434,7 @@ def _submit_decode_group(sinfo, ec_impl, codec, batches, sig, idxs,
         return out
 
     return pipeline.submit(pack, dispatch, unpack, kind="decode",
-                           ops=len(idxs))
+                           owner=owner, ops=len(idxs))
 
 
 def decode(sinfo: StripeInfo, ec_impl,
@@ -520,7 +523,8 @@ def decode_many(sinfo: StripeInfo, ec_impl,
 
 def decode_shards_many(sinfo: StripeInfo, ec_impl,
                        batches: list[tuple[dict[int, np.ndarray], set]],
-                       pipeline=None) -> list[dict[int, np.ndarray]]:
+                       pipeline=None, owner: str | None = "recovery"
+                       ) -> list[dict[int, np.ndarray]]:
     """Reconstruct specific shards for MANY objects with ONE
     ``ec_impl.decode`` per distinct (survivor signature, want set) — the
     recovery-side sibling of :func:`decode_many`.  Parity is positionwise,
@@ -547,7 +551,7 @@ def decode_shards_many(sinfo: StripeInfo, ec_impl,
                           []).append(i)
     if pipeline is not None:
         pending = _decode_shards_groups_pipelined(sinfo, ec_impl, batches,
-                                                  by_sig, pipeline)
+                                                  by_sig, pipeline, owner)
         if pending is not None:
             # every group is dispatched before the first fetch: the host
             # pack of later groups overlapped earlier device compute
@@ -568,7 +572,7 @@ def decode_shards_many(sinfo: StripeInfo, ec_impl,
 
 
 def _decode_shards_groups_pipelined(sinfo, ec_impl, batches, by_sig,
-                                    pipeline):
+                                    pipeline, owner: str | None = "recovery"):
     """Submit every (signature, want) recovery group through the device
     pipeline; ``[(idxs, future), ...]`` or None when no device path."""
     total_bytes = sum(sum(_as_u8(v).nbytes for v in avail.values())
@@ -610,7 +614,8 @@ def _decode_shards_groups_pipelined(sinfo, ec_impl, batches, by_sig,
 
         pending.append((list(idxs),
                         pipeline.submit(pack, dispatch, unpack,
-                                        kind="recover", ops=len(idxs))))
+                                        kind="recover", owner=owner,
+                                        ops=len(idxs))))
     return pending
 
 

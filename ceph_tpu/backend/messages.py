@@ -15,6 +15,7 @@ from dataclasses import dataclass, field
 
 from .extent import ExtentSet
 from .memstore import Transaction
+from ..common.tracer import default_tracer
 
 
 @dataclass
@@ -40,6 +41,10 @@ class ECSubWrite:
     # primary can tell fresh acks from stale ones (the role op reqids and
     # the osdmap epoch stamp play in the reference)
     gen: int = 0
+    # distributed-trace context (stamped by PGChannel.send from the
+    # sender's active trace): the receiving shard's spans stitch under
+    # the originating client op
+    trace: object = None
 
 
 @dataclass
@@ -84,6 +89,8 @@ class ECSubRead:
     # denominator for subchunk_runs (codec's get_sub_chunk_count(); the
     # reference ships it inside the run offsets, ECMsgTypes.h:105-116)
     sub_chunk_count: int = 1
+    # distributed-trace context (see ECSubWrite.trace)
+    trace: object = None
 
 
 @dataclass
@@ -223,6 +230,9 @@ class PGEnvelope:
     pgid: object
     msg: object
     from_shard: int | None = None
+    # the sender's active TraceContext: the destination OSD activates it
+    # around dispatch so its spans join the originating op's trace
+    trace: object = None
 
 
 class OSDEndpoint:
@@ -242,7 +252,22 @@ class OSDEndpoint:
         if ch is None:
             return           # PG deleted/moved: drop, like an unknown spg_t
         handler = ch.handlers.get(self.osd)
-        if handler is not None:
+        if handler is None:
+            return
+        # the payload's own trace field (ECSubRead/ECSubWrite) wins: it
+        # is stamped once and stays stable across reissues, while the
+        # envelope's is whatever context the (re)sender held
+        ctx = getattr(msg.msg, "trace", None) or msg.trace
+        if ctx is None:
+            handler.handle_message(msg.msg)
+            return
+        # a traced message: this OSD's dispatch becomes a child span on
+        # its own track, so the stitched Chrome trace shows the sub-op
+        # crossing the daemon boundary (client -> primary -> this shard)
+        tr = default_tracer()
+        with tr.activate(ctx, track=f"osd.{self.osd}"), \
+                tr.span(f"osd.{type(msg.msg).__name__}", cat="rpc",
+                        owner=ctx.op_class):
             handler.handle_message(msg.msg)
 
 
@@ -277,8 +302,15 @@ class PGChannel:
                 ep.pg_channels.pop(self.pgid, None)
 
     def send(self, to_shard: int, msg) -> None:
+        # trace propagation across the daemon boundary: stamp the
+        # sender's active context onto the envelope AND onto payloads
+        # that declare a trace field (ECSubRead/ECSubWrite — the wire
+        # shape the reference's blkin hooks annotate)
+        ctx = default_tracer().current_ctx()
+        if ctx is not None and getattr(msg, "trace", True) is None:
+            msg.trace = ctx
         self.bus.send(to_shard, PGEnvelope(
-            self.pgid, msg, getattr(msg, "from_shard", None)))
+            self.pgid, msg, getattr(msg, "from_shard", None), trace=ctx))
 
     # -- delegation to the shared bus ---------------------------------------
 

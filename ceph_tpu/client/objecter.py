@@ -22,6 +22,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
+from ..common.tracer import default_tracer
 from ..osdmap import PG, OSDMap, ceph_stable_mod
 from ..osdmap.str_hash import ceph_str_hash_rjenkins
 
@@ -44,6 +45,9 @@ class _Op:
     attempts: int = 0
     done: bool = False
     result: object = None
+    # the op's root TraceContext: every send/resend (and the whole
+    # cross-daemon fan-out below it) stitches under ONE trace id
+    trace: object = None
 
 
 class Objecter:
@@ -119,11 +123,21 @@ class Objecter:
         op.attempts += 1
         ps, primary, acting = self._calc_target(op.pool_id, op.oid)
         op.target = (ps, primary, acting)
-        reply = self.cluster.osd_submit(
-            op.pool_id, ps, primary, self.osdmap.epoch,
-            oid=op.oid, data=op.data, read_len=op.read_len, ops=op.ops,
-            snapid=op.snapid, drain=op.drain,
-            on_done=lambda result, _op=op: self._op_done(_op, result))
+        # the client edge of the distributed trace: one root context per
+        # op (resends reuse it — they are the same logical op), activated
+        # around the dispatch so the whole server-side fan-out chains
+        # under the client.op span on the 'client' track
+        tr = default_tracer()
+        if op.trace is None:
+            op.trace = tr.new_trace("client")
+        with tr.activate(op.trace, track="client"), \
+                tr.span("client.op", cat="client", oid=op.oid,
+                        tid=op.tid, attempt=op.attempts):
+            reply = self.cluster.osd_submit(
+                op.pool_id, ps, primary, self.osdmap.epoch,
+                oid=op.oid, data=op.data, read_len=op.read_len, ops=op.ops,
+                snapid=op.snapid, drain=op.drain,
+                on_done=lambda result, _op=op: self._op_done(_op, result))
         if reply is not None:             # ("stale", current_map)
             _, newer = reply
             self.stale_rejects += 1

@@ -751,6 +751,14 @@ class MiniCluster:
             return None
         daemon = self.osds[g.backend.whoami]
         primary_dead = g.backend.whoami in g.bus.down
+        # every client op gets a trace context here, the MOSDOp dispatch
+        # edge: an ambient one (Objecter / net.py RPC / an operate() call
+        # inside a traced scope) is adopted, otherwise a fresh client
+        # root — so the daemon's spans and every sub-op fanned out below
+        # stitch into one cross-daemon trace
+        from .common.tracer import default_tracer
+        tr = default_tracer()
+        trace_ctx = tr.current_ctx() or tr.new_trace("client")
 
         def _done(reply):
             if g.backend.local_shard.store.exists(
@@ -761,7 +769,8 @@ class MiniCluster:
             if on_done:
                 on_done(reply)
         m = MOSDOp(oid=oid, ops=ops, epoch=epoch, snapid=snapid,
-                   snapc=self._snap_context(pool_id), internal=internal)
+                   snapc=self._snap_context(pool_id), internal=internal,
+                   trace=trace_ctx)
         res = daemon.ms_dispatch(g.pgid, m, _done)
         if res is not None and res[0] == "throttled" and not primary_dead:
             # bounded daemon queue hit (osd_queue_throttle_ops): the
@@ -1147,6 +1156,15 @@ class MiniCluster:
         the new layout — read every object through the old group (degraded
         reads reconstruct), re-encode into a fresh group (the reference's
         backfill)."""
+        from .common.tracer import default_tracer
+        tr = default_tracer()
+        with tr.activate(tr.new_trace("rebalance")), \
+                tr.span("backfill.pg", owner="rebalance",
+                        pg=f"{pool_id}.{ps}"):
+            self._backfill_pg_traced(pool_id, ps, new_acting, ec)
+
+    def _backfill_pg_traced(self, pool_id: int, ps: int,
+                            new_acting: list[int], ec) -> None:
         old = self.pools[pool_id]["pgs"][ps]
         damaged = set(getattr(old.backend, "inconsistent_objects", ()))
         # read everything out of the old layout FIRST: in durable mode the

@@ -25,6 +25,10 @@ class Context:
         # the process-wide jit telemetry collection: shared by every
         # Context so any `perf dump` / prometheus render carries it
         self.perf.add(tracer_mod.jit_perf_counters())
+        # the device-time attribution ledger (who occupies the chip, by
+        # owner class) — process-wide for the same reason
+        from . import device_attribution
+        self.perf.add(device_attribution.perf_counters())
 
         self.admin_socket.register(
             "perf dump", lambda **kw: self.perf.perf_dump(),
@@ -73,6 +77,13 @@ class Context:
         self.admin_socket.register(
             "jit reset", lambda **kw: tracer_mod.jit_reset(),
             "clear the per-(function, shape) JIT telemetry records")
+
+        def _device_top(limit: str = "10", **kw):
+            return device_attribution.device_top(int(limit))
+        self.admin_socket.register(
+            "device top", _device_top,
+            "device occupancy by owner class (client/serving/recovery/"
+            "scrub/rebalance) + costliest compiled executables")
 
     def dout(self, subsys: str, level: int, message: str) -> None:
         self.log.dout(subsys, level, message)

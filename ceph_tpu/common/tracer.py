@@ -19,18 +19,82 @@ backend probing).  This module provides:
 
 Everything here is stdlib-only so the bench driver can import it before
 any JAX backend initializes.
+
+Distributed tracing (the PR-6 tentpole): a :class:`TraceContext`
+(trace id, parent span id, owner op class) rides every client op across
+daemon boundaries — Objecter ops, net.py RPC frames, the OSD daemon's
+queued dispatch, and the PG bus's ECSubRead/ECSubWrite envelopes.  Each
+daemon ``activate()``s the inbound context and stamps its spans with a
+per-daemon *track* (``osd.3``, ``client``), so :meth:`Tracer.dump` can
+stitch the per-daemon span trees into ONE Chrome trace with one process
+row per daemon, and ``tools/trace_report.py --trace`` can answer "where
+did this 1 MiB write spend its 4 ms".
 """
 from __future__ import annotations
 
+import itertools
 import os
+import random
 import threading
 import time
 from collections import deque
+from dataclasses import dataclass
 
 # log-spaced span-latency bounds (seconds); one overflow bucket follows
 LATENCY_BUCKETS_S = (1e-6, 1e-5, 1e-4, 1e-3, 1e-2, 1e-1, 1.0, 10.0)
 
 TRACE_CAPACITY = int(os.environ.get("CEPH_TPU_TRACE_CAPACITY", 16384))
+
+# process-wide id allocators: ids must stay unique across every Tracer
+# instance (cross-daemon stitching joins on them).  The high word is a
+# per-process random salt: in multi-process mode (rados serve +
+# --connect) each client process allocates its own ids, and sequential
+# small ints would collide in the server's stitched dump, silently
+# merging unrelated ops into one tree.
+_id_salt = random.getrandbits(31) << 32
+_trace_ids = itertools.count(_id_salt + 1)
+_span_ids = itertools.count(_id_salt + 1)
+
+
+@dataclass
+class TraceContext:
+    """What rides the wire: enough to stitch a child daemon's spans
+    under the caller's (trace id + parent span id) and to attribute the
+    work to an owner class (client/serving/recovery/scrub/rebalance).
+    Picklable on purpose — net.py RPC frames and wire-mode bus envelopes
+    serialize it."""
+    trace_id: int
+    span_id: int          # the span new children hang under (0 = root)
+    op_class: str = "client"
+
+    def child_of(self, span_id: int) -> "TraceContext":
+        return TraceContext(self.trace_id, span_id, self.op_class)
+
+
+class _Activation:
+    """Context manager pushing a TraceContext (and optional track) onto
+    the calling thread's stacks.  ``ctx=None`` is a no-op so call sites
+    need no branching for untraced messages."""
+
+    __slots__ = ("tracer", "ctx", "track", "_pushed")
+
+    def __init__(self, tracer: "Tracer", ctx: TraceContext | None,
+                 track: str | None = None):
+        self.tracer = tracer
+        self.ctx = ctx
+        self.track = track
+        self._pushed = False
+
+    def __enter__(self) -> TraceContext | None:
+        if self.ctx is not None or self.track is not None:
+            self.tracer._ctx_stack().append((self.ctx, self.track))
+            self._pushed = True
+        return self.ctx
+
+    def __exit__(self, *exc) -> bool:
+        if self._pushed:
+            self.tracer._ctx_stack().pop()
+        return False
 
 
 class Span:
@@ -39,7 +103,8 @@ class Span:
     ring buffer holds only finished spans."""
 
     __slots__ = ("tracer", "name", "cat", "args", "tid", "ts_us", "dur",
-                 "_t0")
+                 "_t0", "trace_id", "span_id", "parent_id", "track",
+                 "_ctx_pushed")
 
     def __init__(self, tracer: "Tracer", name: str, cat: str, args: dict):
         self.tracer = tracer
@@ -50,6 +115,13 @@ class Span:
         self.ts_us = 0.0
         self.dur = 0.0
         self._t0 = 0.0
+        # distributed-trace linkage, filled on __enter__ when a
+        # TraceContext is active on this thread
+        self.trace_id = 0
+        self.span_id = 0
+        self.parent_id = 0
+        self.track: str | None = None
+        self._ctx_pushed = False
 
     def set(self, **args) -> "Span":
         """Attach results discovered mid-span (e.g. bytes moved)."""
@@ -58,12 +130,25 @@ class Span:
 
     def __enter__(self) -> "Span":
         self.tracer._push(self)
+        ctx = self.tracer.current_ctx()
+        if ctx is not None:
+            self.trace_id = ctx.trace_id
+            self.span_id = next(_span_ids)
+            self.parent_id = ctx.span_id
+            # nested spans (this thread, while we are open) chain under us
+            self.tracer._ctx_stack().append((ctx.child_of(self.span_id),
+                                             None))
+            self._ctx_pushed = True
+        self.track = self.tracer.current_track()
         self._t0 = time.perf_counter()
         self.ts_us = (self._t0 - self.tracer._t0) * 1e6
         return self
 
     def __exit__(self, *exc) -> bool:
         self.dur = time.perf_counter() - self._t0
+        if self._ctx_pushed:
+            self.tracer._ctx_stack().pop()
+            self._ctx_pushed = False
         self.tracer._pop(self)
         self.tracer._finish_span(self)
         return False
@@ -107,6 +192,45 @@ class Tracer:
     def depth(self) -> int:
         return len(self._stack())
 
+    # -- distributed trace contexts (per thread) ----------------------------
+
+    def _ctx_stack(self) -> list:
+        st = getattr(self._local, "ctx_stack", None)
+        if st is None:
+            st = self._local.ctx_stack = []
+        return st
+
+    def new_trace(self, op_class: str = "client") -> TraceContext:
+        """A fresh root context (span_id 0): the client edge of an op."""
+        return TraceContext(next(_trace_ids), 0, op_class)
+
+    def current_ctx(self) -> TraceContext | None:
+        """The innermost active TraceContext on this thread (None when
+        the current work is untraced)."""
+        for ctx, _track in reversed(self._ctx_stack()):
+            if ctx is not None:
+                return ctx
+        return None
+
+    def current_track(self) -> str | None:
+        """The innermost daemon track ('osd.3', 'client', ...) active on
+        this thread; spans default their track from it."""
+        for _ctx, track in reversed(self._ctx_stack()):
+            if track is not None:
+                return track
+        return None
+
+    def activate(self, ctx: TraceContext | None,
+                 track: str | None = None) -> _Activation:
+        """Adopt an inbound trace context (and optionally name the local
+        daemon track) for the duration of a ``with`` block.  ``ctx=None``
+        activates only the track; both None is a no-op."""
+        return _Activation(self, ctx, track)
+
+    def track_scope(self, track: str) -> _Activation:
+        """Name the local daemon track without touching the context."""
+        return _Activation(self, None, track)
+
     # -- recording ----------------------------------------------------------
 
     def span(self, name: str, cat: str = "", **args) -> Span:
@@ -139,8 +263,15 @@ class Tracer:
         ev = {"name": span.name, "cat": span.cat or "span", "ph": "X",
               "ts": span.ts_us, "dur": span.dur * 1e6,
               "pid": self.pid, "tid": span.tid}
-        if span.args:
-            ev["args"] = dict(span.args)
+        args = dict(span.args) if span.args else {}
+        if span.trace_id:
+            args["trace_id"] = span.trace_id
+            args["span_id"] = span.span_id
+            args["parent_span_id"] = span.parent_id
+        if args:
+            ev["args"] = args
+        if span.track is not None:
+            ev["track"] = span.track
         with self._lock:
             self._events.append(ev)
         self._hist_add(span.name, span.dur)
@@ -163,11 +294,37 @@ class Tracer:
 
     # -- export --------------------------------------------------------------
 
-    def dump(self) -> dict:
+    def dump(self, stitched: bool = True) -> dict:
         """Chrome trace-event JSON (the ``trace dump`` admin command):
-        load in chrome://tracing or ui.perfetto.dev as-is."""
+        load in chrome://tracing or ui.perfetto.dev as-is.
+
+        ``stitched`` (default) renders the cross-daemon view: events
+        whose span carried a daemon *track* ('osd.3', 'client') are
+        re-homed onto a synthetic pid per track — one process row per
+        daemon — with ``process_name`` metadata events naming the rows,
+        so one client op's spans across N daemons line up on one shared
+        timeline (all tracks stamp from this tracer's clock pair)."""
         with self._lock:
-            events = list(self._events)
+            events = [dict(ev) for ev in self._events]
+        if stitched:
+            track_pids: dict[str, int] = {}
+            meta: list[dict] = []
+            for ev in events:
+                track = ev.pop("track", None)
+                if track is None:
+                    continue
+                pid = track_pids.get(track)
+                if pid is None:
+                    # deterministic synthetic pids, far from real ones
+                    pid = track_pids[track] = 1_000_000 + len(track_pids)
+                    meta.append({"name": "process_name", "ph": "M",
+                                 "pid": pid, "tid": 0,
+                                 "args": {"name": track}})
+                ev["pid"] = pid
+            events = meta + events
+        else:
+            for ev in events:
+                ev.pop("track", None)
         return {"traceEvents": events, "displayTimeUnit": "ms"}
 
     def reset(self) -> dict:
@@ -207,6 +364,22 @@ def trace_span(name: str, cat: str = "", **args) -> Span:
 
 def trace_instant(name: str, cat: str = "", **args) -> None:
     default_tracer().instant(name, cat, **args)
+
+
+def new_trace(op_class: str = "client") -> TraceContext:
+    """A fresh root trace context on the process-default tracer."""
+    return default_tracer().new_trace(op_class)
+
+
+def current_trace() -> TraceContext | None:
+    """The calling thread's active TraceContext, if any."""
+    return default_tracer().current_ctx()
+
+
+def activate_trace(ctx: TraceContext | None,
+                   track: str | None = None) -> _Activation:
+    """Adopt an inbound context / daemon track on the default tracer."""
+    return default_tracer().activate(ctx, track)
 
 
 # -- JIT telemetry registry (fed by ceph_tpu.ops.traced_jit) ----------------

@@ -148,12 +148,13 @@ def _encode_group(group: list[BatchFuture], pad_to_bucket: bool,
         bufs = bufs + [np.zeros((padded - total) * sinfo.stripe_width,
                                 dtype=np.uint8)]
     if pipeline is not None:
-        fut = ecutil.encode_many_pipelined(sinfo, ec, bufs, pipeline)
+        fut = ecutil.encode_many_pipelined(sinfo, ec, bufs, pipeline,
+                                           owner="serving")
         if fut is not None:
             fut.add_done_callback(_land_results(group))
             return [(group, fut)]
-    with trace_span("serving.batch_encode", ops=len(group),
-                    stripes=total, padded_stripes=padded):
+    with trace_span("serving.batch_encode", owner="serving",
+                    ops=len(group), stripes=total, padded_stripes=padded):
         encoded = ecutil.encode_many(sinfo, ec, bufs)
     for op, chunks in zip(group, encoded):
         op._result = chunks
@@ -167,7 +168,7 @@ def _decode_group(group: list[BatchFuture], pad_to_bucket: bool,
     if pipeline is not None:
         pending = ecutil.decode_many_pipelined(
             sinfo, ec, [op.payload for op in group], pipeline,
-            pad_chunks=pad, chunk_size=sinfo.chunk_size)
+            pad_chunks=pad, chunk_size=sinfo.chunk_size, owner="serving")
         if pending is not None:
             out = []
             for idxs, fut in pending:
@@ -175,7 +176,8 @@ def _decode_group(group: list[BatchFuture], pad_to_bucket: bool,
                 fut.add_done_callback(_land_results(sub))
                 out.append((sub, fut))
             return out
-    with trace_span("serving.batch_decode", ops=len(group)):
+    with trace_span("serving.batch_decode", owner="serving",
+                    ops=len(group)):
         decoded = ecutil.decode_many(
             sinfo, ec, [op.payload for op in group],
             pad_chunks=pad, chunk_size=sinfo.chunk_size)

@@ -141,6 +141,30 @@ def _health_gauges(family, prefix: str) -> None:
                 f'check="{_sanitize(key)}"}} {rank}')
 
 
+def _device_time_gauges(family, prefix: str) -> None:
+    """``ceph_tpu_device_time_seconds{class=...}`` — cumulative device
+    occupancy by owner class from the attribution ledger
+    (common/device_attribution), plus the busy-time total as
+    ``class="_busy"`` so dashboards can plot shares without summing."""
+    try:
+        from ..common import device_attribution
+        snap = device_attribution.snapshot()
+    except Exception:                       # pragma: no cover
+        return
+    if not snap["classes"] and not snap["busy_s"]:
+        return
+    metric = f"{prefix}_device_time_seconds"
+    fam = family(metric, "counter",
+                 "device busy seconds attributed per owner class "
+                 "(common/device_attribution)")
+    for cls, rec in sorted(snap["classes"].items()):
+        fam.lines.append(
+            f'{metric}{{class="{_sanitize(cls)}"}} '
+            f'{round(rec["device_s"], 6)}')
+    fam.lines.append(
+        f'{metric}{{class="_busy"}} {round(snap["busy_s"], 6)}')
+
+
 def _stats_rate_gauges(family, prefix: str) -> None:
     """``ceph_tpu_stats_rate{owner=...,stat=...}`` — the PGMap-style
     digest (client IO B/s and op/s, recovery B/s, serving batch
@@ -205,6 +229,7 @@ def render(cct=None, prefix: str = "ceph_tpu") -> str:
     _recovery_reserver_gauges(family, prefix)
     _health_gauges(family, prefix)
     _stats_rate_gauges(family, prefix)
+    _device_time_gauges(family, prefix)
 
     span_metric = f"{prefix}_span_latency_seconds"
     hists = default_tracer().histograms()

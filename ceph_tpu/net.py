@@ -86,6 +86,10 @@ class RpcCall:
     rid: int
     method: str
     args: dict
+    # distributed-trace context (common/tracer.TraceContext): rides the
+    # post-auth frame so the server's spans stitch under the remote
+    # client's trace id — the cross-PROCESS half of trace propagation
+    trace: object = None
 
 
 @dataclass
@@ -445,7 +449,12 @@ class ClusterServer:
             fn = getattr(self, f"_rpc_{call.method}", None)
             if fn is None:
                 raise ValueError(f"unknown method {call.method!r}")
-            with self.lock:
+            from .common.tracer import default_tracer
+            tr = default_tracer()
+            with self.lock, \
+                    tr.activate(getattr(call, "trace", None),
+                                track="server"), \
+                    tr.span(f"rpc.{call.method}", cat="rpc"):
                 value = fn(ch, **call.args)
             return RpcResult(call.rid, True, value)
         except Exception as e:                 # noqa: BLE001 — RPC boundary
@@ -665,7 +674,12 @@ class TcpRados:
         with self._lock:
             self._rid += 1
             rid = self._rid
-        self.ch.send(RpcCall(rid, method, args))
+        # stamp the call with this thread's active trace (or a fresh
+        # client root): the server side activates it around dispatch
+        from .common.tracer import default_tracer
+        tr = default_tracer()
+        ctx = tr.current_ctx() or tr.new_trace("client")
+        self.ch.send(RpcCall(rid, method, args, trace=ctx))
         with self._cond:
             while not self._pending.get(rid):
                 if self._pending.get("dead"):

@@ -16,6 +16,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..common import device_attribution
 from ..common.tracer import trace_span
 from ..gf import matrix as gfm
 from ..gf import ref as gfref
@@ -111,8 +112,17 @@ class RSCodec:
             if self.device == "numpy":
                 return gfref.apply_matrix_fast(self.parity_mat, data)
             self._upload_parity()
+            # synchronous dispatch: the launch-return -> fetch interval is
+            # device occupancy, charged to the caller's owner class (the
+            # pipeline path accounts at its own completion boundary).  The
+            # mark is taken AFTER the launch returns: a first-call launch
+            # runs trace+XLA compile synchronously, and that host-side
+            # interval must not inflate device busy time.
             out = rs_kernels.gf_apply(self._parity_dev, data, self.variant)
-            return np.asarray(jax.device_get(out))
+            t0 = device_attribution.dispatch_mark()
+            host = np.asarray(jax.device_get(out))
+            device_attribution.record_batch(None, t0, host.nbytes)
+            return host
 
     def _upload_parity(self) -> None:
         if self._parity_dev is None:
@@ -205,9 +215,12 @@ class RSCodec:
             if self.device == "numpy":
                 rec = gfref.apply_matrix_fast(entry.D, stack)
             else:
-                rec = np.asarray(jax.device_get(
-                    rs_kernels.gf_apply(self._entry_device(entry), stack,
-                                        self.variant)))
+                # mark after the launch returns (compile time is host time)
+                out = rs_kernels.gf_apply(self._entry_device(entry), stack,
+                                          self.variant)
+                t0 = device_attribution.dispatch_mark()
+                rec = np.asarray(jax.device_get(out))
+                device_attribution.record_batch(None, t0, rec.nbytes)
         return {e: rec[i] for i, e in enumerate(erasures)}
 
     @staticmethod
@@ -246,9 +259,12 @@ class RSCodec:
             if self.device == "numpy":
                 rec = gfref.apply_matrix_fast(entry.D, folded)
             else:
-                rec = np.asarray(jax.device_get(
-                    rs_kernels.gf_apply(self._entry_device(entry), folded,
-                                        self.variant)))
+                # mark after the launch returns (compile time is host time)
+                out = rs_kernels.gf_apply(self._entry_device(entry), folded,
+                                          self.variant)
+                t0 = device_attribution.dispatch_mark()
+                rec = np.asarray(jax.device_get(out))
+                device_attribution.record_batch(None, t0, rec.nbytes)
         return np.swapaxes(rec.reshape(len(erasures), b, n), 0, 1)
 
     # -- device-resident decode (no host round-trip; pipeline path) --------

@@ -46,6 +46,7 @@ import jax
 import jax.numpy as jnp
 
 from ..common import default_context
+from ..common import device_attribution
 from ..common.perf_counters import PerfCountersBuilder
 from ..common.tracer import trace_span
 
@@ -68,16 +69,23 @@ class PipelineFuture:
     timed sync — ``block_until_ready`` waits on the device unboundedly.
     """
 
-    __slots__ = ("kind", "meta", "_pipeline", "_packed", "_dev", "_unpack",
-                 "_event", "_result", "_error", "_callbacks", "_cb_lock")
+    __slots__ = ("kind", "meta", "owner", "_pipeline", "_packed", "_dev",
+                 "_unpack", "_dispatched_at", "_event", "_result", "_error",
+                 "_callbacks", "_cb_lock")
 
-    def __init__(self, pipeline: "CodecPipeline", kind: str, meta: dict):
+    def __init__(self, pipeline: "CodecPipeline", kind: str, meta: dict,
+                 owner: str = "client"):
         self.kind = kind
         self.meta = meta
+        # the owner class this batch's device occupancy is charged to
+        # (common/device_attribution), resolved on the SUBMITTING thread
+        # where the trace context is active
+        self.owner = owner
         self._pipeline = weakref.ref(pipeline)
         self._packed = None
         self._dev = None
         self._unpack = None
+        self._dispatched_at = 0.0
         self._event = threading.Event()
         self._result = None
         self._error: BaseException | None = None
@@ -210,21 +218,26 @@ class CodecPipeline:
     # -- submission --------------------------------------------------------
 
     def submit(self, pack, dispatch, unpack, kind: str = "op",
-               **meta) -> PipelineFuture:
+               owner: str | None = None, **meta) -> PipelineFuture:
         """Run ``pack()`` (host) and ``dispatch(packed)`` (async device
         launch) NOW; defer ``unpack(packed, host_arrays)`` to the
         completion boundary.  Returns the future; errors in any stage
-        land on it."""
-        fut = PipelineFuture(self, kind, meta)
+        land on it.  ``owner`` tags the batch's device occupancy
+        (client/serving/recovery/scrub/rebalance); when omitted it
+        resolves from the active TraceContext's op class."""
+        fut = PipelineFuture(self, kind, meta,
+                             owner=device_attribution.resolve_owner(owner))
         self.perf.inc("submitted")
         try:
-            with trace_span("pipeline.pack", kind=kind), \
+            with trace_span("pipeline.pack", kind=kind, owner=fut.owner), \
                     self.perf.time("pack_time"):
                 packed = pack() if pack is not None else None
             fut._packed = packed
-            with trace_span("pipeline.dispatch", kind=kind), \
+            with trace_span("pipeline.dispatch", kind=kind,
+                            owner=fut.owner), \
                     self.perf.time("dispatch_time"):
                 fut._dev = dispatch(packed)
+            fut._dispatched_at = device_attribution.dispatch_mark()
             fut._unpack = unpack
         except BaseException as e:              # noqa: BLE001 — the future
             self.perf.inc("errors")             # carries the failure
@@ -259,16 +272,31 @@ class CodecPipeline:
             fut._event.wait()
             return fut
         result, error = None, None
+        recorded = False
         try:
-            with trace_span("pipeline.complete", kind=fut.kind), \
+            with trace_span("pipeline.complete", kind=fut.kind,
+                            owner=fut.owner), \
                     self.perf.time("complete_time"):
                 dev = jax.block_until_ready(fut._dev)
+                nbytes = getattr(dev, "nbytes", 0) or 0
+                # device occupancy ends at block_until_ready: the
+                # device_get transfer (slow over the axon tunnel) and the
+                # host-side unpack below are HOST time — charging them
+                # would inflate busy_s and the owner's share while the
+                # chip sits idle
+                device_attribution.record_batch(fut.owner,
+                                                fut._dispatched_at, nbytes)
+                recorded = True
                 host = jax.device_get(dev)
                 result = fut._unpack(fut._packed, host) \
                     if fut._unpack is not None else host
         except BaseException as e:              # noqa: BLE001 — device-side
             error = e                           # failures surface on the
             self.perf.inc("errors")             # future, not the completer
+            if not recorded:
+                # the chip was busy up to the failure either way
+                device_attribution.record_batch(fut.owner,
+                                                fut._dispatched_at, 0)
         self.perf.inc("completed")
         fut._packed = fut._dev = fut._unpack = None   # free buffers promptly
         fut._finish(result, error)

@@ -27,7 +27,24 @@ import time
 
 import jax
 
+from ..common import device_attribution as _attr
 from ..common import tracer as _tracer
+
+
+def _record_cost_analysis(label: str, compiled) -> None:
+    """Fold the executable's XLA cost model (FLOPs, bytes accessed) into
+    the device-attribution ledger — `device top` then shows each kernel's
+    modeled cost next to the measured per-class occupancy.  Best-effort:
+    not every backend/executable implements cost_analysis."""
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):        # older jax returns [dict]
+            ca = ca[0] if ca else {}
+        if ca:
+            _attr.record_executable(label, float(ca.get("flops", 0.0)),
+                                    float(ca.get("bytes accessed", 0.0)))
+    except Exception:                            # noqa: BLE001 — telemetry
+        pass
 
 
 def _shape_key(args) -> tuple:
@@ -73,6 +90,7 @@ def traced_jit(fn=None, *, name: str | None = None, **jit_kwargs):
                     lowered = jfn.lower(*args)
                 with tr.span("jit.compile", fn=label) as sp_c:
                     compiled = lowered.compile()
+                _record_cost_analysis(label, compiled)
                 with tr.span("jit.first_dispatch", fn=label) as sp_d:
                     out = compiled(*args)
                     jax.block_until_ready(out)

@@ -35,6 +35,8 @@ from .mclock import (
     BG_RECOVERY, BG_SCRUB, CLIENT_OP, MClockOpClassQueue,
 )
 from .osd_ops import MOSDOp, MOSDOpReply
+from ..common.device_attribution import canonical_owner
+from ..common.tracer import default_tracer
 
 # live daemons, for the prometheus mclock-depth gauge export
 _DAEMONS: "weakref.WeakSet[OSDDaemon]" = weakref.WeakSet()
@@ -177,10 +179,21 @@ class OSDDaemon:
                          for op in m.ops) / 65536.0
         now = self._now()
         self.queue_stats["enqueued"] += 1
+
+        def run(m=m, g=g, on_reply=on_reply, op_class=op_class):
+            # the queued op runs much later (drain), on whatever thread
+            # drives the bus: re-activate the context the CLIENT stamped
+            # on the MOSDOp so this daemon's spans stitch under it, with
+            # this OSD as their track
+            tr = default_tracer()
+            with tr.activate(getattr(m, "trace", None),
+                             track=f"osd.{self.whoami}"), \
+                    tr.span("osd.op", oid=m.oid,
+                            owner=canonical_owner(op_class)):
+                g.engine.do_op(m, on_reply)
         self._shard_for(pgid).enqueue(
             op_class,
-            _QueuedOp(pgid, lambda: g.engine.do_op(m, on_reply), cost,
-                      t_enqueue=now,
+            _QueuedOp(pgid, run, cost, t_enqueue=now,
                       throttled=1 if self.op_throttle is not None else 0),
             now, cost=cost)
         return None
@@ -193,8 +206,26 @@ class OSDDaemon:
         client ops, src/osd/OSD.cc:9700+)."""
         now = self._now()
         self.queue_stats["enqueued"] += 1
+        # background items run under their own root trace whose op class
+        # is the dmClock class: every span (and device dispatch) below
+        # them attributes to recovery/scrub instead of masquerading as
+        # client work — unless the caller already carries a context
+        # (e.g. the recovery scheduler's wave trace)
+        owner = canonical_owner(op_class)
+        # the ENQUEUING thread's context (e.g. the recovery scheduler's
+        # wave trace) rides along; drain-time ambient context must not —
+        # a client op draining the queue would misattribute the backlog
+        ctx = default_tracer().current_ctx()
+
+        def run(fn=fn, owner=owner, ctx=ctx):
+            tr = default_tracer()
+            with tr.activate(ctx if ctx is not None
+                             else tr.new_trace(owner),
+                             track=f"osd.{self.whoami}"), \
+                    tr.span(f"osd.{owner}", owner=owner):
+                fn()
         self._shard_for(pgid).enqueue(
-            op_class, _QueuedOp(pgid, fn, cost, t_enqueue=now), now,
+            op_class, _QueuedOp(pgid, run, cost, t_enqueue=now), now,
             cost=cost)
 
     def queue_depths(self) -> dict:

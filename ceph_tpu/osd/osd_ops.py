@@ -223,6 +223,10 @@ class MOSDOp:
     # client accesses — they would keep every object artificially hot in
     # the hit sets (the reference's agent IO bypasses hit_set tracking)
     internal: bool = False
+    # distributed-trace context (common/tracer.TraceContext): stamped at
+    # dispatch, activated by the daemon when the queued op actually runs,
+    # so the primary's spans stitch under the client's trace id
+    trace: object = None
 
 
 @dataclass

@@ -52,6 +52,7 @@ from dataclasses import dataclass, field
 from enum import Enum
 
 from .reserver import AsyncReserver
+from ..common.tracer import trace_span
 from ..osd.mclock import BG_RECOVERY
 from ..osd.pg_log import OP_DELETE
 
@@ -666,8 +667,10 @@ class RecoveryScheduler:
             float(self._conf("osd_recovery_sleep"))
         self.perf.inc("waves")
         self.perf.inc("wave_objects", len(items))
-        b.repair_wave(rop, items,
-                      on_done=lambda: self._wave_done(job, rop, gen))
+        with trace_span("recovery.wave", owner="recovery",
+                        pg=repr(job.pgid), objects=len(items)):
+            b.repair_wave(rop, items,
+                          on_done=lambda: self._wave_done(job, rop, gen))
 
     def _wave_done(self, job: PGRecoveryJob, rop, gen: int) -> None:
         if job.gen != gen or job.cancelled:

@@ -1,0 +1,314 @@
+#!/usr/bin/env python3
+"""Perf-regression gate: diff a fresh bench artifact against the record.
+
+The roadmap's open wound: BENCH_r04 errored, BENCH_r05 silently fell
+back to CPU and recorded 7.5 GiB/s as if it were a kernel regression.
+This gate makes both failure modes LOUD and machine-checkable:
+
+- **regression**: each comparable block (the core codec metric plus the
+  ``serving``/``recovery``/``pipeline`` blocks) is diffed against the
+  reference artifact with a per-metric threshold; a drop past the
+  threshold fails the gate;
+- **platform fallback**: an artifact whose device degraded below the
+  expected platform (expected TPU, measured CPU — the r05 failure mode)
+  is a hard FAIL no matter how healthy its numbers look; a CPU number is
+  not a slower TPU number, it is a different experiment;
+- **verdict**: one line on stdout (``PERF GATE: PASS ...`` /
+  ``PERF GATE: FAIL ...``) and exit 0/1, suitable for CI and for the
+  driver's BENCH_r capture.
+
+Inputs are bench.py's one-line JSON artifact, or a driver BENCH_r*.json
+wrapper (its ``parsed`` field), or BASELINE_RESULTS.json-style documents
+— :func:`extract_metrics` normalizes all three.  ``bench.py`` calls
+:func:`evaluate` in-process and stamps the verdict into every artifact
+it emits (the ``gate`` field), so every future BENCH_r*.json lands with
+its own gate verdict attached.
+
+Stdlib-only, standalone on purpose (tools/trace_report.py's discipline).
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+# metric id -> (artifact path, higher-is-better).  Paths resolve inside
+# the normalized bench line; missing paths simply don't participate.
+METRIC_PATHS = {
+    "core.mib_s": (("value",), True),
+    "serving.ops_s": (("serving", "batched", "ops_s"), True),
+    "serving.p99_ms": (("serving", "batched", "p99_ms"), False),
+    "recovery.mib_s": (("recovery", "batched", "mib_s"), True),
+    "pipeline.mib_s": (("pipeline", "async", "mib_s"), True),
+}
+
+# fraction of regression tolerated per metric before the gate fails;
+# latency metrics (higher-is-worse) use the same fraction as an allowed
+# increase.  Overridable per metric via --threshold NAME=0.15.
+DEFAULT_THRESHOLD = 0.10
+
+_BLOCK_DEVICE = {
+    "core.mib_s": ("device",),
+    "serving.ops_s": ("serving", "device"),
+    "serving.p99_ms": ("serving", "device"),
+    "recovery.mib_s": ("recovery", "device"),
+    "pipeline.mib_s": ("pipeline", "device"),
+}
+
+
+def _dig(doc: dict, path: tuple):
+    cur = doc
+    for key in path:
+        if not isinstance(cur, dict) or key not in cur:
+            return None
+        cur = cur[key]
+    return cur
+
+
+def normalize(doc: dict) -> dict:
+    """Accept a bare bench line, or a driver BENCH_r wrapper (use its
+    ``parsed``), and return the bench-line dict."""
+    if not isinstance(doc, dict):
+        raise ValueError("artifact is not a JSON object")
+    if "parsed" in doc and isinstance(doc["parsed"], dict):
+        return doc["parsed"]
+    return doc
+
+
+def extract_metrics(doc: dict) -> dict[str, dict]:
+    """{metric id: {value, device, higher_better}} for every comparable
+    number present in the artifact."""
+    line = normalize(doc)
+    # legacy-shape lines (pre-r04) carry no device markers at all:
+    # artifact_platform's inference fills in, so a TPU record still
+    # participates in per-metric comparison instead of being skipped as
+    # device-unknown
+    default_device = artifact_platform(doc)
+    out: dict[str, dict] = {}
+    for mid, (path, higher) in METRIC_PATHS.items():
+        v = _dig(line, path)
+        if not isinstance(v, (int, float)):
+            continue
+        device = _dig(line, _BLOCK_DEVICE[mid]) or default_device
+        out[mid] = {"value": float(v), "device": device,
+                    "higher_better": higher}
+    return out
+
+
+def artifact_platform(doc: dict) -> str | None:
+    """The platform the artifact's core number was measured on."""
+    line = normalize(doc)
+    dev = line.get("device")
+    if dev is None:
+        dev = _dig(line, ("device_info", "platform"))
+    if dev is None and "error" not in line and "value" in line:
+        # pre-r04 artifact shape: only TPU successes omitted both the
+        # device marker and the error field (BENCH_r03's record line)
+        dev = "tpu"
+    return dev
+
+
+def evaluate(new: dict, reference: dict | None,
+             thresholds: dict[str, float] | None = None,
+             expect_platform: str | None = None) -> dict:
+    """Gate one artifact.  Returns ``{ok, verdict, failures, compared}``;
+    ``verdict`` is the one-line summary.  ``reference=None`` checks only
+    the platform expectation (first run: nothing to diff against)."""
+    thresholds = thresholds or {}
+    failures: list[str] = []
+    compared: list[dict] = []
+
+    new_platform = artifact_platform(new)
+    if expect_platform and new_platform != expect_platform:
+        # the r05 failure mode: a degraded-platform artifact must be an
+        # ERROR, not a silently lower number
+        failures.append(
+            f"platform fallback: expected {expect_platform}, measured "
+            f"{new_platform or 'none'}")
+
+    new_metrics = extract_metrics(new)
+    ref_metrics = extract_metrics(reference) if reference else {}
+    for mid, ref in sorted(ref_metrics.items()):
+        cur = new_metrics.get(mid)
+        if cur is None:
+            failures.append(f"{mid}: present in reference, missing in "
+                            f"new artifact")
+            continue
+        if ref["device"] != cur["device"]:
+            if ref["device"] == "tpu" and cur["device"] in ("cpu", None):
+                failures.append(
+                    f"{mid}: platform fallback (reference on tpu, new on "
+                    f"{cur['device'] or 'none'})")
+            # cpu-vs-tpu numbers are different experiments: never diffed
+            continue
+        thr = thresholds.get(mid, thresholds.get("*", DEFAULT_THRESHOLD))
+        if ref["value"] <= 0:
+            continue
+        ratio = cur["value"] / ref["value"]
+        comp = {"metric": mid, "new": cur["value"], "ref": ref["value"],
+                "ratio": round(ratio, 3), "device": cur["device"],
+                "threshold": thr}
+        compared.append(comp)
+        if cur["higher_better"] and ratio < 1.0 - thr:
+            failures.append(
+                f"{mid}: {cur['value']:.1f} vs {ref['value']:.1f} "
+                f"({100 * (1 - ratio):.0f}% regression > {100 * thr:.0f}% "
+                f"threshold, {cur['device']})")
+        elif not cur["higher_better"] and ratio > 1.0 + thr:
+            failures.append(
+                f"{mid}: {cur['value']:.2f} vs {ref['value']:.2f} "
+                f"({100 * (ratio - 1):.0f}% increase > {100 * thr:.0f}% "
+                f"threshold, {cur['device']})")
+
+    ok = not failures
+    if ok:
+        detail = (f"{len(compared)} metrics within thresholds"
+                  if compared else "no comparable reference metrics")
+        verdict = (f"PERF GATE: PASS ({detail}; platform="
+                   f"{new_platform or 'none'})")
+    else:
+        verdict = f"PERF GATE: FAIL ({'; '.join(failures)})"
+    return {"ok": ok, "verdict": verdict, "failures": failures,
+            "compared": compared}
+
+
+def scan_history(repo_dir: str
+                 ) -> tuple[dict | None, str | None, str | None]:
+    """ONE pass over the BENCH_r*.json history (bench.py runs this per
+    emitted artifact): returns ``(reference_doc, reference_path,
+    expected_platform)``.
+
+    The reference is the newest HEALTHY round with a parsed bench line.
+    Errored/fallback artifacts (the parsed line carries an ``error``
+    field — r04/r05's shape) are skipped while any clean round exists:
+    the degraded artifact the gate exists to catch must never become
+    the baseline it measures against.  When every round errored, the
+    newest one still serves (cpu-only histories compare cpu-vs-cpu
+    legitimately).
+
+    The expected platform is 'tpu' when ANY round measured on tpu —
+    once the record is a device number, a cpu artifact is a fallback,
+    not a baseline."""
+    best: tuple[int, dict, str] | None = None
+    best_clean: tuple[int, dict, str] | None = None
+    expect: str | None = None
+    for path in glob.glob(os.path.join(repo_dir, "BENCH_r*.json")):
+        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        if not m:
+            continue
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        parsed = doc.get("parsed")
+        if not isinstance(parsed, dict):
+            continue
+        if expect is None and artifact_platform(doc) == "tpu":
+            expect = "tpu"
+        n = int(m.group(1))
+        if best is None or n > best[0]:
+            best = (n, doc, path)
+        if "error" not in parsed and \
+                (best_clean is None or n > best_clean[0]):
+            best_clean = (n, doc, path)
+    best = best_clean or best
+    if best is None:
+        return None, None, expect
+    return best[1], best[2], expect
+
+
+def find_reference(repo_dir: str) -> tuple[dict | None, str | None]:
+    """The newest healthy BENCH_r*.json (see :func:`scan_history`)."""
+    doc, path, _expect = scan_history(repo_dir)
+    return doc, path
+
+
+def expected_platform(repo_dir: str) -> str | None:
+    """'tpu' when any prior round measured on tpu (see
+    :func:`scan_history`)."""
+    return scan_history(repo_dir)[2]
+
+
+def gate_for_bench(line: dict, repo_dir: str) -> dict:
+    """The in-process entry bench.py uses: reference + expected platform
+    discovered from the repo's BENCH history, verdict attached to the
+    artifact.  Never raises (the artifact must always emit)."""
+    reference, ref_path, expect = scan_history(repo_dir)
+    res = evaluate(line, reference, expect_platform=expect)
+    res["reference"] = os.path.basename(ref_path) if ref_path else None
+    res["expected_platform"] = expect
+    return res
+
+
+def _parse_thresholds(entries: list[str]) -> dict[str, float]:
+    out: dict[str, float] = {}
+    for e in entries:
+        name, _, val = e.partition("=")
+        if not val:
+            out["*"] = float(name)
+        else:
+            out[name] = float(val)
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="diff a bench artifact against the recorded "
+                    "reference; exit nonzero on regression or platform "
+                    "fallback")
+    ap.add_argument("artifact", help="fresh bench JSON (bench.py line or "
+                                     "BENCH_r wrapper)")
+    ap.add_argument("--baseline",
+                    help="explicit reference artifact (default: newest "
+                         "BENCH_r*.json next to --repo-dir)")
+    ap.add_argument("--repo-dir", default=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))),
+        help="where BENCH_r*.json history lives")
+    ap.add_argument("--expect-platform",
+                    help="hard-fail unless the artifact measured on this "
+                         "platform (default: tpu when any prior round "
+                         "did)")
+    ap.add_argument("--threshold", action="append", default=[],
+                    metavar="[METRIC=]FRACTION",
+                    help="per-metric regression tolerance (bare number "
+                         "sets the default for all metrics)")
+    ap.add_argument("--check", action="store_true",
+                    help="CI mode: print ONLY the one-line verdict")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the full evaluation as JSON")
+    args = ap.parse_args(argv)
+
+    with open(args.artifact) as f:
+        new = json.load(f)
+    reference, ref_name, history_expect = scan_history(args.repo_dir)
+    if args.baseline:
+        with open(args.baseline) as f:
+            reference = json.load(f)
+        ref_name = args.baseline
+    expect = args.expect_platform
+    if expect is None:
+        expect = history_expect
+
+    res = evaluate(new, reference, _parse_thresholds(args.threshold),
+                   expect_platform=expect)
+    if args.json:
+        res["reference"] = ref_name
+        res["expected_platform"] = expect
+        print(json.dumps(res))
+    elif args.check:
+        print(res["verdict"])
+    else:
+        for c in res["compared"]:
+            print(f"  {c['metric']:<18} {c['new']:>12.2f} vs "
+                  f"{c['ref']:>12.2f}  x{c['ratio']:.3f}  "
+                  f"[{c['device']}]")
+        print(res["verdict"])
+    return 0 if res["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

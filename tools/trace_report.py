@@ -27,11 +27,15 @@ import sys
 from collections import defaultdict
 
 
-def load_events(path: str) -> list[dict]:
+def load_doc(path: str) -> list[dict]:
+    """Every event in the dump, metadata included (parsed once)."""
     with open(path) as f:
         doc = json.load(f)
-    events = doc["traceEvents"] if isinstance(doc, dict) else doc
-    return [e for e in events if e.get("ph") == "X"]
+    return doc["traceEvents"] if isinstance(doc, dict) else doc
+
+
+def load_events(path: str) -> list[dict]:
+    return [e for e in load_doc(path) if e.get("ph") == "X"]
 
 
 def percentile_us(durs_us: list[float], q: float) -> float:
@@ -123,6 +127,71 @@ def render_json(agg: dict[str, dict], limit: int = 0) -> str:
     return json.dumps({"spans": spans, "num_spans": len(spans)})
 
 
+def _track_names(all_events: list[dict]) -> dict:
+    """pid -> daemon track name from the stitched dump's process_name
+    metadata events (tracer.Tracer.dump(stitched=True))."""
+    return {e["pid"]: e["args"]["name"] for e in all_events
+            if e.get("ph") == "M" and e.get("name") == "process_name"}
+
+
+def trace_tree(events: list[dict], trace_id: int,
+               tracks: dict | None = None) -> list[str]:
+    """Render ONE distributed trace as an indented span tree — the
+    'where did this 1 MiB write spend its 4 ms' view.  Spans join on the
+    trace/span ids the tracer stamps into event args; each line carries
+    the daemon track, so a client op reads as client -> primary ->
+    remote shards with per-hop durations."""
+    tracks = tracks or {}
+    spans = [e for e in events
+             if e.get("args", {}).get("trace_id") == trace_id]
+    if not spans:
+        return [f"no spans for trace {trace_id}"]
+    by_parent: dict[int, list[dict]] = defaultdict(list)
+    ids = {e["args"]["span_id"] for e in spans}
+    for e in spans:
+        parent = e["args"].get("parent_span_id", 0)
+        by_parent[parent if parent in ids else 0].append(e)
+    for kids in by_parent.values():
+        kids.sort(key=lambda e: e["ts"])
+    lines = [f"trace {trace_id} ({len(spans)} spans, "
+             f"{len({e.get('pid') for e in spans})} tracks)"]
+
+    def walk(parent: int, depth: int) -> None:
+        for e in by_parent.get(parent, ()):
+            track = tracks.get(e.get("pid"), str(e.get("pid")))
+            owner = e["args"].get("owner") or e["args"].get("op_class", "")
+            extra = f" [{owner}]" if owner else ""
+            lines.append(
+                f"{'  ' * depth}{e['name']:<{max(1, 40 - 2 * depth)}} "
+                f"{e.get('dur', 0.0) / 1e3:>9.3f} ms  @{track}{extra}")
+            walk(e["args"]["span_id"], depth + 1)
+    walk(0, 1)
+    return lines
+
+
+def list_traces(events: list[dict]) -> list[str]:
+    """Traces present in the dump, largest root span first."""
+    roots: dict[int, dict] = {}
+    counts: dict[int, int] = defaultdict(int)
+    for e in events:
+        args = e.get("args", {})
+        tid = args.get("trace_id")
+        if tid is None:
+            continue
+        counts[tid] += 1
+        if args.get("parent_span_id", 0) == 0:
+            top = roots.get(tid)
+            if top is None or e.get("dur", 0) > top.get("dur", 0):
+                roots[tid] = e
+    rows = sorted(roots.items(),
+                  key=lambda kv: kv[1].get("dur", 0.0), reverse=True)
+    out = [f"{'trace':>8}  {'spans':>6}  {'root ms':>9}  root"]
+    for tid, root in rows:
+        out.append(f"{tid:>8}  {counts[tid]:>6}  "
+                   f"{root.get('dur', 0.0) / 1e3:>9.3f}  {root['name']}")
+    return out
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="sorted self-time table from a Chrome trace file")
@@ -132,8 +201,21 @@ def main(argv=None) -> int:
     ap.add_argument("--json", action="store_true",
                     help="emit the table as one JSON document instead of "
                          "text (same rows/order)")
+    ap.add_argument("--trace-id", type=int, default=None,
+                    help="render ONE distributed trace as a cross-daemon "
+                         "span tree instead of the table")
+    ap.add_argument("--traces", action="store_true",
+                    help="list the distributed traces in the dump")
     args = ap.parse_args(argv)
-    events = load_events(args.trace)
+    all_events = load_doc(args.trace)
+    events = [e for e in all_events if e.get("ph") == "X"]
+    if args.traces:
+        print("\n".join(list_traces(events)))
+        return 0
+    if args.trace_id is not None:
+        print("\n".join(trace_tree(events, args.trace_id,
+                                   _track_names(all_events))))
+        return 0
     if not events:
         # both modes keep the nonzero exit: a trace that captured
         # nothing is a failure signal CI must not green on
@@ -154,4 +236,7 @@ def main(argv=None) -> int:
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    try:
+        sys.exit(main())
+    except BrokenPipeError:         # | head closed the pipe: not an error
+        sys.exit(0)
