@@ -419,23 +419,32 @@ def _recovery_repair_pass(device: str, batched: bool, n_objects: int,
         # shape caches (both paths pay a cold compile on their decode
         # shapes), the second is the steady-state measurement — same
         # warm-vs-cold discipline as the chain timer above
-        dt = pushed = 0
+        dt = pushed = wire = 0
         for payload in (b"\x01", b"\x02"):
             g.bus.mark_down(victim)
             for oid in objs:              # the writes the victim misses
                 c.put(pid, oid, payload + objs[oid][1:])
             before = g.backend.perf.get("recovery_bytes")
+            wire_before = c.wire.class_bytes()["recovery"]
             t0 = time.perf_counter()
             g.bus.mark_up(victim)
             c.deliver_all()
             dt = time.perf_counter() - t0
             pushed = g.backend.perf.get("recovery_bytes") - before
+            wire = c.wire.class_bytes()["recovery"] - wire_before
             assert not g.backend.stale, "repair did not drain"
         report = c.scrub_pool(pid, repair=False)
         assert report == {}, f"repair left scrub findings: {report}"
         return {"mib_s": round(pushed / 2**20 / dt, 2),
                 "objects": n_objects, "pushed_bytes": pushed,
-                "elapsed_s": round(dt, 3)}
+                "elapsed_s": round(dt, 3),
+                # bytes-on-wire per byte repaired (ROADMAP item 3's
+                # success metric): recovery-class wire traffic of the
+                # measured cycle over the chunk bytes pushed — ~k for
+                # centralized repair, the number pipelined repair must
+                # beat
+                "wire_bytes": int(wire),
+                "wire_per_byte": round(wire / max(pushed, 1), 3)}
     finally:
         c.shutdown()
 
@@ -462,6 +471,10 @@ def recovery_section(platform: str | None) -> dict:
             "batched": batched,
             "speedup": round(batched["mib_s"] /
                              max(per_object["mib_s"], 1e-9), 2),
+            # the wire sub-block tools/perf_gate.py gates on: repair
+            # efficiency regresses when this number rises
+            "wire": {"per_byte_repaired": batched["wire_per_byte"],
+                     "per_object_arm": per_object["wire_per_byte"]},
         }
         if res["device"] == "cpu":
             res["note"] = ("no tpu: repair dispatch overhead measured "
@@ -474,6 +487,33 @@ def recovery_section(platform: str | None) -> dict:
     except Exception as e:                 # never fail the artifact
         print(f"# recovery bench failed: {e!r}", file=sys.stderr)
         return {"device": "none", "error": repr(e)[:200]}
+
+
+def _serving_wire_pass(device: str, n_ops: int = 64) -> dict:
+    """Bytes-on-wire per client op over a short cluster pass (put+get
+    through the PG fan-out).  compare_batched_unbatched drives the
+    ServingEngine directly — no bus — so the wire cost of a served op
+    is measured here, on the path that actually frames messages."""
+    from ceph_tpu.cluster import MiniCluster
+    from ceph_tpu.common import Context
+    c = MiniCluster(n_osds=6, chunk_size=1024, cct=Context())
+    try:
+        pid = c.create_ec_pool(
+            "sw", {"k": "4", "m": "2", "device": device,
+                   "technique": "reed_sol_van"}, pg_num=4)
+        rng = np.random.default_rng(1)
+        payload = rng.integers(0, 256, 4096, np.uint8).tobytes()
+        before = c.wire.class_bytes()
+        for i in range(n_ops // 2):
+            c.put(pid, f"w{i}", payload)
+        for i in range(n_ops // 2):
+            c.get(pid, f"w{i}", len(payload))
+        after = c.wire.class_bytes()
+        moved = sum(after[k] - before[k] for k in ("client", "serving"))
+        return {"per_op": round(moved / n_ops, 1), "ops": n_ops,
+                "bytes": int(moved), "op_bytes": len(payload)}
+    finally:
+        c.shutdown()
 
 
 def serving_section(platform: str | None) -> dict:
@@ -495,6 +535,7 @@ def serving_section(platform: str | None) -> dict:
                 ec, StripeInfo(4, 1024), n_ops=256, concurrency=64,
                 op_bytes=4096, warmup_ops=64, timeout=240.0)
         res["device"] = "tpu" if platform == "tpu" else "cpu"
+        res["wire"] = _serving_wire_pass(device)
         if res["device"] == "cpu":
             res["note"] = ("no tpu: dispatch overhead measured on the "
                            f"{'jax-cpu' if platform else 'numpy'} path")

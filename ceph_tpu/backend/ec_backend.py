@@ -200,7 +200,9 @@ class ECBackend(PGBackend):
         extent cache where pinned; the remainder is read remotely when the
         op moves to waiting_reads."""
         if op.plan is None:
-            op.plan = get_write_plan(self.sinfo, op.t, self._hinfo)
+            op.plan = get_write_plan(
+                self.sinfo, op.t, self._hinfo,
+                sub_chunk_count=self.ec_impl.get_sub_chunk_count())
 
     def _op_blocked(self, op: Op) -> bool:
         """An RMW read overlapping an earlier in-flight write must wait until
@@ -243,15 +245,27 @@ class ECBackend(PGBackend):
         avail = {i for i, s in enumerate(self.acting) if s in cur}
         avail -= getattr(op, "_rmw_failed", set())   # rotten sources
         minimum = self.ec_impl.minimum_to_decode(want, avail)
+        # degraded RMW of a sub-chunked code (clay): the reconstruction
+        # decode needs FULL chunks — a chunk slice is not a smaller
+        # codeword when the sub-chunk interleave spans the whole height
+        # (same rule as objects_read_and_reconstruct; the gap reads of
+        # the planner's forced full-object rewrite hit this degraded)
+        whole_chunks = (self.ec_impl.get_sub_chunk_count() > 1
+                        and set(minimum) != want)
         per_shard: dict[int, dict[str, list[tuple]]] = {}
         for oid, es in need.items():
             for off, length in es:
                 c_off = self.sinfo.aligned_logical_offset_to_chunk_offset(off)
                 c_len = self.sinfo.aligned_logical_offset_to_chunk_offset(length)
+                if whole_chunks:
+                    c_off, c_len = 0, None
                 for chunk in minimum:
                     shard = self.acting[chunk]
-                    per_shard.setdefault(shard, {}).setdefault(oid, []).append(
-                        (c_off, c_len))
+                    entry = (c_off, c_len)
+                    ext_list = per_shard.setdefault(shard, {}).setdefault(
+                        oid, [])
+                    if entry not in ext_list:
+                        ext_list.append(entry)
         op._rmw_chunks = {c: self.acting[c] for c in minimum}
         op._rmw_need = need
         op._rmw_buf: dict[str, dict[int, dict[int, bytes]]] = {}
@@ -571,6 +585,14 @@ class ECBackend(PGBackend):
             self.in_progress_reads.pop(tid, None)
             on_complete({}, {oid: -5 for oid in reads})
             return tid
+        # reconstructing a sub-chunked code (clay): the decode's
+        # interleave is a function of the WHOLE chunk height, so a
+        # (c_off, c_len) chunk SLICE is not a smaller codeword the way it
+        # is for per-byte-linear RS — decode full chunks and slice the
+        # logical result instead (the write-planner's full-object-rewrite
+        # rule, applied to the read side; found by the clay thrash soak)
+        whole_chunks = (self.ec_impl.get_sub_chunk_count() > 1
+                        and set(base_minimum) != want)
         per_shard: dict[int, dict[str, list[tuple]]] = {}
         for oid, extents in reads.items():
             lo = min(off for off, _ in extents)
@@ -578,6 +600,8 @@ class ECBackend(PGBackend):
             start, length = self.sinfo.offset_len_to_stripe_bounds(lo, hi - lo)
             c_off = self.sinfo.aligned_logical_offset_to_chunk_offset(start)
             c_len = self.sinfo.aligned_logical_offset_to_chunk_offset(length)
+            if whole_chunks:
+                c_off, c_len = 0, None
             rop.shard_extents[oid] = (c_off, c_len)
             minimum = base_minimum
             if fast_read and len(avail) > len(minimum):
@@ -588,8 +612,8 @@ class ECBackend(PGBackend):
             rop.tried_shards[oid] = set(minimum)
             for chunk, subchunks in minimum.items():
                 shard = self.acting[chunk]
-                runs = None if subchunks == [(0, self.ec_impl.get_sub_chunk_count())] \
-                    else subchunks
+                runs = None if whole_chunks or subchunks == \
+                    [(0, self.ec_impl.get_sub_chunk_count())] else subchunks
                 per_shard.setdefault(shard, {}).setdefault(oid, []).append(
                     (c_off, c_len, runs))
         rop.pending_shards = {shard: 1 for shard in per_shard}
@@ -625,7 +649,14 @@ class ECBackend(PGBackend):
         chunk = chunk_of_shard[reply.from_shard]
         for oid, bufs in reply.buffers_read.items():
             data = b"".join(b for _, b in bufs)
-            rop.results.setdefault(oid, {})[chunk] = data
+            store = rop.results.setdefault(oid, {})
+            # a whole-chunk upgrade (clay retry) re-reads chunks whose
+            # sliced replies may still be in flight: under reordered or
+            # duplicated delivery the short straggler can land AFTER the
+            # full-height reply — the longer buffer always wins (equal
+            # extents produce equal lengths, so this is inert otherwise)
+            if len(data) >= len(store.get(chunk, b"")):
+                store[chunk] = data
         for oid in reply.errors:
             rop.errors.setdefault(oid, set()).add(chunk)
             self._retry_remaining_shards(rop, oid)
@@ -650,7 +681,26 @@ class ECBackend(PGBackend):
         if len(have_or_pending) < k:
             return  # complete_read_op will surface the failure
         c_off, c_len = rop.shard_extents[oid]
-        for chunk in untried:
+        resend = set(untried)
+        if self.ec_impl.get_sub_chunk_count() > 1 and \
+                not (c_off, c_len) == (0, None):
+            # the widened read will DECODE (a failed source means
+            # reconstruction), and a sub-chunked code cannot decode
+            # chunk slices (see objects_read_and_reconstruct): upgrade
+            # this object to whole-chunk reads, dropping the sliced
+            # buffers already collected — every contributing chunk is
+            # re-fetched at full height (FIFO delivery makes the full
+            # reply land after any sliced one still in flight;
+            # _complete_read_op drops short stragglers regardless)
+            rop.shard_extents[oid] = (0, None)
+            c_off, c_len = 0, None
+            # ...including chunks whose SLICED replies already landed or
+            # are still in flight: every contributor needs a full-height
+            # re-read (the stragglers' short buffers are dropped at
+            # completion either way)
+            resend |= (set(rop.results.get(oid, {})) | pending) & avail
+            rop.results.get(oid, {}).clear()
+        for chunk in resend:
             shard = self.acting[chunk]
             rop.tried_shards[oid].add(chunk)
             rop.pending_shards[shard] = rop.pending_shards.get(shard, 0) + 1
@@ -708,6 +758,15 @@ class ECBackend(PGBackend):
             by_chunk = rop.results.get(oid, {})
             by_chunk = {c: v for c, v in by_chunk.items()
                         if c not in rop.errors.get(oid, set())}
+            if len(by_chunk) > 0 and \
+                    self.ec_impl.get_sub_chunk_count() > 1:
+                # a whole-chunk upgrade mid-read (clay retry) may leave
+                # sliced stragglers alongside full chunks: only equal
+                # full-height buffers may decode together — drop the
+                # short ones (better a clean EIO below than garbage)
+                full = max(len(v) for v in by_chunk.values())
+                by_chunk = {c: v for c, v in by_chunk.items()
+                            if len(v) == full}
             if len(by_chunk) < k:
                 errors[oid] = -5  # EIO
                 continue

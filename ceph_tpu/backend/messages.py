@@ -15,6 +15,7 @@ from dataclasses import dataclass, field
 
 from .extent import ExtentSet
 from .memstore import Transaction
+from ..common import wire_accounting
 from ..common.tracer import default_tracer
 
 
@@ -185,6 +186,41 @@ class PGActivateAck:
     PeeringState::Active collects before pg goes clean)."""
     from_shard: int
     epoch: int
+
+
+# -- wire accounting (common/wire_accounting.py) -----------------------------
+#
+# Every PG message type registers its payload sizer here, next to its
+# definition, so the non-framed in-process bus can charge honest byte
+# counts (the wire-mode bus and net.py use real frame lengths).  The
+# sizers weigh the fields that dominate on a real wire — chunk buffers,
+# transactions, log entries; fixed-size headers ride the shared
+# MSG_OVERHEAD.  tests/test_wire_guard.py fails the build if a message
+# class lands here without one: no unmetered message types.
+
+_blob = wire_accounting.blob_size
+
+wire_accounting.register_wire_sizes({
+    ECSubWrite: lambda m: _blob(m.t.ops) + _blob(m.log_entries),
+    ECSubWriteReply: lambda m: 16,
+    RollForward: lambda m: 8,
+    Rollback: lambda m: 8,
+    ECSubRead: lambda m: _blob(m.to_read) + _blob(m.attrs_to_read),
+    ECSubReadReply: lambda m: (_blob(m.buffers_read) + _blob(m.attrs_read)
+                               + _blob(m.omap_read)),
+    PushOp: lambda m: (len(m.data) + _blob(m.attrs) + _blob(m.omap)
+                       + len(m.omap_header)),
+    PushReply: lambda m: len(m.oid),
+    PGLogQuery: lambda m: 8,
+    PGLogInfo: lambda m: 16 + _blob(m.entries),
+    PGScan: lambda m: 8,
+    PGScanReply: lambda m: _blob(m.oids),
+    PGLogUpdate: lambda m: 24 + _blob(m.entries),
+    PGActivate: lambda m: 16,
+    PGActivateAck: lambda m: 16,
+    # the cluster-bus wrapper: header + the routed payload
+    "PGEnvelope": lambda m: 16 + wire_accounting.wire_size(m.msg),
+})
 
 
 @dataclass
@@ -385,6 +421,10 @@ class MessageBus:
         self.delivered = 0
         self.dropped = 0
         self.duplicated = 0
+        # optional WireAccounting (common/wire_accounting.py): when set,
+        # every send charges byte/op counters per message type and per
+        # owner op class — the in-process half of wire observability
+        self.wire_stats = None
         # failure/revival notification fan-out: the reference's analog is the
         # osdmap epoch bump reaching each OSD after heartbeats report it
         self.down_listeners: list = []
@@ -436,12 +476,29 @@ class MessageBus:
                 self._fault_rng.random() < f.drop_prob:
             self.dropped += 1
             return
+        acct = self.wire_stats
+        # attribute to the PAYLOAD's type and trace — the envelope is
+        # routing; the payload's own stamped ctx wins over the envelope's
+        # (the precedence OSDEndpoint.handle_message applies) — but SIZE
+        # the whole thing the wire carries, envelope included
+        inner = msg.msg if isinstance(msg, PGEnvelope) else msg
+        ctx = getattr(inner, "trace", None) or getattr(msg, "trace", None)
+        # wire-mode buses charge the REAL frame length below: skip the
+        # sizer walk entirely rather than estimate-then-discard
+        nbytes = wire_accounting.wire_size(msg) \
+            if acct is not None and not self.wire else None
         if self.wire:
             from .wire import message_encode
             sender = getattr(msg, "from_shard", None)
-            msg = _WireEnvelope(
-                sender, message_encode(msg, secret=self.wire_secret))
-        self.queues.setdefault(to_shard, deque()).append(msg)
+            frame = message_encode(msg, secret=self.wire_secret)
+            if acct is not None:
+                nbytes = len(frame)      # real framed bytes on this bus
+            msg = _WireEnvelope(sender, frame)
+        q = self.queues.setdefault(to_shard, deque())
+        if acct is not None:
+            acct.account_msg(inner, nbytes=nbytes, ctx=ctx)
+            acct.note_queue_depth(len(q) + 1)
+        q.append(msg)
 
     def _pick(self, q: deque):
         """Next message to deliver.  Under reorder injection: the earliest

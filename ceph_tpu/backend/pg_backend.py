@@ -741,12 +741,16 @@ class PGBackend:
         chunk = self.acting.index(shard)
         self._on_shard_down_reads(shard, chunk)
         # recovery reads: restart the op's READING phase from live shards
+        from ..common.tracer import root_or_ambient
         for tid, rop in list(self._recovery_read_tids.items()):
             if shard in rop._pending:
                 del self._recovery_read_tids[tid]
                 rop.state = RecoveryState.IDLE
                 try:
-                    self.continue_recovery_op(rop)
+                    # re-planned reads are still recovery traffic (wire
+                    # accounting / device ledger), same as recover_object
+                    with root_or_ambient("recovery"):
+                        self.continue_recovery_op(rop)
                 except IOError:
                     # too few survivors: park; re-driven by on_shard_up
                     self._stalled_recoveries.append(rop)
@@ -804,9 +808,14 @@ class PGBackend:
             # (reservation-gated), not bypass it on shard revival
             self.recovery_scheduler.requeue_stalled(self, stalled)
         else:
+            from ..common.tracer import root_or_ambient
             for rop in stalled:
                 try:
-                    self.continue_recovery_op(rop)
+                    # re-driven repair bytes stay recovery-class (the
+                    # ambient ctx here is usually a peering/up event's,
+                    # not a recovery root)
+                    with root_or_ambient("recovery"):
+                        self.continue_recovery_op(rop)
                 except IOError:
                     self._stalled_recoveries.append(rop)
         # a stale shard whose repair FAILED (a peer died mid-repair) gets a
@@ -1021,13 +1030,20 @@ class PGBackend:
         rop = RecoveryOp(oid=oid, missing_shards=set(missing_chunks),
                          on_complete=on_complete)
         self.recovery_ops[oid] = rop
-        try:
-            self.continue_recovery_op(rop)
-        except IOError:
-            # too few current shards right now: park; re-driven when a
-            # shard returns (the reference defers recovery the same way
-            # when sources are missing)
-            self._stalled_recoveries.append(rop)
+        # the recovery conversation (reads -> replies -> pushes) rides
+        # the root context stamped HERE: an ambient one (scrub repair,
+        # a scheduler wave) is adopted, otherwise a fresh recovery root
+        # — so every byte it moves attributes to the recovery op class
+        # in the wire accounting and device ledger
+        from ..common.tracer import root_or_ambient
+        with root_or_ambient("recovery"):
+            try:
+                self.continue_recovery_op(rop)
+            except IOError:
+                # too few current shards right now: park; re-driven when
+                # a shard returns (the reference defers recovery the same
+                # way when sources are missing)
+                self._stalled_recoveries.append(rop)
         return rop
 
     def continue_recovery_op(self, rop: RecoveryOp) -> None:
@@ -1176,8 +1192,13 @@ class PGBackend:
         rop = ShardRepairOp(shard=shard, chunk=chunk,
                             on_complete=on_complete, driver=driver)
         self.shard_repairs[shard] = rop
-        self.bus.send(shard, PGLogQuery(self.whoami,
-                                        since=self.pg_log.tail))
+        # root the repair conversation on a recovery-class trace (see
+        # recover_object): the log query, its reply, and every replay/
+        # backfill push it triggers stitch — and account — as recovery
+        from ..common.tracer import root_or_ambient
+        with root_or_ambient("recovery"):
+            self.bus.send(shard, PGLogQuery(self.whoami,
+                                            since=self.pg_log.tail))
         return rop
 
     # -- boot peering (crash recovery) -------------------------------------

@@ -91,7 +91,8 @@ class WritePlan:
     invalidates_cache: bool = False
 
 
-def get_write_plan(sinfo: StripeInfo, t: PGTransaction, get_hinfo) -> WritePlan:
+def get_write_plan(sinfo: StripeInfo, t: PGTransaction, get_hinfo,
+                   sub_chunk_count: int = 1) -> WritePlan:
     """Mirror of the reference planner (ECTransaction.h:40-183).
 
     ``get_hinfo(oid) -> HashInfo`` supplies the projected-size oracle.  For
@@ -99,6 +100,16 @@ def get_write_plan(sinfo: StripeInfo, t: PGTransaction, get_hinfo) -> WritePlan:
     stripe; every write extent reads its partial head/tail stripes when they
     overlap existing data; ``will_write`` is the stripe-aligned hull of the
     writes (a superset of ``to_read``).
+
+    ``sub_chunk_count > 1`` (clay) additionally forces any PARTIAL write
+    to a full-object read+rewrite: the sub-chunk interleave is a function
+    of the WHOLE chunk height, so a write that left old bytes in place
+    would stitch codewords of different geometries into one stored chunk
+    and every later decode — degraded read, fractional repair — would
+    reconstruct garbage (found by the clay thrash soak).  The reference
+    never hits this because it encodes strictly per stripe; this
+    codebase's whole-extent batched encode is bit-identical only for
+    per-byte-linear codes, so sub-chunked codes pay the rewrite instead.
     """
     plan = WritePlan(t=t)
     for oid, op in t.ops.items():
@@ -156,6 +167,24 @@ def get_write_plan(sinfo: StripeInfo, t: PGTransaction, get_hinfo) -> WritePlan:
             will_write.union_insert(projected_size,
                                     truncating_to - projected_size)
             projected_size = truncating_to
+
+        if sub_chunk_count > 1 and len(list(will_write)):
+            # one object = ONE codeword: extend a partial write to cover
+            # the whole object, reading back every stripe the op's own
+            # writes don't supply (the RMW machinery overlays reads and
+            # writes before the single full-height encode)
+            end = sinfo.logical_to_next_stripe_offset(projected_size)
+            spans = list(will_write)
+            if not (len(spans) == 1 and spans[0][0] == 0
+                    and spans[0][1] >= end):
+                old_end = min(sinfo.logical_to_next_stripe_offset(
+                    orig_size), end)
+                gaps = ExtentSet([(0, old_end)])
+                gaps.subtract(will_write)
+                to_read = plan.to_read.setdefault(oid, ExtentSet())
+                for g_off, g_len in gaps:
+                    to_read.union_insert(g_off, g_len)
+                will_write.union_insert(0, end)
 
         hinfo.set_projected_total_logical_size(sinfo, projected_size)
     return plan

@@ -83,6 +83,11 @@ class FrameParser:
     def __init__(self, secret: bytes | None = None):
         self.secret = secret
         self._buf = bytearray()
+        # opt-in (wire accounting): when True, each parsed frame's REAL
+        # on-wire length (preamble + crcs/mac + body) is appended here in
+        # frame order; the consumer drains the list after every feed()
+        self.track_sizes = False
+        self.frame_sizes: list[int] = []
 
     def feed(self, data: bytes) -> list[tuple[int, list[bytes]]]:
         self._buf += data
@@ -127,6 +132,8 @@ class FrameParser:
             if not hmac.compare_digest(want, mac):
                 raise WireError("frame MAC mismatch")
         del self._buf[:total]
+        if self.track_sizes:
+            self.frame_sizes.append(total)
         return tag, segs
 
 

@@ -180,6 +180,14 @@ class MiniCluster:
         # the reference's one-messenger-per-OSD topology
         self.bus = MessageBus()
         self.bus.pre_deliver_hooks.append(self._drain_live_daemons)
+        # wire accounting (common/wire_accounting.py): every bus send
+        # charges byte/op counters per message type and owner op class —
+        # the source of recovery.wire_bytes_per_byte_repaired and
+        # serving.wire_bytes_per_op in the stats digest
+        from .common.wire_accounting import WireAccounting
+        self.wire = WireAccounting(cct=self.cct,
+                                   name=f"c{self.cluster_id}")
+        self.bus.wire_stats = self.wire
         # one daemon shell per OSD: sharded mClock op queue + superblock,
         # and ONE ObjectStore hosting every PG shard on that OSD as
         # collections (OSD.cc:3971 load_pgs iterates one store)
@@ -210,9 +218,12 @@ class MiniCluster:
         self._init_telemetry()
 
     def _init_telemetry(self) -> None:
+        from .common.clusterlog import ClusterLog
         from .common.flight_recorder import FlightRecorder
         from .mgr.health import HealthCheckEngine
+        from .mgr.heat import HeatTracker
         from .mgr.stats import StatsAggregator
+        from .mgr.timeseries import TimeSeriesRing
         self.stats = StatsAggregator(cct=self.cct,
                                      name=f"c{self.cluster_id}")
         self.flight = FlightRecorder(
@@ -222,8 +233,34 @@ class MiniCluster:
             capacity=self.cct.conf.get("mgr_flight_capacity"))
         self.health_engine = HealthCheckEngine(
             name=f"c{self.cluster_id}", cct=self.cct,
-            on_transition=self._on_health_transition)
+            on_transition=self._on_health_transition,
+            on_clear=self._on_health_clear)
+        # the cluster log (clog analog): the dozen human-readable lines
+        # an incident reads first, persisted under <data_dir>/clusterlog
+        # so `ceph -w` can follow from another process
+        self.clusterlog = ClusterLog(
+            cct=self.cct,
+            path=(self.data_dir / "clusterlog")
+            if self.data_dir is not None else None)
+        # workload heat maps over the stats window, scoped to this
+        # cluster's PG collections by the c<id> tag
+        self.heat = HeatTracker(self.stats, self._heat_topology,
+                                name=f"c{self.cluster_id}",
+                                tag=f"c{self.cluster_id}")
+        # the embedded time-series ring: status() ticks it; flight
+        # bundles carry it; ts_report reads it post-hoc
+        self.ts = TimeSeriesRing(cct=self.cct)
+        self.ts.add_source("stats", self.stats.digest_flat)
+        self.ts.add_source("heat", self.heat.flat_series)
         self._register_health_checks()
+        # OSD up/down land in the cluster log the moment the bus flips
+        # (the mon's "osd.3 down" clog lines)
+        self.bus.down_listeners.append(
+            lambda osd: self.clusterlog.warn(f"osd.{osd} down",
+                                             channel="osd"))
+        self.bus.up_listeners.append(
+            lambda osd: self.clusterlog.info(f"osd.{osd} up",
+                                             channel="osd"))
         # transition-triggered dumps see the evaluation already cached;
         # MANUAL dumps (admin/CLI) on a process that never ran health()
         # fall back to a read-only evaluation (no hooks — evaluating
@@ -232,13 +269,54 @@ class MiniCluster:
             "health", lambda: self.health_engine.last_evaluation
             or self.health_engine.evaluate(fire_transitions=False))
         self.flight.add_source("stats", lambda: self.stats.digest())
+        self.flight.add_source("wire", self.wire.dump)
+        self.flight.add_source("heat", self.heat.dump)
+        self.flight.add_source("clusterlog", self.clusterlog.dump)
+        self.flight.add_source("timeseries", self.ts.dump)
         self.flight.register_admin()
+
+    def _heat_topology(self) -> dict:
+        """The heat tracker's placement view: pg -> primary + acting."""
+        return {str(g.pgid): {"primary": g.backend.whoami,
+                              "acting": list(g.acting)}
+                for p in self.pools.values()
+                for g in p["pgs"].values()}
 
     def _on_health_transition(self, key, info, evaluation) -> None:
         """A check newly raised or escalated: capture the run-up NOW
         (tracer ring + perf + health + stats), while the state that
-        tripped it is still live."""
+        tripped it is still live — and log the transition where a human
+        will read it."""
+        msg = f"health check {key} raised: {info['summary']}"
+        sev = "ERR" if info["severity"] == "HEALTH_ERR" else "WRN"
+        # a fresh process's engine re-fires STANDING checks as new
+        # transitions (its prior state is empty), and the clusterlog ring
+        # persists across reopens: only log when this key's latest
+        # persisted line differs (message OR severity — an escalation
+        # with an unchanged summary still logs), so `ceph -s` in a loop
+        # against an unhealthy cluster doesn't bury the history in
+        # duplicates.  Genuine raise/clear/raise cycles log every time:
+        # the "cleared" line (on_clear below) breaks the dedup chain.
+        prior = self._last_health_line(key)
+        if prior is None or prior["message"] != msg \
+                or prior.get("severity") != sev:
+            self.clusterlog.log(sev, msg, channel="health")
         self.flight.dump(reason=f"health-{key}-{info['severity']}")
+
+    def _last_health_line(self, key: str) -> dict | None:
+        return next((e for e in reversed(self.clusterlog.dump())
+                     if e.get("channel") == "health"
+                     and e["message"].startswith(f"health check {key} ")),
+                    None)
+
+    def _on_health_clear(self, key, evaluation) -> None:
+        """A raised check stopped reporting: one INF line — but only if
+        the raise itself was logged (muted checks never were), and only
+        once (the dedup mirror of _on_health_transition)."""
+        msg = f"health check {key} cleared"
+        prior = self._last_health_line(key)
+        if prior is not None and prior["message"] != msg:
+            self.clusterlog.info(msg, channel="health")
 
     def _register_health_checks(self) -> None:
         """The named check set (mon/health_check.h keys where the concept
@@ -326,6 +404,11 @@ class MiniCluster:
                      recompile_storm_check(self.cct, self.stats),
                      description="jit compilations within the stats "
                                  "window exceeded the storm threshold")
+        from .mgr.heat import hot_shard_check
+        eng.register("HOT_SHARD", hot_shard_check(self.heat, self.cct),
+                     description="one OSD's primary-op load is a "
+                                 "sustained multiple of the median "
+                                 "(hot-shard workload skew)")
 
     def enable_serving(self, start: bool = False, **kw):
         """Attach a :class:`~ceph_tpu.exec.ServingEngine` to every EC
@@ -357,6 +440,8 @@ class MiniCluster:
         if self.recovery is None:
             kw.setdefault("name", f"c{self.cluster_id}")
             self.recovery = RecoveryScheduler(cct=self.cct, **kw)
+            # recovery start/finish lines land in the cluster log
+            self.recovery.clog = self.clusterlog
             from .mgr.health import pg_recovery_stalled_check
             self.health_engine.register(
                 "PG_RECOVERY_STALLED",
@@ -458,6 +543,14 @@ class MiniCluster:
                 self._attach_recovery(pgs[ps], pool)
         self.pools[pool.pool_id] = {"pool": pool, "pgs": pgs, "ec": ec}
         self.pool_ids[name] = pool.pool_id
+        if not getattr(self, "_restoring", False):
+            # reopens restore pools through this same path: only a
+            # GENUINELY new pool is a cluster-log event (a "created"
+            # line per CLI invocation would bury the real history)
+            self.clusterlog.info(
+                f"pool '{name}' created (id {pool.pool_id}, "
+                f"{'ec' if ec is not None else 'replicated'}, "
+                f"{pool.pg_num} pgs)", channel="mon")
         self._save_meta()
         return pool.pool_id
 
@@ -557,17 +650,22 @@ class MiniCluster:
                 store_backend=meta.get("store_backend", "file"))
         for key in meta.get("health_mutes", ()):
             c.health_engine.mute(key)
-        for p in meta["pools"]:
-            if p["type"] == POOL_TYPE_REPLICATED:
-                pid = c.create_replicated_pool(p["name"], p["size"],
-                                               p["pg_num"],
-                                               params=p.get("params"))
-            else:
-                pid = c.create_ec_pool(p["name"], p["params"], p["pg_num"])
-            pool = c.pools[pid]["pool"]
-            pool.snap_seq = p.get("snap_seq", 0)
-            pool.snaps = dict(p.get("snaps", {}))
-            pool.removed_snaps = set(p.get("removed_snaps", ()))
+        c._restoring = True
+        try:
+            for p in meta["pools"]:
+                if p["type"] == POOL_TYPE_REPLICATED:
+                    pid = c.create_replicated_pool(p["name"], p["size"],
+                                                   p["pg_num"],
+                                                   params=p.get("params"))
+                else:
+                    pid = c.create_ec_pool(p["name"], p["params"],
+                                           p["pg_num"])
+                pool = c.pools[pid]["pool"]
+                pool.snap_seq = p.get("snap_seq", 0)
+                pool.snaps = dict(p.get("snaps", {}))
+                pool.removed_snaps = set(p.get("removed_snaps", ()))
+        finally:
+            c._restoring = False
         # re-persist: pool creation above rewrote the meta file BEFORE the
         # snap fields were restored; without this, the next process would
         # load a cluster whose pool snaps were silently wiped
@@ -664,9 +762,14 @@ class MiniCluster:
                 raise BlockedWriteError(
                     f"write of {oid} blocked: PG {g.pgid} inactive")
             return g
-        g.backend.submit_transaction(
-            PGTransaction().write(oid, 0, bytes(data) + b"\0" * pad),
-            on_commit=_committed)
+        # the fast-path put is still a CLIENT op: root a trace here (the
+        # MOSDOp dispatch edge does the same) so the sub-writes it fans
+        # out attribute their wire bytes to the client class
+        from .common.tracer import root_or_ambient
+        with root_or_ambient("client"):
+            g.backend.submit_transaction(
+                PGTransaction().write(oid, 0, bytes(data) + b"\0" * pad),
+                on_commit=_committed)
         self.objects.setdefault(pool_id, set()).add(oid)
         if deliver:
             g.bus.deliver_all()
@@ -704,14 +807,16 @@ class MiniCluster:
         encoded = ecutil.encode_many(sinfo, pool["ec"],
                                      [padded[oid] for oid in order])
         done: list[str] = []
-        for oid, enc in zip(order, encoded):
-            t = PGTransaction().write(oid, 0, padded[oid])
-            objop = t.ops[oid]
-            objop.precomputed_chunks = enc
-            objop.precomputed_for = padded[oid]
-            groups[oid].backend.submit_transaction(
-                t, on_commit=lambda tid, _oid=oid: done.append(_oid))
-            self.objects.setdefault(pool_id, set()).add(oid)
+        from .common.tracer import root_or_ambient
+        with root_or_ambient("client"):
+            for oid, enc in zip(order, encoded):
+                t = PGTransaction().write(oid, 0, padded[oid])
+                objop = t.ops[oid]
+                objop.precomputed_chunks = enc
+                objop.precomputed_for = padded[oid]
+                groups[oid].backend.submit_transaction(
+                    t, on_commit=lambda tid, _oid=oid: done.append(_oid))
+                self.objects.setdefault(pool_id, set()).add(oid)
         for g in {id(g): g for g in groups.values()}.values():
             g.bus.deliver_all()
         if wait and len(done) != len(order):
@@ -846,9 +951,14 @@ class MiniCluster:
     def get(self, pool_id: int, oid: str, length: int) -> bytes:
         g = self.pg_group(pool_id, oid)
         out = {}
-        g.backend.objects_read_and_reconstruct(
-            {oid: [(0, length)]},
-            lambda result, errors: out.update(result=result, errors=errors))
+        from .common.tracer import root_or_ambient
+        # client-class root (see put): degraded-read sub-reads account
+        # their wire bytes to the client that asked for them
+        with root_or_ambient("client"):
+            g.backend.objects_read_and_reconstruct(
+                {oid: [(0, length)]},
+                lambda result, errors: out.update(result=result,
+                                                  errors=errors))
         g.bus.deliver_all()
         if out.get("errors"):
             raise IOError(out["errors"])
@@ -986,6 +1096,12 @@ class MiniCluster:
             daemon.queue_background(g.pgid, scrub, op_class=BG_SCRUB)
             daemon.drain()
             g.bus.deliver_all()
+        if report:
+            self.clusterlog.warn(
+                f"deep scrub of pool {pool_id} found inconsistencies in "
+                f"{len(report)} pg(s): "
+                f"{sum(len(b) for b in report.values())} object(s)",
+                channel="scrub")
         return report
 
     # -- pool snapshots (the mon's 'osd pool mksnap/rmsnap') ----------------
@@ -1119,7 +1235,10 @@ class MiniCluster:
         # teardown must not evaluate checks over half-closed PGs
         self.stats.close()
         self.health_engine.close()
+        self.heat.close()
+        self.clusterlog.close()
         self.flight.close()
+        self.wire.close()
         for p in self.pools.values():
             for g in p["pgs"].values():
                 g.shutdown()
@@ -1158,6 +1277,9 @@ class MiniCluster:
         backfill)."""
         from .common.tracer import default_tracer
         tr = default_tracer()
+        self.clusterlog.info(
+            f"backfill of pg {pool_id}.{ps:x} -> {new_acting}",
+            channel="osd")
         with tr.activate(tr.new_trace("rebalance")), \
                 tr.span("backfill.pg", owner="rebalance",
                         pg=f"{pool_id}.{ps}"):
@@ -1328,6 +1450,9 @@ class MiniCluster:
                 n_pgs += 1
                 states[self.pg_state(g)] += 1
         self.stats.sample()
+        # status IS the mgr tick: the time-series ring records a point
+        # (interval-gated, so a tight status loop stays bounded)
+        self.ts.record()
         st = {
             "osdmap": {"epoch": self.osdmap.epoch,
                        "num_osds": self.osdmap.max_osd,

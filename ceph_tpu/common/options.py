@@ -222,6 +222,41 @@ OPTIONS: list[Option] = [
            description="flight-recorder bundles kept in the in-memory "
                        "ring (disk dumps are additionally bounded by "
                        "the operator's data dir)"),
+    # -- wire & workload observability (heat / clog / timeseries) ----------
+    Option("mgr_hot_shard_ratio", TYPE_FLOAT, LEVEL_ADVANCED, default=4.0,
+           min=1.0,
+           description="HOT_SHARD health check fires when one OSD's "
+                       "primary-op rate reaches this multiple of the "
+                       "median OSD load over the stats window",
+           see_also=["mgr_hot_shard_min_ops"]),
+    Option("mgr_hot_shard_min_ops", TYPE_FLOAT, LEVEL_ADVANCED,
+           default=16.0, min=0.0,
+           description="HOT_SHARD requires the hottest OSD to sustain at "
+                       "least this many primary op/s before skew alone "
+                       "can fire the check (idle clusters never page)",
+           see_also=["mgr_hot_shard_ratio"]),
+    Option("mgr_cluster_log_max", TYPE_UINT, LEVEL_ADVANCED, default=500,
+           min=1,
+           description="cluster log (clog) entries kept in the bounded "
+                       "ring; the on-disk clusterlog file compacts back "
+                       "to this bound"),
+    Option("mgr_ts_interval", TYPE_FLOAT, LEVEL_ADVANCED, default=1.0,
+           min=0.0,
+           description="minimum seconds between embedded time-series "
+                       "points (status ticks closer together are "
+                       "coalesced)",
+           see_also=["mgr_ts_capacity", "mgr_ts_coarse_every"]),
+    Option("mgr_ts_capacity", TYPE_UINT, LEVEL_ADVANCED, default=360,
+           min=2,
+           description="points per time-series ring (fine and coarse "
+                       "archives each hold this many; round-robin "
+                       "eviction past it)",
+           see_also=["mgr_ts_interval"]),
+    Option("mgr_ts_coarse_every", TYPE_UINT, LEVEL_ADVANCED, default=12,
+           min=1,
+           description="fine time-series points folded (mean+max) into "
+                       "one coarse archive point",
+           see_also=["mgr_ts_capacity"]),
     Option("log_file", TYPE_STR, LEVEL_BASIC, default="",
            description="path to log file"),
     Option("log_max_recent", TYPE_UINT, LEVEL_ADVANCED, default=500,

@@ -382,6 +382,16 @@ def activate_trace(ctx: TraceContext | None,
     return default_tracer().activate(ctx, track)
 
 
+def root_or_ambient(op_class: str) -> _Activation:
+    """Activate the calling thread's ambient trace context — or root a
+    fresh ``op_class`` trace when none is active — so the sub-ops a call
+    fans out attribute their wire bytes and device time to the right
+    owner class (an enclosing scrub-repair/scheduler-wave context wins
+    over the default)."""
+    tr = default_tracer()
+    return tr.activate(tr.current_ctx() or tr.new_trace(op_class))
+
+
 # -- JIT telemetry registry (fed by ceph_tpu.ops.traced_jit) ----------------
 #
 # Keyed by (function label, shape key).  Each entry exists because exactly

@@ -59,7 +59,8 @@ class HealthCheckEngine:
     """Registry of named health checks; ``Cluster.health()`` is a thin
     view over ``evaluate()``."""
 
-    def __init__(self, name: str = "health", cct=None, on_transition=None):
+    def __init__(self, name: str = "health", cct=None, on_transition=None,
+                 on_clear=None):
         self.name = name
         self.cct = cct
         # key -> (fn, default severity, description of the trigger)
@@ -69,6 +70,9 @@ class HealthCheckEngine:
         self._raised: dict[str, int] = {}
         self._lock = threading.Lock()
         self.on_transition = on_transition
+        # fired (key, evaluation) when a previously-raised check stops
+        # reporting — the cluster-log "cleared" line's source
+        self.on_clear = on_clear
         # the most recent evaluation: flight-recorder sources read THIS
         # instead of re-evaluating (which would recurse through the
         # transition hook mid-dump)
@@ -176,13 +180,18 @@ class HealthCheckEngine:
                 if rank > self._raised.get(key, 0) and not info["muted"]:
                     transitions.append((key, info))
                 self._raised[key] = rank
+            cleared: list[str] = []
             for key in list(self._raised):
                 if key not in results:
                     del self._raised[key]        # cleared: re-raise fires
+                    cleared.append(key)
             self.last_evaluation = evaluation
         if self.on_transition is not None:
             for key, info in transitions:
                 self.on_transition(key, info, evaluation)
+        if self.on_clear is not None:
+            for key in cleared:
+                self.on_clear(key, evaluation)
         return evaluation
 
     def severity_gauges(self) -> dict[str, int]:

@@ -165,6 +165,73 @@ def _device_time_gauges(family, prefix: str) -> None:
         f'{metric}{{class="_busy"}} {round(snap["busy_s"], 6)}')
 
 
+def _wire_gauges(family, prefix: str) -> None:
+    """``ceph_tpu_wire_bytes`` / ``ceph_tpu_wire_msgs``
+    ``{owner,msg_type,dir}`` — per-message-type wire traffic of every
+    live WireAccounting (bus + TCP messenger).  The totals and per-class
+    rollups already export through the ordinary ``wire.<name>``
+    collection walk; this family adds the per-TYPE breakdown the perf
+    schema cannot hold (open-ended type set)."""
+    try:
+        from ..common.wire_accounting import live_wire_accountants
+    except Exception:                       # pragma: no cover
+        return
+    fams = {}
+    for acct in sorted(live_wire_accountants(), key=lambda a: a.name):
+        for mtype, rec in acct.per_type().items():
+            for direction in ("tx", "rx"):
+                for unit, help_text in (
+                        ("bytes", "wire bytes per message type"),
+                        ("msgs", "wire messages per message type")):
+                    v = rec[f"{direction}_{unit}"]
+                    if not v:
+                        continue
+                    metric = f"{prefix}_wire_{unit}"
+                    fam = fams.get(metric)
+                    if fam is None:
+                        fam = fams[metric] = family(metric, "counter",
+                                                    help_text)
+                    fam.lines.append(
+                        f'{metric}{{owner="{_sanitize(acct.name)}",'
+                        f'msg_type="{_sanitize(mtype)}",'
+                        f'dir="{direction}"}} {v}')
+
+
+def _heat_gauges(family, prefix: str) -> None:
+    """``ceph_tpu_osd_heat{owner,osd,stat}`` /
+    ``ceph_tpu_pg_heat{owner,pg,stat}`` — the workload heat maps of
+    every live HeatTracker (mgr/heat.py): primary-op and byte rates over
+    the stats window, rolled per PG and per primary OSD.  The
+    before/after instrument for the balancer loop (ROADMAP item 5)."""
+    try:
+        from .heat import live_heat_trackers
+    except Exception:                       # pragma: no cover
+        return
+    fams = {}
+    for tracker in sorted(live_heat_trackers(), key=lambda t: t.name):
+        owner = _sanitize(tracker.name)
+        snap = tracker.snapshot()
+        for metric_key, label, rows, help_text in (
+                ("osd_heat", "osd", snap["osds"],
+                 "per-OSD primary-op load over the stats window"),
+                ("pg_heat", "pg", snap["pgs"],
+                 "per-PG primary-op load over the stats window")):
+            metric = f"{prefix}_{metric_key}"
+            for key, rec in sorted(rows.items(), key=lambda kv:
+                                   str(kv[0])):
+                for stat in ("op_s", "bytes_s"):
+                    fam = fams.get(metric)
+                    if fam is None:
+                        fam = fams[metric] = family(metric, "gauge",
+                                                    help_text)
+                    # pg ids ("1.0") and osd ids are clean label VALUES
+                    # as-is; only metric names need sanitizing
+                    fam.lines.append(
+                        f'{metric}{{owner="{owner}",'
+                        f'{label}="{key}",'
+                        f'stat="{stat}"}} {rec[stat]}')
+
+
 def _stats_rate_gauges(family, prefix: str) -> None:
     """``ceph_tpu_stats_rate{owner=...,stat=...}`` — the PGMap-style
     digest (client IO B/s and op/s, recovery B/s, serving batch
@@ -230,6 +297,8 @@ def render(cct=None, prefix: str = "ceph_tpu") -> str:
     _health_gauges(family, prefix)
     _stats_rate_gauges(family, prefix)
     _device_time_gauges(family, prefix)
+    _wire_gauges(family, prefix)
+    _heat_gauges(family, prefix)
 
     span_metric = f"{prefix}_span_latency_seconds"
     hists = default_tracer().histograms()

@@ -36,6 +36,10 @@ _AGGREGATORS: "weakref.WeakSet[StatsAggregator]" = weakref.WeakSet()
 # backends; one collection per PG instance)
 PG_PREFIXES = ("ec_backend.", "replicated_backend.", "pg_backend.")
 
+# collection prefix of the wire accountants (common/wire_accounting.py):
+# bus + TCP messenger byte/op counters, per-op-class rollups
+WIRE_PREFIXES = ("wire.",)
+
 
 def live_aggregators() -> list["StatsAggregator"]:
     return list(_AGGREGATORS)
@@ -151,6 +155,27 @@ class StatsAggregator:
         dt = self.span()
         return self.counter_delta(key, coll_prefix) / dt if dt > 0 else 0.0
 
+    def per_collection_delta(self, key: str,
+                             coll_prefix: tuple[str, ...] | None = None
+                             ) -> dict[str, float]:
+        """Window increase of counter ``key`` PER collection (the heat
+        tracker's input: one PG backend collection per PG, so per-
+        collection deltas ARE per-PG deltas).  Same born-mid-window and
+        reset-clamp semantics as :meth:`counter_delta`."""
+        ends = self._ends()
+        if ends is None:
+            return {}
+        (_, first), (_, last) = ends
+        out: dict[str, float] = {}
+        for (coll, k), v in last.items():
+            if k != key:
+                continue
+            if coll_prefix is not None and \
+                    not any(coll.startswith(p) for p in coll_prefix):
+                continue
+            out[coll] = max(0.0, v - first.get((coll, k), 0.0))
+        return out
+
     def gauge_sum(self, key: str,
                   coll_prefix: tuple[str, ...] | None = None) -> float:
         """Summed CURRENT value across matching collections (for gauges
@@ -166,9 +191,34 @@ class StatsAggregator:
 
     # -- the PGMap-style digest --------------------------------------------
 
+    def _wire_class_delta(self, cls: str) -> float:
+        return self.counter_delta(f"class_bytes:{cls}", WIRE_PREFIXES)
+
+    def wire_bytes_per_byte_repaired(self) -> float:
+        """ROADMAP item 3's success metric: wire bytes attributed to
+        recovery-class ops over the window, per byte of repaired data
+        pushed — ~k for centralized repair (k-1 survivor chunk reads +
+        one reconstructed chunk push per chunk repaired), ~1 for a
+        pipelined repair chain.  0.0 while nothing repaired."""
+        repaired = self.counter_delta("recovery_bytes", PG_PREFIXES)
+        if repaired <= 0:
+            return 0.0
+        return self._wire_class_delta("recovery") / repaired
+
+    def wire_bytes_per_op(self) -> float:
+        """ROADMAP item 4's companion metric: wire bytes of client- and
+        serving-class traffic per completed client op over the window."""
+        ops = (self.counter_delta("writes", PG_PREFIXES)
+               + self.counter_delta("reads", PG_PREFIXES))
+        if ops <= 0:
+            return 0.0
+        return (self._wire_class_delta("client")
+                + self._wire_class_delta("serving")) / ops
+
     def digest(self) -> dict:
         """The rate digest ``Cluster.status()`` / `ceph_tpu top` render:
-        client IO, recovery, serving-batch throughput, jit churn."""
+        client IO, recovery, serving-batch throughput, wire traffic,
+        jit churn."""
         return {
             "window_s": round(self.span(), 3),
             "samples": len(self._samples),
@@ -189,11 +239,26 @@ class StatsAggregator:
                                              ("recovery.",)),
                 "active_pgs": self.gauge_sum("jobs_active",
                                              ("recovery.",)),
+                # bytes-on-wire per byte repaired (ROADMAP item 3's
+                # success metric — ~k centralized, ~1 pipelined)
+                "wire_bytes_per_byte_repaired":
+                    self.wire_bytes_per_byte_repaired(),
             },
             "serving": {
                 "batch_s": self.rate("batches"),
                 "op_s": self.rate("ops_completed"),
                 "bytes_s": self.rate("bytes_in"),
+                # client+serving wire bytes per completed client op
+                "wire_bytes_per_op": self.wire_bytes_per_op(),
+            },
+            "wire": {
+                "tx_bytes_s": self.rate("tx_bytes", WIRE_PREFIXES),
+                "tx_msgs_s": self.rate("tx_msgs", WIRE_PREFIXES),
+                "class_bytes_s": {
+                    cls: (self._wire_class_delta(cls) / self.span()
+                          if self.span() > 0 else 0.0)
+                    for cls in ("client", "serving", "recovery",
+                                "scrub", "rebalance", "other")},
             },
             "jit": {
                 "compiles": self.counter_delta("compilations", ("jit",)),
@@ -214,9 +279,14 @@ class StatsAggregator:
             "recovery_op_s": d["recovery"]["op_s"],
             "recovery_queued_pgs": d["recovery"]["queued_pgs"],
             "recovery_active_pgs": d["recovery"]["active_pgs"],
+            "recovery_wire_per_byte":
+                d["recovery"]["wire_bytes_per_byte_repaired"],
             "serving_batch_s": d["serving"]["batch_s"],
             "serving_op_s": d["serving"]["op_s"],
             "serving_bytes_s": d["serving"]["bytes_s"],
+            "serving_wire_per_op": d["serving"]["wire_bytes_per_op"],
+            "wire_tx_bytes_s": d["wire"]["tx_bytes_s"],
+            "wire_tx_msgs_s": d["wire"]["tx_msgs_s"],
             "jit_compiles": d["jit"]["compiles"],
             "jit_cache_hits": d["jit"]["cache_hits"],
         }

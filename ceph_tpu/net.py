@@ -41,6 +41,7 @@ from .auth.cephx import (AuthError, Authorizer, CephxClient,
                          CephxServiceHandler, KeyServer)
 from .backend.wire import (BANNER, FrameParser, TAG_HELLO, TAG_MESSAGE,
                            WireError, frame_encode)
+from .common import wire_accounting
 
 SERVICE = "osd"
 KEYRING = "client.admin.keyring"
@@ -99,6 +100,10 @@ class RpcResult:
     value: object = None
     error: str = ""
     errno: int = 0
+    # echo of the call's trace ctx: the reply frame's wire bytes charge
+    # to the op class that asked (the send happens on the reader thread,
+    # outside the dispatch activation)
+    trace: object = None
 
 
 @dataclass
@@ -118,6 +123,23 @@ class NotifyAck:
 _TYPES = {c.__name__: c for c in (
     CephxBegin, CephxChallenge, CephxAuthenticate, CephxSession,
     CephxAuthorize, CephxDone, RpcCall, RpcResult, NotifyPush, NotifyAck)}
+
+# wire accounting sizers (common/wire_accounting.py): the sockets have
+# REAL frame lengths, so these estimates only serve the no-unmetered-
+# types guard and non-framed callers; weigh the payload-bearing fields
+_blob = wire_accounting.blob_size
+wire_accounting.register_wire_sizes({
+    CephxBegin: lambda m: len(m.name),
+    CephxChallenge: lambda m: len(m.challenge),
+    CephxAuthenticate: lambda m: len(m.client_challenge) + len(m.proof),
+    CephxSession: lambda m: len(m.env) + len(m.ticket_env),
+    CephxAuthorize: lambda m: _blob(m.authorizer.blob) + 48,
+    CephxDone: lambda m: len(m.reply),
+    RpcCall: lambda m: len(m.method) + _blob(m.args),
+    RpcResult: lambda m: _blob(m.value) + len(m.error),
+    NotifyPush: lambda m: len(m.payload) + 16,
+    NotifyAck: lambda m: _blob(m.value) + 16,
+})
 
 # ---- pre-auth codec: NO pickle before the peer is authenticated ----------
 #
@@ -233,20 +255,41 @@ class Channel:
     def __init__(self, sock: socket.socket):
         self.sock = sock
         self.parser = FrameParser(None)
+        self.parser.track_sizes = True
         self.secret: bytes | None = None
         self._wlock = threading.Lock()
         self._banner_seen = False
         self._banner_buf = bytearray()
+        # per-connection byte/op counters (the reference's per-Connection
+        # messenger stats) + optional shared WireAccounting the server
+        # attaches so every connection rolls up into wire.net.<port>
+        self.stats = {"tx_msgs": 0, "tx_bytes": 0,
+                      "rx_msgs": 0, "rx_bytes": 0}
+        self.acct = None
         with self._wlock:
             self.sock.sendall(BANNER)
 
     def secure(self, key: bytes) -> None:
         self.secret = key
         self.parser = FrameParser(key)
+        self.parser.track_sizes = True
 
     def send(self, msg) -> None:
         data = _encode(msg, self.secret)
         with self._wlock:
+            # stats ride the same lock that serializes concurrent
+            # senders (dispatch reply vs notify push): counting outside
+            # it loses increments and drifts from the peer's rx side
+            self.stats["tx_msgs"] += 1
+            self.stats["tx_bytes"] += len(data)
+            if self.acct is not None:
+                # real framed bytes; the op class comes from the riding
+                # trace ctx (RpcCall) or the sender's active context
+                from .common.tracer import default_tracer
+                self.acct.account_msg(
+                    msg, nbytes=len(data),
+                    ctx=getattr(msg, "trace", None)
+                    or default_tracer().current_ctx())
             self.sock.sendall(data)
 
     def recv_msgs(self) -> list:
@@ -267,8 +310,26 @@ class Channel:
                 self._banner_buf.clear()
             frames = self.parser.feed(data)
             if frames:
-                return [_decode(t, s, authed=self.secret is not None)
-                        for t, s in frames]
+                # the parser reports each frame's REAL on-wire length
+                # (preamble + crc/mac + body), so rx_bytes matches the
+                # peer's tx_bytes for the same conversation; the segment
+                # sum is only the fallback for a parser swapped mid-read
+                sizes = self.parser.frame_sizes
+                self.parser.frame_sizes = []
+                out = []
+                for i, (t, s) in enumerate(frames):
+                    msg = _decode(t, s, authed=self.secret is not None)
+                    nbytes = sizes[i] if i < len(sizes) else \
+                        sum(len(seg) for seg in s) + \
+                        wire_accounting.MSG_OVERHEAD
+                    self.stats["rx_msgs"] += 1
+                    self.stats["rx_bytes"] += nbytes
+                    if self.acct is not None:
+                        self.acct.account_rx(
+                            type(msg).__name__, nbytes,
+                            ctx=getattr(msg, "trace", None))
+                    out.append(msg)
+                return out
 
     def recv_one(self):
         msgs = self.recv_msgs()
@@ -297,6 +358,11 @@ class ClusterServer:
         self.handler = CephxServiceHandler(SERVICE, self.keyserver)
         self._listener = socket.create_server((host, port))
         self.port = self._listener.getsockname()[1]
+        # server-wide wire accounting: every connection's frames roll up
+        # into ONE wire.net.<port> perf collection (per-message-type
+        # bytes, per-op-class bytes, RPC latency histogram)
+        self.wire = wire_accounting.WireAccounting(
+            cct=getattr(cluster, "cct", None), name=f"net.{self.port}")
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         # the KeyServer's per-entity challenge/session slots are single
@@ -371,11 +437,13 @@ class ClusterServer:
             self._listener.close()
         except OSError:
             pass
+        self.wire.close()
 
     # -- per-connection ------------------------------------------------------
 
     def _serve_conn(self, sock: socket.socket) -> None:
         ch = Channel(sock)
+        ch.acct = self.wire
         try:
             # the auth lock is held across handshake round-trips: bound
             # them so a stalled client cannot freeze everyone's connects
@@ -445,6 +513,7 @@ class ClusterServer:
     # -- RPC dispatch --------------------------------------------------------
 
     def _dispatch(self, ch: Channel, call: RpcCall) -> RpcResult:
+        t0 = time.perf_counter()
         try:
             fn = getattr(self, f"_rpc_{call.method}", None)
             if fn is None:
@@ -456,11 +525,18 @@ class ClusterServer:
                                 track="server"), \
                     tr.span(f"rpc.{call.method}", cat="rpc"):
                 value = fn(ch, **call.args)
-            return RpcResult(call.rid, True, value)
+            return RpcResult(call.rid, True, value,
+                             trace=getattr(call, "trace", None))
         except Exception as e:                 # noqa: BLE001 — RPC boundary
             return RpcResult(call.rid, False, None,
                              f"{type(e).__name__}: {e}",
-                             getattr(e, "errno", 0) or 0)
+                             getattr(e, "errno", 0) or 0,
+                             trace=getattr(call, "trace", None))
+        finally:
+            # RPC latency lands in the wire histogram whether the call
+            # succeeded or not — a failing method is still served time
+            self.wire.observe_rpc(call.method,
+                                  time.perf_counter() - t0)
 
     def _rpc_mkpool(self, ch, name, profile=None, pg_num=8,
                     replicated=False, size=3):

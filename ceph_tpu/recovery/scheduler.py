@@ -213,6 +213,9 @@ class RecoveryScheduler:
                 for r in table.values():
                     r.set_max(int(value))
         self.cct.conf.add_observer("osd_max_backfills", _on_max_backfills)
+        # optional cluster log (common/clusterlog.py): job start/finish
+        # lines land where an operator reads them (`ceph -w`)
+        self.clog = None
         _SCHEDULERS.add(self)
 
     def close(self) -> None:
@@ -353,6 +356,11 @@ class RecoveryScheduler:
         job.stalled = list(stalled or [])
         self.jobs[key] = job
         self.perf.inc("jobs_scheduled")
+        if self.clog is not None:
+            self.clog.info(
+                f"recovery queued for pg {job.pgid} "
+                f"(targets {sorted(job.targets)}, prio {job.priority})",
+                channel="recovery")
         self._update_gauges()
         self._request_local(job)
         return job
@@ -531,6 +539,9 @@ class RecoveryScheduler:
         self.jobs.pop(job.key, None)
         self.local_reserver(job.backend.whoami).cancel_reservation(job.key)
         self.perf.inc("jobs_completed")
+        if self.clog is not None:
+            self.clog.info(f"recovery of pg {job.pgid} complete",
+                           channel="recovery")
         self._update_gauges()
 
     def _preempted(self, job: PGRecoveryJob, gen: int) -> None:

@@ -79,7 +79,7 @@ def main(argv=None) -> int:
     honour_jax_platforms_env()   # axon sitecustomize override
     # '-s' is the classic status alias; argparse would eat it as an
     # unknown option before the positional, so translate it up front
-    argv = ["status" if a == "-s" else a
+    argv = [{"-s": "status", "-w": "watch"}.get(a, a)
             for a in (sys.argv[1:] if argv is None else list(argv))]
     ap = argparse.ArgumentParser(prog="ceph")
     ap.add_argument("--data-dir")
@@ -90,12 +90,14 @@ def main(argv=None) -> int:
                     help="client.admin keyring (default: "
                          "<data-dir>/client.admin.keyring)")
     ap.add_argument("--iterations", type=int, default=1,
-                    help="top: number of refresh rounds")
+                    help="top/watch/daemonperf: refresh rounds "
+                         "(watch: 0 = follow forever)")
     ap.add_argument("--interval", type=float, default=1.0,
-                    help="top: seconds between refresh rounds")
+                    help="top/watch/daemonperf: seconds between rounds")
     ap.add_argument("cmd", nargs="+",
                     help="status | -s | health [detail] | "
-                         "health mute|unmute KEY | top | flight dump | "
+                         "health mute|unmute KEY | top | daemonperf | "
+                         "log last [N] | watch | -w | flight dump | "
                          "osd tree | osd df | pg dump | df")
     args = ap.parse_args(argv)
 
@@ -104,6 +106,12 @@ def main(argv=None) -> int:
         return _run_remote(args)
     if args.data_dir is None:
         ap.error("--data-dir is required (or --connect for remote mode)")
+    if args.cmd[0] == "watch":
+        # `ceph -w`: follow the persisted clusterlog FILE — no cluster
+        # reopen (a live process may hold the stores; the log file is
+        # the one surface both can share)
+        return _run_watch(os.path.join(args.data_dir, "clusterlog"),
+                          args.iterations, args.interval)
     from ..cluster import MiniCluster
     if not os.path.exists(os.path.join(args.data_dir, "cluster_meta.pkl")):
         print(f"error: no cluster at {args.data_dir}", file=sys.stderr)
@@ -136,6 +144,14 @@ def main(argv=None) -> int:
             print(f"{args.cmd[1]}d {key}")
         elif cmd == "top":
             _run_top(c, args.iterations, args.interval)
+        elif cmd == "daemonperf":
+            _run_daemonperf(c, args.iterations, args.interval)
+        elif args.cmd[0] == "log" and len(args.cmd) >= 2 and \
+                args.cmd[1] == "last":
+            n = int(args.cmd[2]) if len(args.cmd) > 2 else 20
+            from ..common.clusterlog import format_entry
+            for e in c.clusterlog.last(n):
+                print(format_entry(e))
         elif cmd == "flight dump":
             b = c.flight.dump(reason="cli", force=True)
             print(f"captured flight bundle seq={b['seq']} "
@@ -290,6 +306,10 @@ def render_top(c) -> str:
     lines.append(rec_line)
     lines.append(f"serving:   {d['serving']['op_s']:.0f} op/s, "
                  f"{d['serving']['batch_s']:.0f} batch/s")
+    w = d["wire"]
+    if w["tx_bytes_s"] or w["tx_msgs_s"]:
+        lines.append(f"wire:      {_fmt_bytes_s(w['tx_bytes_s'])} tx, "
+                     f"{w['tx_msgs_s']:.0f} msg/s")
     lines.append(f"jit:       {d['jit']['compiles']:.0f} compiles, "
                  f"{d['jit']['cache_hits']:.0f} cache hits (window)")
     from ..mgr.health import iter_throttles
@@ -313,6 +333,69 @@ def _run_top(c, iterations: int, interval: float) -> None:
             time.sleep(interval)
             print()
         print(render_top(c))
+
+
+def _run_watch(path: str, iterations: int, interval: float) -> int:
+    """`ceph -w`: print the clusterlog tail, then follow the FILE for
+    appends (another process's MiniCluster writing it live).
+    ``iterations=0`` follows forever; N bounds the poll rounds (tests,
+    scripts)."""
+    import os
+    from ..common.clusterlog import format_entry, read_log_file
+    if not os.path.exists(path):
+        print(f"error: no clusterlog at {path} (cluster never ran "
+              f"durable, or nothing logged yet)", file=sys.stderr)
+        return 2
+    entries = read_log_file(path)
+    for e in entries[-10:]:
+        print(format_entry(e), flush=True)
+    seen = max((e.get("seq", 0) for e in entries), default=0)
+    rounds = 0
+    while iterations <= 0 or rounds < iterations:
+        rounds += 1
+        time.sleep(interval)
+        for e in read_log_file(path):
+            if e.get("seq", 0) > seen:
+                seen = e["seq"]
+                print(format_entry(e), flush=True)
+    return 0
+
+
+def render_daemonperf(c, prev: dict | None = None) -> tuple[str, dict]:
+    """One `daemonperf` frame: per-daemon queue counter DELTAS since
+    ``prev`` plus the cluster rate digest — the reference's
+    ``ceph daemonperf osd.N`` columns generalized over every daemon.
+    Returns (rendered text, new prev) so the caller owns the cadence."""
+    c.stats.sample()
+    d = c.stats.digest()
+    cur = {o: dict(daemon.queue_stats) for o, daemon in sorted(c.osds.items())}
+    prev = prev or {}
+    lines = ["daemon   enq   deq   rej  wait_ms | "
+             "wr/s   rd/s   rec_B/s   wire_B/s"]
+    cluster_cols = (f"{d['client_io']['wr_op_s']:6.0f} "
+                    f"{d['client_io']['rd_op_s']:6.0f} "
+                    f"{d['recovery']['bytes_s']:9.0f} "
+                    f"{d['wire']['tx_bytes_s']:10.0f}")
+    for o, qs in cur.items():
+        p = prev.get(o, {})
+        enq = qs["enqueued"] - p.get("enqueued", 0)
+        deq = qs["dequeued"] - p.get("dequeued", 0)
+        rej = qs["throttled_rejects"] - p.get("throttled_rejects", 0)
+        wait = (qs["wait_sum"] - p.get("wait_sum", 0.0)) * 1000.0
+        lines.append(f"osd.{o:<4} {enq:5d} {deq:5d} {rej:5d} "
+                     f"{wait:8.1f} | {cluster_cols}")
+        cluster_cols = " " * len(cluster_cols)   # once per frame
+    return "\n".join(lines), cur
+
+
+def _run_daemonperf(c, iterations: int, interval: float) -> None:
+    prev: dict | None = None
+    for i in range(max(1, iterations)):
+        if i:
+            time.sleep(interval)
+            print()
+        text, prev = render_daemonperf(c, prev)
+        print(text)
 
 
 def _run_remote(args) -> int:

@@ -173,3 +173,57 @@ def test_scalar_mds_choices(registry, scalar_mds, technique):
     decoded = ec.decode({1, 5}, available)
     np.testing.assert_array_equal(decoded[1], encoded[1])
     np.testing.assert_array_equal(decoded[5], encoded[5])
+
+
+# -- cluster read paths (sub-chunk geometry vs chunk slicing) ---------------
+
+class TestClayClusterReads:
+    """A sub-chunked chunk is ONE codeword over its whole height: any
+    read path that must DECODE (degraded, or mid-read source failure)
+    has to fetch full chunks — a (c_off, c_len) slice is not a smaller
+    codeword the way it is for per-byte-linear RS.  Both regressions
+    here were found by the clay thrash soak."""
+
+    def _cluster(self):
+        from ceph_tpu.cluster import MiniCluster
+        from ceph_tpu.common import Context
+        c = MiniCluster(n_osds=12, chunk_size=128, cct=Context())
+        pid = c.create_ec_pool(
+            "p", {"plugin": "clay", "k": "4", "m": "2",
+                  "scalar_mds": "jax_rs", "device": "numpy"}, pg_num=1)
+        g = c.pools[pid]["pgs"][0]
+        data = _payload(3 * 512, seed=3)      # 3 stripes: height 384 > 128
+        c.put(pid, "o", data)
+        return c, pid, g, data
+
+    def test_degraded_partial_read_decodes_whole_chunks(self):
+        c, pid, g, data = self._cluster()
+        try:
+            g.bus.mark_down(g.acting[1])
+            out = {}
+            g.backend.objects_read_and_reconstruct(
+                {"o": [(512, 512)]}, lambda r, e: out.update(r=r, e=e))
+            g.bus.deliver_all()
+            assert not out["e"]
+            assert out["r"]["o"][0][2] == data[512:1024]
+        finally:
+            c.shutdown()
+
+    def test_mid_read_source_failure_upgrades_to_whole_chunks(self):
+        """A HEALTHY sliced read whose source errors mid-flight retries
+        through parity: the retry must re-fetch every contributor at
+        full height (sliced buffers + parity slices decode garbage)."""
+        from ceph_tpu.backend.memstore import GObject
+        from ceph_tpu.backend.pg_backend import shard_store
+        c, pid, g, data = self._cluster()
+        try:
+            victim = g.acting[1]
+            del shard_store(g.bus, victim).objects[GObject("o", victim)]
+            out = {}
+            g.backend.objects_read_and_reconstruct(
+                {"o": [(512, 512)]}, lambda r, e: out.update(r=r, e=e))
+            g.bus.deliver_all()
+            assert not out["e"], out["e"]
+            assert out["r"]["o"][0][2] == data[512:1024]
+        finally:
+            c.shutdown()

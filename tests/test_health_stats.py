@@ -242,7 +242,10 @@ class TestStatsAggregator:
                 "client_wr_bytes_s", "client_rd_bytes_s", "client_wr_op_s",
                 "client_rd_op_s", "recovery_bytes_s", "recovery_op_s",
                 "recovery_queued_pgs", "recovery_active_pgs",
+                "recovery_wire_per_byte",
                 "serving_batch_s", "serving_op_s", "serving_bytes_s",
+                "serving_wire_per_op", "wire_tx_bytes_s",
+                "wire_tx_msgs_s",
                 "jit_compiles", "jit_cache_hits"}
         finally:
             agg.close()

@@ -1,0 +1,480 @@
+"""Wire & workload observability: accounting completeness, heat maps,
+cluster log, and the embedded time-series ring.
+
+The acceptance surface of the wire-observability PR:
+
+- per-op-class wire bytes SUM to total connection bytes (accounting is
+  complete — no message escapes classification);
+- ``recovery.wire_bytes_per_byte_repaired`` reports ~k for centralized
+  repair at k=8 (ROADMAP item 3's success metric, finally measurable);
+- a synthetic hot-shard workload trips ``HOT_SHARD`` and shows the skew
+  in ``ceph_tpu_osd_heat``;
+- the cluster log ring is bounded and persists; ``ceph -w`` /
+  ``ceph log last`` / ``daemonperf`` render it; the time-series ring
+  evicts round-robin; and ``ts_report`` replays an episode from the
+  flight-recorder bundle alone.
+"""
+import importlib.util
+import json
+import os
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from ceph_tpu.cluster import MiniCluster
+from ceph_tpu.common import Context
+from ceph_tpu.common.clusterlog import ClusterLog
+from ceph_tpu.common.wire_accounting import (WIRE_CLASSES, WireAccounting,
+                                             wire_size)
+from ceph_tpu.mgr.timeseries import TimeSeriesRing
+
+ROOT = Path(__file__).resolve().parent.parent
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        f"tool_{name}", ROOT / "tools" / f"{name}.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class _Ctx:
+    """A minimal TraceContext stand-in for unit tests."""
+    def __init__(self, op_class):
+        self.op_class = op_class
+
+
+class TestWireAccountingUnit:
+    def test_classes_partition_totals(self):
+        cct = Context()
+        acct = WireAccounting(cct=cct, name="unit")
+        try:
+            acct.account_tx("A", 100, ctx=_Ctx("recovery"))
+            acct.account_tx("B", 50, ctx=_Ctx("client"))
+            acct.account_tx("B", 25, ctx=None)          # untraced -> other
+            acct.account_rx("A", 10, ctx=_Ctx("scrub"))
+            totals = acct.totals()
+            assert totals["tx_bytes"] == 175 and totals["rx_bytes"] == 10
+            assert totals["tx_msgs"] == 3 and totals["rx_msgs"] == 1
+            cls = acct.class_bytes()
+            assert sum(cls.values()) == 185
+            assert cls["recovery"] == 100 and cls["other"] == 25
+            per = acct.per_type()
+            assert per["B"]["tx_bytes"] == 75
+            assert per["A"]["rx_msgs"] == 1
+        finally:
+            acct.close()
+        assert cct.perf.get("wire.unit") is None     # close() unhooks
+
+    def test_queue_depth_peak_and_rpc_latency(self):
+        acct = WireAccounting(cct=Context(), name="unit2")
+        try:
+            acct.note_queue_depth(3)
+            acct.note_queue_depth(9)
+            acct.note_queue_depth(1)
+            assert acct.perf.get("send_queue_depth") == 1
+            assert acct.perf.get("send_queue_peak") == 9
+            acct.observe_rpc("put", 0.002)
+            acct.observe_rpc("put", 0.004)
+            acct.observe_rpc("get", 0.001)
+            rpc = acct.rpc_methods()
+            assert rpc["put"]["count"] == 2
+            assert rpc["put"]["avg_ms"] == pytest.approx(3.0, abs=0.5)
+            dump = acct.perf.dump()["rpc_latency_ms"]
+            assert dump["count"] == 3
+        finally:
+            acct.close()
+
+    def test_wire_size_fallback_is_still_counted(self):
+        class Unregistered:
+            pass
+        acct = WireAccounting(cct=Context(), name="unit3")
+        try:
+            acct.account_msg(Unregistered())
+            assert acct.perf.get("unsized_msgs") == 1
+            assert acct.perf.get("tx_bytes") >= wire_size(Unregistered()) \
+                or acct.perf.get("tx_bytes") > 0
+        finally:
+            acct.close()
+
+
+class TestWireCompleteness:
+    def test_mixed_serving_recovery_classes_sum_to_totals(self):
+        """The acceptance invariant: under a mixed serving+recovery run
+        every byte on the bus lands in exactly one op-class bucket."""
+        c = MiniCluster(n_osds=8, osds_per_host=2, chunk_size=512,
+                        cct=Context())
+        try:
+            pid = c.create_ec_pool("p", {"k": "2", "m": "2",
+                                         "device": "numpy"}, pg_num=4)
+            c.enable_recovery_scheduler()
+            rng = np.random.default_rng(0)
+            objs = {f"o{i}": rng.integers(0, 256, 3000,
+                                          np.uint8).tobytes()
+                    for i in range(8)}
+            for oid, d in objs.items():
+                c.put(pid, oid, d)
+            g = c.pools[pid]["pgs"][0]
+            victim = g.acting[1]
+            g.bus.mark_down(victim)
+            for oid, d in objs.items():      # writes the victim misses
+                c.put(pid, oid, b"\x07" + d[1:])
+            g.bus.mark_up(victim)
+            c.deliver_all()
+            for oid, d in objs.items():      # serving reads
+                assert c.get(pid, oid, 3000) == b"\x07" + d[1:]
+            c.scrub_pool(pid, repair=False)  # scrub-class traffic too
+            totals = c.wire.totals()
+            cls_bytes = c.wire.class_bytes()
+            assert totals["tx_bytes"] > 0
+            assert sum(cls_bytes.values()) == \
+                totals["tx_bytes"] + totals["rx_bytes"]
+            cls_msgs = {k: c.wire.perf.get(f"class_msgs:{k}")
+                        for k in WIRE_CLASSES}
+            assert sum(cls_msgs.values()) == \
+                totals["tx_msgs"] + totals["rx_msgs"]
+            # the mixed run actually exercised several classes
+            assert cls_bytes["client"] > 0
+            assert cls_bytes["recovery"] > 0
+            assert c.wire.perf.get("unsized_msgs") == 0
+            assert c.wire.perf.get("send_queue_peak") >= 1
+        finally:
+            c.shutdown()
+
+
+class TestRecoveryWireRatio:
+    def test_centralized_repair_is_k_times_on_wire(self):
+        """k=8 centralized repair hauls ~k survivor chunks to the
+        primary per chunk repaired: wire-bytes-per-byte-repaired lands
+        near k (log/header overhead rides on top) — the number the
+        pipelined-repair work (ROADMAP item 3) must push toward ~1."""
+        k = 8
+        c = MiniCluster(n_osds=12, osds_per_host=1, chunk_size=512,
+                        cct=Context())
+        try:
+            pid = c.create_ec_pool("p", {"k": str(k), "m": "2",
+                                         "device": "numpy"}, pg_num=1)
+            g = c.pools[pid]["pgs"][0]
+            rng = np.random.default_rng(1)
+            objs = {f"o{i}": rng.integers(0, 256, 16384,
+                                          np.uint8).tobytes()
+                    for i in range(6)}
+            c.stats.sample(now=0.0)
+            for oid, d in objs.items():
+                c.put(pid, oid, d)
+            victim = g.acting[1]
+            g.bus.mark_down(victim)
+            for oid, d in objs.items():
+                c.put(pid, oid, b"\x01" + d[1:])
+            wire0 = c.wire.perf.get("class_bytes:recovery")
+            rep0 = g.backend.perf.get("recovery_bytes")
+            g.bus.mark_up(victim)
+            c.deliver_all()
+            wire = c.wire.perf.get("class_bytes:recovery") - wire0
+            repaired = g.backend.perf.get("recovery_bytes") - rep0
+            assert repaired > 0
+            ratio = wire / repaired
+            assert 0.9 * k <= ratio <= 2.0 * k, \
+                f"centralized repair wire ratio {ratio:.2f} not ~k={k}"
+            # the digest reports the same metric over the stats window
+            c.stats.sample(now=10.0)
+            d = c.stats.digest()
+            assert d["recovery"]["wire_bytes_per_byte_repaired"] == \
+                pytest.approx(ratio, rel=0.25)
+            assert d["serving"]["wire_bytes_per_op"] > 0
+        finally:
+            c.shutdown()
+
+
+class TestHotShard:
+    def _cluster(self):
+        c = MiniCluster(n_osds=9, osds_per_host=3, chunk_size=512,
+                        cct=Context())
+        pid = c.create_ec_pool("p", {"k": "2", "m": "1",
+                                     "device": "numpy"}, pg_num=4)
+        # deterministic window: drive the aggregator on a fake clock so
+        # rates don't depend on wall time
+        t = [0.0]
+        c.stats.clock = lambda: t[0]
+        return c, pid, t
+
+    def test_hot_shard_trips_check_and_heat_gauges(self):
+        c, pid, t = self._cluster()
+        try:
+            # oids that all land in ONE PG: the synthetic hot shard
+            hot = [oid for oid in (f"h{i}" for i in range(200))
+                   if c.object_pg(pid, oid) == 0][:4]
+            assert len(hot) == 4
+            c.stats.sample()
+            for rep in range(15):
+                for oid in hot:
+                    c.put(pid, oid, bytes([rep]) * 1024)
+            t[0] = 2.0
+            c.stats.sample()                 # 60 ops / 2s = 30 op/s
+            h = c.health()
+            assert "HOT_SHARD" in h["checks"], h
+            ev = c.health_engine.last_evaluation
+            assert ev["checks"]["HOT_SHARD"]["detail"]
+            hot_osd = c.pools[pid]["pgs"][0].backend.whoami
+            heat = c.heat.osd_heat()
+            assert heat[hot_osd]["op_s"] >= 16
+            digest = c.heat.tail_digest()
+            assert hot_osd in digest["hot_osds"]
+            from ceph_tpu.mgr.prometheus import render
+            lines = render(c.cct).splitlines()
+            row = next(l for l in lines if l.startswith(
+                f'ceph_tpu_osd_heat{{owner="c{c.cluster_id}",'
+                f'osd="{hot_osd}",stat="op_s"}}'))
+            assert float(row.rsplit(" ", 1)[1]) > 0
+            pg_row = next(l for l in lines if l.startswith(
+                f'ceph_tpu_pg_heat{{owner="c{c.cluster_id}",'
+                f'pg="1.0",stat="op_s"}}'))
+            assert float(pg_row.rsplit(" ", 1)[1]) > 0
+        finally:
+            c.shutdown()
+
+    def test_balanced_load_does_not_fire(self):
+        c, pid, t = self._cluster()
+        try:
+            c.stats.sample()
+            rng = np.random.default_rng(3)
+            for i in range(60):              # spread over all PGs
+                c.put(pid, f"b{i}", rng.integers(0, 256, 800,
+                                                 np.uint8).tobytes())
+            t[0] = 2.0
+            c.stats.sample()
+            assert "HOT_SHARD" not in c.health()["checks"]
+        finally:
+            c.shutdown()
+
+    def test_idle_and_subsecond_windows_never_fire(self):
+        c, pid, t = self._cluster()
+        try:
+            c.stats.sample()
+            t[0] = 0.5                       # sub-second window
+            for oid in ("x", "y"):
+                c.put(pid, oid, b"z" * 512)
+            c.stats.sample()
+            assert "HOT_SHARD" not in c.health()["checks"]
+        finally:
+            c.shutdown()
+
+
+class TestClusterLog:
+    def test_ring_bounded_and_severity_filter(self):
+        log = ClusterLog(cct=Context(), capacity=5)
+        for i in range(12):
+            log.log("INF" if i % 2 else "WRN", f"event {i}")
+        entries = log.last(100)
+        assert len(entries) == 5                       # bounded
+        assert entries[-1]["message"] == "event 11"
+        assert entries[0]["message"] == "event 7"      # oldest evicted
+        warns = log.last(100, severity="WRN")
+        assert all(e["severity"] == "WRN" for e in warns)
+        assert log.tail_since(entries[-2]["seq"]) == entries[-1:]
+        with pytest.raises(ValueError):
+            log.log("NOPE", "bad severity")
+
+    def test_persistence_and_seq_survive_reopen(self, tmp_path):
+        path = tmp_path / "clusterlog"
+        log = ClusterLog(cct=Context(), path=path, capacity=10)
+        log.info("first")
+        log.warn("second")
+        log.close()
+        log2 = ClusterLog(cct=Context(), path=path, capacity=10)
+        msgs = [e["message"] for e in log2.last(10)]
+        assert msgs == ["first", "second"]
+        e = log2.error("third")
+        assert e["seq"] == 3                           # seq continues
+        from ceph_tpu.common.clusterlog import read_log_file
+        assert [x["message"] for x in read_log_file(path)] == \
+            ["first", "second", "third"]
+
+    def test_file_compaction_bounds_disk(self, tmp_path):
+        path = tmp_path / "clusterlog"
+        log = ClusterLog(cct=Context(), path=path, capacity=4)
+        for i in range(50):
+            log.info(f"e{i}")
+        from ceph_tpu.common.clusterlog import COMPACT_FACTOR, \
+            read_log_file
+        on_disk = read_log_file(path)
+        assert len(on_disk) <= 4 * COMPACT_FACTOR
+        assert on_disk[-1]["message"] == "e49"         # newest survives
+
+
+class TestTimeSeries:
+    def _ring(self, **kw):
+        t = [0.0]
+        kw.setdefault("interval", 1.0)
+        kw.setdefault("capacity", 4)
+        kw.setdefault("coarse_every", 2)
+        ring = TimeSeriesRing(cct=Context(), clock=lambda: t[0], **kw)
+        return ring, t
+
+    def test_round_robin_eviction(self):
+        ring, t = self._ring()
+        vals = [0.0]
+        ring.add_source("s", lambda: {"v": vals[0]})
+        for i in range(10):
+            t[0] = float(i)
+            vals[0] = float(i)
+            assert ring.record() is not None
+        assert len(ring.fine) == 4                     # bounded
+        assert [p["s.v"] for p in ring.fine] == [6.0, 7.0, 8.0, 9.0]
+        assert ring.points_recorded == 10
+        # coarse: every 2 fine points folded to avg+max, also bounded
+        assert len(ring.coarse) == 4
+        last = ring.coarse[-1]
+        assert last["s.v:avg"] == 8.5 and last["s.v:max"] == 9.0
+
+    def test_interval_gating_and_force(self):
+        ring, t = self._ring(interval=5.0)
+        ring.add_source("s", lambda: {"v": 1.0})
+        assert ring.record() is not None
+        t[0] = 1.0
+        assert ring.record() is None                   # inside interval
+        assert ring.points_skipped == 1
+        assert ring.record(force=True) is not None     # phase boundary
+        t[0] = 6.0
+        assert ring.record() is not None
+
+    def test_broken_source_marks_error_not_crash(self):
+        ring, t = self._ring()
+        ring.add_source("bad", lambda: 1 / 0)
+        ring.add_source("good", lambda: {"v": 2.0})
+        p = ring.record()
+        assert p["bad.error"] == 1.0 and p["good.v"] == 2.0
+
+    def test_series_access_and_dump_shape(self):
+        ring, t = self._ring()
+        ring.add_source("s", lambda: {"v": t[0]})
+        for i in range(3):
+            t[0] = float(i)
+            ring.record()
+        assert ring.series_names() == ["s.v"]
+        assert ring.series("s.v") == [(0.0, 0.0), (1.0, 1.0), (2.0, 2.0)]
+        d = ring.dump()
+        assert d["recorded"] == 3 and len(d["fine"]) == 3
+
+
+@pytest.fixture
+def durable_cluster(tmp_path):
+    c = MiniCluster(n_osds=6, osds_per_host=2, chunk_size=512,
+                    cct=Context(), data_dir=tmp_path / "d")
+    pid = c.create_ec_pool("p", {"k": "2", "m": "1", "device": "numpy"},
+                           pg_num=4)
+    rng = np.random.default_rng(5)
+    for i in range(6):
+        c.put(pid, f"o{i}", rng.integers(0, 256, 1500,
+                                         np.uint8).tobytes())
+    yield c, pid, tmp_path / "d"
+    c.shutdown()
+
+
+class TestCLISurfaces:
+    def test_log_last_and_watch_and_daemonperf(self, durable_cluster,
+                                               capsys):
+        c, pid, data_dir = durable_cluster
+        g = c.pools[pid]["pgs"][0]
+        victim = g.acting[1]
+        g.bus.mark_down(victim)
+        g.bus.mark_up(victim)
+        c.deliver_all()
+        c.shutdown()          # release stores for the CLI reopen
+        from ceph_tpu.tools.ceph_cli import main as ceph_main
+        assert ceph_main(["--data-dir", str(data_dir),
+                          "log", "last", "50"]) == 0
+        out = capsys.readouterr().out
+        assert f"osd.{victim} down" in out
+        assert f"osd.{victim} up" in out
+        assert "pool 'p' created" in out
+        # `ceph -w` follows the FILE without reopening the cluster
+        assert ceph_main(["--data-dir", str(data_dir), "-w",
+                          "--iterations", "1",
+                          "--interval", "0.01"]) == 0
+        out = capsys.readouterr().out
+        assert "[osd]" in out or "[mon]" in out
+        # daemonperf: per-daemon counter-rate columns
+        assert ceph_main(["--data-dir", str(data_dir), "daemonperf",
+                          "--iterations", "2", "--interval", "0"]) == 0
+        out = capsys.readouterr().out
+        assert "daemon" in out and "osd.0" in out and "wire_B/s" in out
+
+    def test_watch_without_log_is_an_error(self, tmp_path, capsys):
+        from ceph_tpu.tools.ceph_cli import main as ceph_main
+        assert ceph_main(["--data-dir", str(tmp_path), "watch",
+                          "--iterations", "1"]) == 2
+        assert "no clusterlog" in capsys.readouterr().err
+
+
+class TestFlightReplay:
+    def test_ts_report_replays_episode_from_bundle_alone(
+            self, durable_cluster, capsys):
+        """The acceptance closer: degrade the cluster, let the health
+        transition snapshot a flight bundle, then reconstruct what
+        happened from the BUNDLE — time-series sparklines + the cluster
+        log — with no live cluster and no external scraper."""
+        c, pid, data_dir = durable_cluster
+        c.status()                      # tick stats + timeseries
+        c.ts.record(force=True)
+        g = c.pools[pid]["pgs"][0]
+        g.bus.mark_down(g.acting[1])    # degrade -> PG_DEGRADED WARN
+        c.ts.record(force=True)
+        h = c.health()                  # transition -> flight dump
+        assert h["status"] != "HEALTH_OK"
+        bundles = sorted((data_dir / "flight").glob("flight-*.json"))
+        assert bundles, "health transition wrote no flight bundle"
+        bundle = json.loads(bundles[-1].read_text())
+        assert bundle["timeseries"]["fine"], "bundle carries no points"
+        assert any("down" in e["message"]
+                   for e in bundle["clusterlog"])
+        ts_report = _load_tool("ts_report")
+        assert ts_report.main([str(data_dir / "flight"), "--log"]) == 0
+        out = capsys.readouterr().out
+        assert "stats.client_wr_op_s" in out
+        assert "down" in out            # the clusterlog replay
+        assert ts_report.main([str(bundles[-1]), "--series",
+                               "heat.tail", "--json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert any(r["series"].startswith("heat.tail")
+                   for r in doc["series"])
+
+    def test_ts_report_rejects_garbage(self, tmp_path, capsys):
+        ts_report = _load_tool("ts_report")
+        p = tmp_path / "junk.json"
+        p.write_text(json.dumps({"hello": 1}))
+        assert ts_report.main([str(p)]) == 2
+        assert "no usable timeseries" in capsys.readouterr().err
+
+
+class TestNetWireAccounting:
+    def test_tcp_rpc_frames_and_latency_accounted(self, tmp_path):
+        from ceph_tpu.net import ClusterServer, TcpRados
+        c = MiniCluster(n_osds=6, osds_per_host=2, chunk_size=512,
+                        cct=Context(), data_dir=tmp_path / "d")
+        server = ClusterServer(c)
+        server.start()
+        try:
+            r = TcpRados("127.0.0.1", server.port,
+                         tmp_path / "d" / "client.admin.keyring")
+            r.mkpool("np", {"k": "2", "m": "1", "device": "numpy"},
+                     pg_num=4)
+            payload = os.urandom(2048)
+            r.put("np", "obj", payload)
+            assert r.get("np", "obj") == payload
+            r.close()
+            per = server.wire.per_type()
+            assert per["RpcCall"]["rx_msgs"] >= 3      # mkpool/put/get
+            assert per["RpcResult"]["tx_msgs"] >= 3
+            assert per["RpcCall"]["rx_bytes"] >= 2048  # the put payload
+            rpc = server.wire.rpc_methods()
+            assert rpc["put"]["count"] == 1 and rpc["get"]["count"] == 1
+            assert server.wire.perf.dump()["rpc_latency_ms"]["count"] \
+                >= 3
+            # RPC frames rode a traced client op: classed, not "other"
+            assert server.wire.perf.get("class_bytes:client") > 0
+        finally:
+            server.stop()
+            c.shutdown()

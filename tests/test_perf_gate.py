@@ -14,13 +14,15 @@ spec.loader.exec_module(perf_gate)
 
 
 def _line(value=1000.0, device="tpu", serving=500.0, recovery=80.0,
-          pipeline=120.0, p99=2.0):
+          pipeline=120.0, p99=2.0, wire_per_byte=6.0, wire_per_op=9000.0):
     return {
         "metric": "rs_k8m4_1MiB_encode_decode_device_resident",
         "value": value, "unit": "MiB/s", "device": device,
         "serving": {"device": device,
-                    "batched": {"ops_s": serving, "p99_ms": p99}},
-        "recovery": {"device": device, "batched": {"mib_s": recovery}},
+                    "batched": {"ops_s": serving, "p99_ms": p99},
+                    "wire": {"per_op": wire_per_op}},
+        "recovery": {"device": device, "batched": {"mib_s": recovery},
+                     "wire": {"per_byte_repaired": wire_per_byte}},
         "pipeline": {"device": device, "async": {"mib_s": pipeline}},
     }
 
@@ -30,7 +32,7 @@ class TestEvaluate:
         res = perf_gate.evaluate(_line(value=980.0), _line(),
                                  expect_platform="tpu")
         assert res["ok"] and res["verdict"].startswith("PERF GATE: PASS")
-        assert len(res["compared"]) == 5
+        assert len(res["compared"]) == 7
 
     def test_twenty_percent_regression_fails(self):
         res = perf_gate.evaluate(_line(value=800.0), _line(value=1000.0))
@@ -42,6 +44,22 @@ class TestEvaluate:
         res = perf_gate.evaluate(_line(recovery=50.0), _line())
         assert not res["ok"]
         assert any("recovery.mib_s" in f for f in res["failures"])
+
+    def test_wire_efficiency_regression_direction_is_up(self):
+        """Wire metrics gate on INCREASE: repair moving more bytes on
+        the wire per byte repaired (or serving per op) is the
+        regression, even with throughput unchanged."""
+        res = perf_gate.evaluate(_line(wire_per_byte=8.0),
+                                 _line(wire_per_byte=6.0))
+        assert not res["ok"]
+        assert any("recovery.wire_per_byte" in f for f in res["failures"])
+        res = perf_gate.evaluate(_line(wire_per_op=12000.0),
+                                 _line(wire_per_op=9000.0))
+        assert any("serving.wire_per_op" in f for f in res["failures"])
+        # a wire-efficiency IMPROVEMENT (fewer bytes moved) passes
+        res = perf_gate.evaluate(_line(wire_per_byte=2.0,
+                                       wire_per_op=5000.0), _line())
+        assert res["ok"]
 
     def test_latency_regression_direction_is_up(self):
         res = perf_gate.evaluate(_line(p99=3.0), _line(p99=2.0))
@@ -67,7 +85,7 @@ class TestEvaluate:
         res = perf_gate.evaluate(_line(device="cpu"),
                                  _line(device="cpu"),
                                  expect_platform="cpu")
-        assert res["ok"] and len(res["compared"]) == 5
+        assert res["ok"] and len(res["compared"]) == 7
 
     def test_custom_threshold(self):
         ref, new = _line(value=1000.0), _line(value=900.0)

@@ -298,6 +298,72 @@ class TestThrashScheduledFused:
             assert cluster.get(pid, oid, len(want)) == want
 
 
+@pytest.fixture(scope="module")
+def thrashed_clay():
+    """The recovery soak over a CLAY pool: sub-chunk FRACTIONAL repair
+    reads (get_repair_subchunks < full chunk) under randomized churn,
+    reservation-gated by the scheduler.  The seed's fractional-read
+    regression (zero-padded helper reads full-decoding garbage) was only
+    caught by a unit test; this arm makes the whole repair path —
+    fractional reads through ECSubRead slicing, per-object fallback
+    inside scheduler waves, log catch-up after revival — hold the
+    acked-write/scrub-clean invariants under fire, so it cannot silently
+    regress again (ROADMAP item 1's leftover)."""
+    from ceph_tpu.common import Context
+    rng = np.random.default_rng(20260806)
+    cluster = MiniCluster(n_osds=12, chunk_size=CHUNK, cct=Context())
+    pid = cluster.create_ec_pool(
+        "thrash", {"plugin": "clay", "k": str(K), "m": str(M),
+                   "scalar_mds": "jax_rs", "device": "numpy"},
+        pg_num=8)
+    from ceph_tpu.backend.messages import FaultConfig
+    for i, g in enumerate(cluster.pools[pid]["pgs"].values()):
+        g.bus.inject_faults(FaultConfig(seed=i * 7 + 3001,
+                                        reorder=True, dup_prob=0.1))
+    cluster.enable_recovery_scheduler()
+    model, log = _run_campaign(cluster, pid, rng, 120)
+    return cluster, pid, model, log
+
+
+class TestThrashClay:
+    def test_fractional_code_actually_engaged(self, thrashed_clay):
+        cluster, pid, model, log = thrashed_clay
+        ec = cluster.pools[pid]["ec"]
+        # the pool really is sub-chunked and its repair plan fractional
+        assert ec.get_sub_chunk_count() > 1
+        assert sum(c for _, c in ec.get_repair_subchunks(1)) < \
+            ec.get_sub_chunk_count()
+        # and the campaign really repaired through it
+        assert sum(1 for e in log if e.startswith("kill")) >= 3
+        recoveries = sum(
+            g.backend.perf.get("recoveries")
+            + g.backend.perf.get("log_repair_objects")
+            + g.backend.perf.get("backfill_objects")
+            for g in cluster.pools[pid]["pgs"].values())
+        assert recoveries >= 1, "clay soak never exercised repair"
+
+    def test_converged_and_model_intact(self, thrashed_clay):
+        cluster, pid, model, _ = thrashed_clay
+        assert len(model) >= 8
+        for g in cluster.pools[pid]["pgs"].values():
+            assert not g.backend.stale, \
+                f"{g.pgid}: shards {g.backend.stale} never repaired"
+            assert not g.backend.waiting_state
+            assert g.backend.is_active()
+        assert cluster.recovery.jobs == {}
+        for oid, want in sorted(model.items()):
+            got = cluster.get(pid, oid, len(want))
+            assert got == want, f"{oid} lost acked data under clay repair"
+
+    def test_deep_scrub_clean_after_clay_soak(self, thrashed_clay):
+        cluster, pid, model, _ = thrashed_clay
+        for oid in sorted(model):
+            g = cluster.pg_group(pid, oid)
+            report = g.backend.be_deep_scrub(oid)
+            bad = {c for c, clean in report.items() if not clean}
+            assert not bad, f"{oid}: inconsistent chunks {bad}"
+
+
 class TestThrashScheduled:
     def test_campaign_ran_and_converged(self, thrashed_scheduled):
         cluster, pid, model, log = thrashed_scheduled

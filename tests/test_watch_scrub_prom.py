@@ -266,6 +266,87 @@ class TestPrometheus:
         finally:
             agg.close()
 
+    def test_wire_counter_families_rendered(self):
+        """WireAccounting exports through BOTH surfaces: the ordinary
+        collection walk (totals + per-class rollups under
+        collection="wire.<name>") and the labelled per-message-type
+        family (`ceph_tpu_wire_bytes{owner,msg_type,dir}`), with the
+        HELP/TYPE-once invariants."""
+        from ceph_tpu.common import Context
+        from ceph_tpu.common.wire_accounting import WireAccounting
+        from ceph_tpu.mgr.prometheus import render
+
+        class _Ctx:
+            op_class = "recovery"
+        cct = Context()
+        acct = WireAccounting(cct=cct, name="promwire")
+        try:
+            acct.account_tx("ECSubRead", 100, ctx=_Ctx())
+            acct.account_rx("ECSubReadReply", 4096, ctx=_Ctx())
+            text = render(cct)
+            lines = text.splitlines()
+            assert 'ceph_tpu_tx_bytes{collection="wire.promwire"} 100' \
+                in lines
+            assert 'ceph_tpu_rx_bytes{collection="wire.promwire"} 4096' \
+                in lines
+            assert 'ceph_tpu_class_bytes_recovery{' \
+                   'collection="wire.promwire"} 4196' in lines
+            assert 'ceph_tpu_wire_bytes{owner="promwire",' \
+                   'msg_type="ECSubRead",dir="tx"} 100' in lines
+            assert 'ceph_tpu_wire_bytes{owner="promwire",' \
+                   'msg_type="ECSubReadReply",dir="rx"} 4096' in lines
+            assert lines.count("# TYPE ceph_tpu_wire_bytes counter") == 1
+            types = [line.split(" ", 2)[2].split(" ", 1)[0]
+                     for line in lines if line.startswith("# TYPE ")]
+            assert len(types) == len(set(types)), "duplicate TYPE lines"
+        finally:
+            acct.close()
+
+    def test_heat_gauge_families_rendered(self):
+        """Live HeatTrackers export `ceph_tpu_osd_heat{owner,osd,stat}`
+        and `ceph_tpu_pg_heat{owner,pg,stat}` — the hot-shard skew
+        instrument — with the HELP/TYPE-once invariants."""
+        from ceph_tpu.common import Context, PerfCountersBuilder
+        from ceph_tpu.mgr.heat import HeatTracker
+        from ceph_tpu.mgr.prometheus import render
+        from ceph_tpu.mgr.stats import StatsAggregator
+        cct = Context()
+        pc = (PerfCountersBuilder("ec_backend.ph.pg1.0")
+              .add_u64_counter("writes", "client writes committed")
+              .add_u64_counter("write_bytes", "client bytes written")
+              .create_perf_counters())
+        cct.perf.add(pc)
+        # fake clock: render() ticks every live aggregator itself, and a
+        # real monotonic sample would stretch the 2s window to hours
+        t = [2.0]
+        agg = StatsAggregator(cct=cct, name="promheat-src",
+                              clock=lambda: t[0])
+        tracker = HeatTracker(
+            agg, lambda: {"1.0": {"primary": 3, "acting": [3, 4, 5]}},
+            name="promheat", tag="ph")
+        try:
+            agg.sample(now=0.0)
+            pc.inc("writes", 20)
+            pc.inc("write_bytes", 4096)
+            agg.sample(now=2.0)
+            text = render(cct)
+            lines = text.splitlines()
+            assert 'ceph_tpu_osd_heat{owner="promheat",osd="3",' \
+                   'stat="op_s"} 10.0' in lines
+            assert 'ceph_tpu_osd_heat{owner="promheat",osd="4",' \
+                   'stat="op_s"} 0.0' in lines
+            assert 'ceph_tpu_pg_heat{owner="promheat",pg="1.0",' \
+                   'stat="bytes_s"} 2048.0' in lines
+            assert lines.count("# TYPE ceph_tpu_osd_heat gauge") == 1
+            assert lines.count("# TYPE ceph_tpu_pg_heat gauge") == 1
+            types = [line.split(" ", 2)[2].split(" ", 1)[0]
+                     for line in lines if line.startswith("# TYPE ")]
+            assert len(types) == len(set(types)), "duplicate TYPE lines"
+        finally:
+            tracker.close()
+            agg.close()
+            cct.perf.remove(pc.name)
+
     def test_device_collection_rendered(self):
         """The device-telemetry gauges land in the exposition via the
         ordinary collection walk (refresh happens at render time)."""

@@ -1,0 +1,94 @@
+"""Guard: every message class on the wire registers wire accounting.
+
+``common/wire_accounting.py`` charges every sent message's bytes to a
+per-type counter and a per-op-class rollup; the byte count for the
+non-framed in-process bus comes from the per-type sizer registry.  A
+message class added to ``backend/messages.py`` or ``net.py`` WITHOUT a
+registered sizer would still be counted (pickle fallback + an
+``unsized_msgs`` bump) but with an estimate nobody reviewed — so this
+guard walks both modules by AST (the ``test_counter_help.py`` pattern:
+discipline as a test), collects every dataclass that can ride the
+PGChannel/RPC wire, and fails unless each one appears in the live sizer
+registry.  No unmetered message types.
+"""
+import ast
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+
+# message-shaped dataclasses that never ride a channel: local config /
+# transport-internal wrappers (the _-prefixed ones are excluded by name)
+NOT_WIRE_MESSAGES = {"FaultConfig"}
+
+MESSAGE_MODULES = ("ceph_tpu/backend/messages.py", "ceph_tpu/net.py")
+
+
+def _dataclass_names(path: Path) -> set[str]:
+    tree = ast.parse(path.read_text(), filename=str(path))
+    names = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        for dec in node.decorator_list:
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            if isinstance(target, ast.Name) and target.id == "dataclass" \
+                    or isinstance(target, ast.Attribute) and \
+                    target.attr == "dataclass":
+                names.add(node.name)
+    return names
+
+
+def test_ast_finds_message_dataclasses():
+    """The guard must be scanning something real (if the message modules
+    move, update MESSAGE_MODULES rather than silently guarding air)."""
+    total = set()
+    for rel in MESSAGE_MODULES:
+        total |= _dataclass_names(ROOT / rel)
+    assert len(total) >= 20, f"only {len(total)} dataclasses found"
+
+
+def test_every_wire_message_registers_a_sizer():
+    # importing the modules runs their register_wire_sizes() blocks
+    import ceph_tpu.backend.messages  # noqa: F401
+    import ceph_tpu.net  # noqa: F401
+    from ceph_tpu.common.wire_accounting import registered_wire_types
+    registered = registered_wire_types()
+    offenders = []
+    for rel in MESSAGE_MODULES:
+        for name in sorted(_dataclass_names(ROOT / rel)):
+            if name.startswith("_") or name in NOT_WIRE_MESSAGES:
+                continue
+            if name not in registered:
+                offenders.append(f"{rel}: {name}")
+    assert not offenders, (
+        "message classes without a wire-accounting sizer (register them "
+        "in register_wire_sizes next to the definition):\n"
+        + "\n".join(offenders))
+
+
+def test_rpc_registry_fully_metered():
+    """Every type in net.py's RPC registry — the set that can actually
+    arrive on a socket — is individually metered."""
+    import ceph_tpu.net as net
+    from ceph_tpu.common.wire_accounting import registered_wire_types
+    missing = sorted(set(net._TYPES) - registered_wire_types())
+    assert not missing, f"unmetered RPC types: {missing}"
+
+
+def test_sizers_measure_payloads():
+    """Spot-check that the registered sizers weigh the payload-bearing
+    fields (a sizer returning a constant would defeat the wire-per-byte
+    metrics this PR exists to produce)."""
+    from ceph_tpu.backend.memstore import GObject, Transaction
+    from ceph_tpu.backend.messages import (ECSubReadReply, ECSubWrite,
+                                           PushOp)
+    from ceph_tpu.common.wire_accounting import wire_size
+    small = PushOp(from_shard=0, oid="o", data=b"x" * 100)
+    big = PushOp(from_shard=0, oid="o", data=b"x" * 10_000)
+    assert wire_size(big) - wire_size(small) == 9_900
+    t = Transaction().write(GObject("o", 1), 0, b"y" * 4096)
+    w = ECSubWrite(from_shard=0, tid=1, t=t)
+    assert wire_size(w) >= 4096
+    r = ECSubReadReply(from_shard=1, tid=1,
+                       buffers_read={"o": [(0, b"z" * 2048)]})
+    assert wire_size(r) >= 2048

@@ -43,6 +43,12 @@ METRIC_PATHS = {
     "serving.p99_ms": (("serving", "batched", "p99_ms"), False),
     "recovery.mib_s": (("recovery", "batched", "mib_s"), True),
     "pipeline.mib_s": (("pipeline", "async", "mib_s"), True),
+    # wire efficiency (ISSUE 7): bytes-on-wire per byte repaired / per
+    # served op — lower is better; a rise past threshold means repair or
+    # serving started moving more network bytes for the same work
+    "recovery.wire_per_byte": (("recovery", "wire", "per_byte_repaired"),
+                               False),
+    "serving.wire_per_op": (("serving", "wire", "per_op"), False),
 }
 
 # fraction of regression tolerated per metric before the gate fails;
@@ -56,6 +62,8 @@ _BLOCK_DEVICE = {
     "serving.p99_ms": ("serving", "device"),
     "recovery.mib_s": ("recovery", "device"),
     "pipeline.mib_s": ("pipeline", "device"),
+    "recovery.wire_per_byte": ("recovery", "device"),
+    "serving.wire_per_op": ("serving", "device"),
 }
 
 
