@@ -61,6 +61,40 @@ WATCHDOG_S = int(os.environ.get("BENCH_WATCHDOG_S", 1200))
 
 _chain_cache: dict = {}
 
+
+class PlatformMismatchError(RuntimeError):
+    """The measured JAX platform is not the one the run requested — the
+    r05 failure mode (a silent CPU fallback recorded as if it were a
+    slower TPU number).  Raised BEFORE the suite runs so the artifact
+    names the abort instead of carrying a different experiment's data."""
+
+
+def requested_platform() -> str | None:
+    """The platform this run was ASKED to measure on: the explicit
+    ``BENCH_EXPECT_PLATFORM`` override, else ``JAX_PLATFORMS`` when it
+    names exactly one platform (a comma list is jax's own documented
+    fallback chain — the operator opted into degradation there)."""
+    expect = os.environ.get("BENCH_EXPECT_PLATFORM", "").strip().lower()
+    if expect:
+        return expect
+    env = os.environ.get("JAX_PLATFORMS", "").strip().lower()
+    if env and "," not in env:
+        return env
+    return None
+
+
+def preflight_platform(measured: str | None) -> None:
+    """Abort the suite with a NAMED error when the measured platform is
+    not the requested one (kills the silent-fallback mode at the source;
+    tools/perf_gate.py still gates it after the fact)."""
+    requested = requested_platform()
+    if requested is not None and measured != requested:
+        raise PlatformMismatchError(
+            f"requested platform {requested!r} but measured "
+            f"{measured or 'none'} — refusing to run the suite on the "
+            f"wrong device (set BENCH_EXPECT_PLATFORM/JAX_PLATFORMS to "
+            f"what you mean, or unset them to accept fallback)")
+
 # Hardware attribution for EVERY emitted line (including watchdog and
 # fallback paths): jax version is readable without importing jax; the
 # platform/device fields fill in from whatever the subprocess probe saw.
@@ -268,6 +302,7 @@ _emitted = False
 _SERVING: dict | None = None     # the serving-engine comparison block
 _RECOVERY: dict | None = None    # the repair-throughput comparison block
 _PIPELINE: dict | None = None    # the async-pipeline comparison block
+_EFFICIENCY: dict | None = None  # the roofline device-efficiency block
 
 
 def _pipeline_pass(sinfo, ec, batches, degraded, depth: int,
@@ -550,6 +585,31 @@ def serving_section(platform: str | None) -> dict:
         return {"device": "none", "error": repr(e)[:200]}
 
 
+def efficiency_section(platform: str | None) -> dict:
+    """The roofline ledger the sections above populated (every
+    traced_jit dispatch recorded its measured seconds next to its
+    XLA-modeled FLOPs/bytes), rendered as the JSON artifact's
+    `efficiency` block: aggregate %-of-peak + the per-executable table
+    tools/roofline_report.py renders.  tools/perf_gate.py gates
+    `efficiency.pct_of_peak` regressions against the BENCH history."""
+    try:
+        from ceph_tpu.common import roofline
+        if platform is None:
+            return {"device": "none",
+                    "error": "no jax backend initialized"}
+        block = roofline.bench_block(platform)
+        if "error" not in block:
+            print(f"# efficiency: {block['pct_of_peak']:.2f}% of "
+                  f"{block['peaks']['source']} peak "
+                  f"({block['bound']}-bound aggregate, "
+                  f"{len(block['executables'])} executables)",
+                  file=sys.stderr)
+        return block
+    except Exception as e:                 # never fail the artifact
+        print(f"# efficiency section failed: {e!r}", file=sys.stderr)
+        return {"device": "none", "error": repr(e)[:200]}
+
+
 def emit(value, vs_baseline, extra):
     """Print the one driver JSON line — at most once per process (the
     watchdog thread and the main path can race to it)."""
@@ -575,6 +635,8 @@ def emit(value, vs_baseline, extra):
         line.setdefault("recovery", _RECOVERY)
     if _PIPELINE is not None:
         line.setdefault("pipeline", _PIPELINE)
+    if _EFFICIENCY is not None:
+        line.setdefault("efficiency", _EFFICIENCY)
     # always carried, even on the watchdog/fallback paths: the per-phase
     # breakdown and the per-attempt probe record accumulated so far.  A
     # phase still OPEN when the watchdog fires is exactly the one that
@@ -756,10 +818,22 @@ def main() -> int:
 
     with phase("probe"):
         platform = probe_backend()
+    # preflight (ISSUE 8): the measured platform must BE the requested
+    # one before any suite runs — a silent fallback aborts loudly here
+    # with a named error in the artifact AND a nonzero exit, instead of
+    # recording a different experiment's numbers (the r05 mode)
+    try:
+        preflight_platform(platform)
+    except PlatformMismatchError as e:
+        print(f"# {e}", file=sys.stderr)
+        emit(cpu_combined, 1.0, {
+            "device": platform or "none", "cpu_kind": cpu_kind,
+            "error": f"PlatformMismatchError: {e}"[:300]})
+        return 1
     # serving comparison (coalesced vs op-at-a-time) on whatever device
     # is up — its own subsystem, measured before the device codec pass so
     # a tunnel death mid-codec still leaves the serving block in the line
-    global _SERVING, _RECOVERY, _PIPELINE
+    global _SERVING, _RECOVERY, _PIPELINE, _EFFICIENCY
     _SERVING = serving_section(platform)
     # repair-throughput comparison (batched waves vs per-object) on the
     # same device — like serving, measured before the codec pass so a
@@ -768,6 +842,9 @@ def main() -> int:
     # codec-pipeline comparison (sync per-batch vs async depth-4, mesh
     # when >1 device) — same placement rationale
     _PIPELINE = pipeline_section(platform)
+    # the roofline efficiency block reads the ledger the sections above
+    # populated — computed here so a codec-pass death still carries it
+    _EFFICIENCY = efficiency_section(platform)
     if platform == "tpu":
         try:
             combined, extra = measure_device(data, k, m, erasures, batch)

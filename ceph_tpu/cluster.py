@@ -252,6 +252,18 @@ class MiniCluster:
         self.ts = TimeSeriesRing(cct=self.cct)
         self.ts.add_source("stats", self.stats.digest_flat)
         self.ts.add_source("heat", self.heat.flat_series)
+        from .common import roofline
+        self.ts.add_source("efficiency", roofline.flat_series)
+        # XLA profiler capture windows (common/profiler_capture.py):
+        # `device profile start|stop|status` plus a rate-limited one-shot
+        # auto-capture on any WARN/ERR health transition.  Durable mode
+        # only (captures need a disk home under <data_dir>/profiles).
+        from .common.profiler_capture import ProfilerCapture
+        self.profiler = ProfilerCapture(
+            cct=self.cct,
+            out_dir=(self.data_dir / "profiles")
+            if self.data_dir is not None else None)
+        self.profiler.register_admin()
         self._register_health_checks()
         # OSD up/down land in the cluster log the moment the bus flips
         # (the mon's "osd.3 down" clog lines)
@@ -273,6 +285,7 @@ class MiniCluster:
         self.flight.add_source("heat", self.heat.dump)
         self.flight.add_source("clusterlog", self.clusterlog.dump)
         self.flight.add_source("timeseries", self.ts.dump)
+        self.flight.add_source("efficiency", roofline.snapshot)
         self.flight.register_admin()
 
     def _heat_topology(self) -> dict:
@@ -302,6 +315,9 @@ class MiniCluster:
                 or prior.get("severity") != sev:
             self.clusterlog.log(sev, msg, channel="health")
         self.flight.dump(reason=f"health-{key}-{info['severity']}")
+        # one bounded profiler capture per anomaly (cooldown-gated inside:
+        # a flapping check must not churn the process-global profiler)
+        self.profiler.auto_capture(reason=f"{key}-{info['severity']}")
 
     def _last_health_line(self, key: str) -> dict | None:
         return next((e for e in reversed(self.clusterlog.dump())
@@ -409,6 +425,13 @@ class MiniCluster:
                      description="one OSD's primary-op load is a "
                                  "sustained multiple of the median "
                                  "(hot-shard workload skew)")
+        from .mgr.health import hbm_pressure_check
+        eng.register("HBM_PRESSURE",
+                     hbm_pressure_check(self.cct),
+                     description="a device's high-water memory mark is "
+                                 "pinned near its capacity (guarded "
+                                 "watermark sampler: silent on backends "
+                                 "without memory stats)")
 
     def enable_serving(self, start: bool = False, **kw):
         """Attach a :class:`~ceph_tpu.exec.ServingEngine` to every EC
@@ -1238,6 +1261,7 @@ class MiniCluster:
         self.heat.close()
         self.clusterlog.close()
         self.flight.close()
+        self.profiler.close()
         self.wire.close()
         for p in self.pools.values():
             for g in p["pgs"].values():

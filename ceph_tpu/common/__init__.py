@@ -15,7 +15,9 @@ from .tracer import (Span, Tracer, default_tracer, trace_span,
 from .optracker import OpTracker, TrackedOp
 from .context import Context, default_context
 from .flight_recorder import FlightRecorder
+from .profiler_capture import ProfilerCapture
 from . import device_telemetry
+from . import roofline
 
 __all__ = [
     "ConfigProxy", "Option", "OPTIONS", "SCHEMA", "parse_size",
@@ -27,5 +29,5 @@ __all__ = [
     "Span", "Tracer", "default_tracer", "trace_span", "trace_instant",
     "jit_dump", "jit_perf_counters",
     "Context", "default_context",
-    "FlightRecorder", "device_telemetry",
+    "FlightRecorder", "ProfilerCapture", "device_telemetry", "roofline",
 ]

@@ -85,6 +85,15 @@ class Context:
             "device occupancy by owner class (client/serving/recovery/"
             "scrub/rebalance) + costliest compiled executables")
 
+        def _device_roofline(limit: str = "20", **kw):
+            from . import roofline
+            return roofline.report(int(limit), cct=self)
+        self.admin_socket.register(
+            "device roofline", _device_roofline,
+            "per-executable roofline ledger: achieved vs peak FLOP/s "
+            "and HBM B/s, arithmetic intensity, memory/compute-bound "
+            "classification")
+
     def dout(self, subsys: str, level: int, message: str) -> None:
         self.log.dout(subsys, level, message)
 

@@ -89,21 +89,57 @@ def device_inventory(initialize: bool = False) -> dict:
 def memory_stats(initialize: bool = False) -> dict[str, dict]:
     """Per-device memory stats where the backend exposes them (the PJRT
     ``memory_stats()`` surface: bytes_in_use, peak_bytes_in_use,
-    bytes_limit on TPU/GPU; CPU backends typically return None)."""
+    bytes_limit on TPU/GPU).  Guarded per device AND per field: a CPU
+    backend may lack the method entirely, return ``None``, or return a
+    non-dict — every shape degrades to that device being absent from the
+    snapshot (partial data, never a raise)."""
     if not initialize and not backend_ready():
         return {}
     out: dict[str, dict] = {}
     try:
         import jax
         for d in jax.devices():
+            st = None
             try:
-                st = d.memory_stats()
+                if hasattr(d, "memory_stats"):
+                    st = d.memory_stats()
             except Exception:
                 st = None
-            if st:
+            if isinstance(st, dict) and st:
                 out[f"{d.platform}:{d.id}"] = dict(st)
     except Exception:
         pass
+    return out
+
+
+# session high-water marks per device: the backend's own
+# peak_bytes_in_use can reset (client restart, stats clear); the module
+# keeps the max ever observed in THIS process so HBM_PRESSURE sees the
+# true watermark even between samples
+_hbm_high_water: dict[str, int] = {}
+
+
+def hbm_watermarks(initialize: bool = False) -> dict[str, dict]:
+    """Per-device HBM watermark sample: bytes in use, backend peak,
+    bytes limit, and the session high-water mark (max observed across
+    samples).  Devices whose backend lacks memory stats (CPU) simply
+    don't appear — the HBM_PRESSURE health check reads this and stays
+    silent on such platforms."""
+    out: dict[str, dict] = {}
+    for dev, st in memory_stats(initialize).items():
+        try:
+            in_use = int(st.get("bytes_in_use", 0) or 0)
+            peak = int(st.get("peak_bytes_in_use", 0) or 0)
+            limit = int(st.get("bytes_limit", 0) or 0)
+        except (TypeError, ValueError):     # backend-specific field shapes
+            continue
+        hw = max(_hbm_high_water.get(dev, 0), peak, in_use)
+        _hbm_high_water[dev] = hw
+        rec = {"bytes_in_use": in_use, "peak_bytes_in_use": peak,
+               "bytes_limit": limit, "high_water_bytes": hw}
+        if limit > 0:
+            rec["high_water_ratio"] = round(hw / limit, 4)
+        out[dev] = rec
     return out
 
 
@@ -147,6 +183,12 @@ def _device_perf(cct):
               .add_u64("mem_peak_bytes_in_use",
                        "backend-reported peak bytes in use, summed over "
                        "devices")
+              .add_u64("mem_bytes_limit",
+                       "backend-reported memory capacity, summed over "
+                       "devices (0 where the backend lacks it)")
+              .add_u64("hbm_high_water_bytes",
+                       "session high-water device-memory mark, summed "
+                       "over devices (feeds HBM_PRESSURE)")
               .add_u64("compile_cache_keys",
                        "distinct (function, shape) keys in the traced_jit "
                        "compile cache")
@@ -161,15 +203,22 @@ def refresh(cct, initialize: bool = False) -> dict:
     ``device dump`` admin command / flight-recorder source)."""
     inv = device_inventory(initialize)
     mem = memory_stats(initialize)
+    marks = hbm_watermarks(initialize)
     live = live_buffer_bytes(initialize)
     cache = compile_cache_stats()
     pc = _device_perf(cct)
     pc.set("num_devices", inv["num_devices"])
     pc.set("live_buffer_bytes", live)
+    # guarded field folds: a backend may report partial stat sets
     pc.set("mem_bytes_in_use",
-           sum(int(s.get("bytes_in_use", 0)) for s in mem.values()))
+           sum(int(s.get("bytes_in_use", 0) or 0) for s in mem.values()))
     pc.set("mem_peak_bytes_in_use",
-           sum(int(s.get("peak_bytes_in_use", 0)) for s in mem.values()))
+           sum(int(s.get("peak_bytes_in_use", 0) or 0)
+               for s in mem.values()))
+    pc.set("mem_bytes_limit",
+           sum(m["bytes_limit"] for m in marks.values()))
+    pc.set("hbm_high_water_bytes",
+           sum(m["high_water_bytes"] for m in marks.values()))
     pc.set("compile_cache_keys", cache["keys"])
-    return {"inventory": inv, "memory": mem, "live_buffer_bytes": live,
-            "compile_cache": cache}
+    return {"inventory": inv, "memory": mem, "watermarks": marks,
+            "live_buffer_bytes": live, "compile_cache": cache}

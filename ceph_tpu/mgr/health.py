@@ -332,6 +332,36 @@ def pg_recovery_stalled_check(stats, scheduler_getter):
     return check
 
 
+def hbm_pressure_check(cct, ratio: float | None = None, sampler=None):
+    """HBM_PRESSURE: a device's session high-water memory mark is pinned
+    near its capacity (``mgr_hbm_pressure_ratio`` of ``bytes_limit``) —
+    the working set is one allocation away from an OOM that would take a
+    serving dispatch down with it.  Reads the guarded watermark sampler
+    (``device_telemetry.hbm_watermarks``): platforms whose backend lacks
+    memory stats (CPU) report nothing and the check stays silent."""
+    def check():
+        r = ratio if ratio is not None else \
+            float(cct.conf.get("mgr_hbm_pressure_ratio"))
+        if sampler is not None:
+            marks = sampler()
+        else:
+            from ..common import device_telemetry
+            marks = device_telemetry.hbm_watermarks()
+        hot: list[str] = []
+        for dev, rec in sorted(marks.items()):
+            limit = rec.get("bytes_limit", 0)
+            hw = rec.get("high_water_bytes", 0)
+            if limit and hw / limit >= r:
+                hot.append(f"{dev}: high-water {hw}/{limit} bytes "
+                           f"({100.0 * hw / limit:.0f}% of capacity)")
+        if hot:
+            return CheckResult(
+                f"{len(hot)} device(s) >= {r:.0%} of memory capacity",
+                detail=hot, count=len(hot))
+        return None
+    return check
+
+
 def recompile_storm_check(cct, stats, threshold: float | None = None):
     """RECOMPILE_STORM: the traced_jit registry is compiling at more
     than ``mgr_recompile_storm_compiles`` per MINUTE over the stats

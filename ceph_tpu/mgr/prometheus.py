@@ -165,6 +165,39 @@ def _device_time_gauges(family, prefix: str) -> None:
         f'{metric}{{class="_busy"}} {round(snap["busy_s"], 6)}')
 
 
+def _device_efficiency_gauges(family, prefix: str, snap: dict | None
+                              ) -> None:
+    """``ceph_tpu_device_efficiency{executable,stat}`` — the roofline
+    ledger's per-executable achieved rates, arithmetic intensity and
+    %-of-peak (common/roofline.py).  ``stat="memory_bound"`` encodes the
+    classification (1 = under the ridge point).  The aggregate view
+    exports through the ordinary ``device_efficiency`` collection walk;
+    this family adds the per-executable breakdown the perf schema cannot
+    hold (open-ended executable set).  ``snap`` is the ONE snapshot
+    ``render()`` took via ``roofline.refresh(cct)`` — sharing it keeps
+    the per-executable rows on the same (config-overridable) peaks as
+    the aggregate gauges in the same scrape."""
+    if not snap or not snap["executables"]:
+        return
+    metric = f"{prefix}_device_efficiency"
+    fam = family(metric, "gauge",
+                 "per-executable roofline efficiency "
+                 "(common/roofline.py)")
+    for eid, rec in sorted(snap["executables"].items()):
+        stats = (("calls", rec["calls"]),
+                 ("seconds", rec["seconds"]),
+                 ("achieved_flops_s", rec["achieved_flops_s"]),
+                 ("achieved_bytes_s", rec["achieved_bytes_s"]),
+                 ("arithmetic_intensity", rec["arithmetic_intensity"]),
+                 ("pct_of_peak", rec["pct_of_peak"]),
+                 ("memory_bound",
+                  1 if rec["bound"] == "memory" else 0))
+        for stat, v in stats:
+            fam.lines.append(
+                f'{metric}{{executable="{_sanitize(eid)}",'
+                f'stat="{stat}"}} {round(float(v), 6)}')
+
+
 def _wire_gauges(family, prefix: str) -> None:
     """``ceph_tpu_wire_bytes`` / ``ceph_tpu_wire_msgs``
     ``{owner,msg_type,dir}`` — per-message-type wire traffic of every
@@ -268,6 +301,15 @@ def render(cct=None, prefix: str = "ceph_tpu") -> str:
         device_telemetry.refresh(cct)
     except Exception:                       # pragma: no cover
         pass
+    # same for the roofline ledger's aggregate device_efficiency gauges;
+    # the returned snapshot also feeds the per-executable family below
+    # (one ledger join per scrape, same peaks for both surfaces)
+    eff_snap = None
+    try:
+        from ..common import roofline
+        eff_snap = roofline.refresh(cct)
+    except Exception:                       # pragma: no cover
+        pass
     families: dict[str, _MetricFamily] = {}
 
     def family(metric: str, kind: str, help_text: str) -> _MetricFamily:
@@ -297,6 +339,7 @@ def render(cct=None, prefix: str = "ceph_tpu") -> str:
     _health_gauges(family, prefix)
     _stats_rate_gauges(family, prefix)
     _device_time_gauges(family, prefix)
+    _device_efficiency_gauges(family, prefix, eff_snap)
     _wire_gauges(family, prefix)
     _heat_gauges(family, prefix)
 

@@ -28,23 +28,39 @@ import time
 import jax
 
 from ..common import device_attribution as _attr
+from ..common import roofline as _roofline
 from ..common import tracer as _tracer
 
 
-def _record_cost_analysis(label: str, compiled) -> None:
+def _record_cost_analysis(label: str, key, compiled, args) -> tuple:
     """Fold the executable's XLA cost model (FLOPs, bytes accessed) into
     the device-attribution ledger — `device top` then shows each kernel's
-    modeled cost next to the measured per-class occupancy.  Best-effort:
-    not every backend/executable implements cost_analysis."""
+    modeled cost next to the measured per-class occupancy — and register
+    the per-call cost with the roofline ledger (common/roofline.py),
+    which joins it against the measured dispatch seconds recorded below.
+    Best-effort: not every backend/executable implements cost_analysis;
+    the roofline entry then falls back to summed input-operand bytes.
+    Returns the ``(flops, bytes, input_bytes)`` tuple the wrapper caches
+    per key and re-sends with every steady-state dispatch."""
+    flops = bytes_accessed = 0.0
     try:
         ca = compiled.cost_analysis()
         if isinstance(ca, (list, tuple)):        # older jax returns [dict]
             ca = ca[0] if ca else {}
         if ca:
-            _attr.record_executable(label, float(ca.get("flops", 0.0)),
-                                    float(ca.get("bytes accessed", 0.0)))
+            flops = float(ca.get("flops", 0.0))
+            bytes_accessed = float(ca.get("bytes accessed", 0.0))
+            _attr.record_executable(label, flops, bytes_accessed)
     except Exception:                            # noqa: BLE001 — telemetry
         pass
+    input_bytes = sum(int(getattr(a, "nbytes", 0) or 0) for a in args)
+    cost = (flops, bytes_accessed, input_bytes)
+    try:
+        _roofline.record_compile(label, key, flops, bytes_accessed,
+                                 input_bytes=input_bytes)
+    except Exception:                            # noqa: BLE001 — telemetry
+        pass
+    return cost
 
 
 def _shape_key(args) -> tuple:
@@ -66,7 +82,19 @@ def traced_jit(fn=None, *, name: str | None = None, **jit_kwargs):
     jfn = jax.jit(fn, **jit_kwargs)
     label = name or getattr(fn, "__name__", repr(fn))
     compiled_cache: dict[tuple, object] = {}
+    cost_cache: dict[tuple, tuple] = {}      # key -> (flops, bytes, in_b)
     lock = threading.Lock()
+
+    def _timed_dispatch(compiled, key, args):
+        """Steady-state dispatch, wall-timed for the roofline ledger (a
+        lower bound of device time on async backends — roofline.py's
+        honesty note; the first dispatch of every key is sync-timed)."""
+        _tracer.record_cache_hit(label, key)
+        t0 = time.perf_counter()
+        out = compiled(*args)
+        _roofline.record_call(label, key, time.perf_counter() - t0,
+                              cost=cost_cache.get(key))
+        return out
 
     @functools.wraps(fn)
     def wrapper(*args, **kwargs):
@@ -77,35 +105,41 @@ def traced_jit(fn=None, *, name: str | None = None, **jit_kwargs):
         key = _shape_key(args)
         compiled = compiled_cache.get(key)
         if compiled is not None:
-            _tracer.record_cache_hit(label, key)
-            return compiled(*args)
+            return _timed_dispatch(compiled, key, args)
         with lock:
             compiled = compiled_cache.get(key)
             if compiled is not None:
-                _tracer.record_cache_hit(label, key)
-                return compiled(*args)
+                return _timed_dispatch(compiled, key, args)
             tr = _tracer.default_tracer()
             try:
                 with tr.span("jit.trace", fn=label) as sp_t:
                     lowered = jfn.lower(*args)
                 with tr.span("jit.compile", fn=label) as sp_c:
                     compiled = lowered.compile()
-                _record_cost_analysis(label, compiled)
+                cost_cache[key] = _record_cost_analysis(
+                    label, key, compiled, args)
                 with tr.span("jit.first_dispatch", fn=label) as sp_d:
                     out = compiled(*args)
                     jax.block_until_ready(out)
                 compiled_cache[key] = compiled
                 _tracer.record_compilation(label, key, sp_t.dur, sp_c.dur,
                                            sp_d.dur)
+                _roofline.record_call(label, key, sp_d.dur, synced=True,
+                                      cost=cost_cache.get(key))
             except Exception:
                 # AOT unsupported for this signature: the jit cache still
-                # compiles exactly once per key; book the first call whole
+                # compiles exactly once per key; book the whole first
+                # call as compile time
                 t0 = time.perf_counter()
                 out = jfn(*args)
                 jax.block_until_ready(out)
+                dur = time.perf_counter() - t0
                 compiled_cache[key] = jfn
-                _tracer.record_compilation(label, key, 0.0,
-                                           time.perf_counter() - t0, 0.0)
+                _tracer.record_compilation(label, key, 0.0, dur, 0.0)
+                cost_cache[key] = _record_cost_analysis(
+                    label, key, None, args)
+                _roofline.record_call(label, key, dur, synced=True,
+                                      cost=cost_cache.get(key))
             return out
 
     wrapper.__wrapped_jit__ = jfn

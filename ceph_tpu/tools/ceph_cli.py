@@ -98,6 +98,7 @@ def main(argv=None) -> int:
                     help="status | -s | health [detail] | "
                          "health mute|unmute KEY | top | daemonperf | "
                          "log last [N] | watch | -w | flight dump | "
+                         "device roofline | device profile status | "
                          "osd tree | osd df | pg dump | df")
     args = ap.parse_args(argv)
 
@@ -152,6 +153,28 @@ def main(argv=None) -> int:
             from ..common.clusterlog import format_entry
             for e in c.clusterlog.last(n):
                 print(format_entry(e))
+        elif cmd == "device roofline":
+            from ..common import roofline
+            print(roofline.render_table(roofline.report(cct=c.cct)))
+        elif args.cmd[:2] == ["device", "profile"]:
+            sub = args.cmd[2] if len(args.cmd) > 2 else "status"
+            if sub != "status":
+                # a profiler window is PROCESS-scoped state: this CLI
+                # reopens the cluster per invocation, so a window opened
+                # here would be force-closed on exit before any work ran,
+                # and a later 'stop' would land in a fresh process that
+                # never saw it.  Only the live process's admin socket can
+                # span start..work..stop.
+                print("error: 'device profile start|stop' needs the LIVE "
+                      "process — call 'device profile start' on its "
+                      "admin socket (in-process or via 'rados serve'); "
+                      "this reopen-per-invocation CLI can only report "
+                      "'device profile status' (on-disk captures)",
+                      file=sys.stderr)
+                return 2
+            import json as _json
+            print(_json.dumps(c.profiler.status(), indent=2,
+                              default=str))
         elif cmd == "flight dump":
             b = c.flight.dump(reason="cli", force=True)
             print(f"captured flight bundle seq={b['seq']} "

@@ -1,0 +1,132 @@
+"""common/optracker.py direct coverage (ISSUE 8 satellite): historic-op
+event timelines, slow-flag promotion, and bounded-history eviction —
+previously exercised only indirectly through the backend dumps."""
+from __future__ import annotations
+
+import time
+
+from ceph_tpu.common.optracker import OpTracker
+from ceph_tpu.common.options import ConfigProxy
+from ceph_tpu.common.perf_counters import PerfCountersBuilder
+
+
+class TestEventTimeline:
+    def test_events_ordered_and_complete(self):
+        tracker = OpTracker()
+        op = tracker.create_request("osd_op(client.1 write)")
+        op.mark_event("queued")
+        op.mark_event("reached_pg")
+        op.mark_event("commit_sent")
+        op.finish()
+        dump = tracker.dump_historic_ops()
+        assert dump["num_ops"] == 1
+        events = [e["event"] for e in dump["ops"][0]["type_data"]["events"]]
+        # the tracker brackets the caller's marks: initiated first,
+        # done last, caller events in call order between them
+        assert events == ["initiated", "queued", "reached_pg",
+                          "commit_sent", "done"]
+        times = [e["time"] for e in dump["ops"][0]["type_data"]["events"]]
+        assert times == sorted(times)
+        assert dump["ops"][0]["duration"] >= 0
+
+    def test_inflight_moves_to_history_on_finish(self):
+        tracker = OpTracker()
+        op = tracker.create_request("op")
+        assert tracker.dump_ops_in_flight()["num_ops"] == 1
+        assert tracker.dump_historic_ops()["num_ops"] == 0
+        op.finish()
+        assert tracker.dump_ops_in_flight()["num_ops"] == 0
+        assert tracker.dump_historic_ops()["num_ops"] == 1
+
+    def test_context_manager_finishes_and_double_finish_is_idempotent(self):
+        tracker = OpTracker()
+        with tracker.create_request("ctx op") as op:
+            op.mark_event("working")
+        assert tracker.dump_historic_ops()["num_ops"] == 1
+        op.finish()                      # second finish must not re-file
+        assert tracker.dump_historic_ops()["num_ops"] == 1
+        events = [e["event"] for e in tracker.dump_historic_ops()
+                  ["ops"][0]["type_data"]["events"]]
+        assert events.count("done") == 1
+
+    def test_age_histogram_buckets(self):
+        tracker = OpTracker()
+        op = tracker.create_request("aging")
+        op.initiated_at = time.time() - 15.0     # lands in the <60s bucket
+        tracker.create_request("fresh")
+        hist = tracker.get_age_histogram()
+        assert hist == {"<60s": 1, "<1s": 1}
+
+
+class TestSlowFlagPromotion:
+    def _perf(self):
+        return (PerfCountersBuilder("optracker_test")
+                .add_u64_counter("slow_ops", "ops over the complaint time")
+                .create_perf_counters())
+
+    def test_slow_op_flagged_counted_and_kept(self):
+        perf = self._perf()
+        tracker = OpTracker(complaint_time=0.0, perf=perf)
+        op = tracker.create_request("slow write")
+        op.finish()                              # 0.0 threshold: always slow
+        assert op.slow
+        assert perf.get("slow_ops") == 1
+        slow = tracker.dump_historic_slow_ops()
+        assert slow["num_ops"] == 1 and slow["ops"][0]["slow"]
+        # the regular history carries the flag too
+        assert tracker.dump_historic_ops()["ops"][0]["slow"]
+
+    def test_fast_op_not_promoted(self):
+        perf = self._perf()
+        tracker = OpTracker(complaint_time=30.0, perf=perf)
+        tracker.create_request("fast").finish()
+        assert perf.get("slow_ops") == 0
+        assert tracker.dump_historic_slow_ops()["num_ops"] == 0
+        assert not tracker.dump_historic_ops()["ops"][0]["slow"]
+
+    def test_complaint_time_live_updates_via_conf_observer(self):
+        conf = ConfigProxy({"osd_op_complaint_time": 30.0})
+        tracker = OpTracker(conf=conf)
+        assert tracker.complaint_time == 30.0
+        tracker.create_request("before").finish()
+        conf.set("osd_op_complaint_time", 0.0)
+        assert tracker.complaint_time == 0.0
+        tracker.create_request("after").finish()
+        slow = [o["description"] for o in
+                tracker.dump_historic_slow_ops()["ops"]]
+        assert slow == ["after"]
+
+    def test_missing_slow_ops_counter_is_tolerated(self):
+        perf = (PerfCountersBuilder("no_slow_key")
+                .add_u64_counter("other", "unrelated")
+                .create_perf_counters())
+        tracker = OpTracker(complaint_time=0.0, perf=perf)
+        tracker.create_request("slow anyway").finish()   # must not raise
+        assert tracker.dump_historic_slow_ops()["num_ops"] == 1
+
+
+class TestBoundedHistory:
+    def test_history_evicts_oldest_past_capacity(self):
+        tracker = OpTracker(history_size=3)
+        for i in range(5):
+            tracker.create_request(f"op{i}").finish()
+        dump = tracker.dump_historic_ops()
+        assert dump["num_ops"] == 3
+        assert [o["description"] for o in dump["ops"]] == \
+            ["op2", "op3", "op4"]
+
+    def test_slow_ring_bounded_independently(self):
+        tracker = OpTracker(history_size=2, complaint_time=0.0)
+        for i in range(4):
+            tracker.create_request(f"s{i}").finish()
+        slow = tracker.dump_historic_slow_ops()
+        assert slow["num_ops"] == 2
+        assert [o["description"] for o in slow["ops"]] == ["s2", "s3"]
+
+    def test_eviction_leaves_inflight_registry_clean(self):
+        tracker = OpTracker(history_size=1)
+        ops = [tracker.create_request(f"o{i}") for i in range(3)]
+        for op in ops:
+            op.finish()
+        assert tracker.dump_ops_in_flight() == {"ops": [], "num_ops": 0}
+        assert tracker.dump_historic_ops()["num_ops"] == 1

@@ -14,7 +14,8 @@ spec.loader.exec_module(perf_gate)
 
 
 def _line(value=1000.0, device="tpu", serving=500.0, recovery=80.0,
-          pipeline=120.0, p99=2.0, wire_per_byte=6.0, wire_per_op=9000.0):
+          pipeline=120.0, p99=2.0, wire_per_byte=6.0, wire_per_op=9000.0,
+          pct_of_peak=42.0):
     return {
         "metric": "rs_k8m4_1MiB_encode_decode_device_resident",
         "value": value, "unit": "MiB/s", "device": device,
@@ -24,6 +25,7 @@ def _line(value=1000.0, device="tpu", serving=500.0, recovery=80.0,
         "recovery": {"device": device, "batched": {"mib_s": recovery},
                      "wire": {"per_byte_repaired": wire_per_byte}},
         "pipeline": {"device": device, "async": {"mib_s": pipeline}},
+        "efficiency": {"device": device, "pct_of_peak": pct_of_peak},
     }
 
 
@@ -32,7 +34,7 @@ class TestEvaluate:
         res = perf_gate.evaluate(_line(value=980.0), _line(),
                                  expect_platform="tpu")
         assert res["ok"] and res["verdict"].startswith("PERF GATE: PASS")
-        assert len(res["compared"]) == 7
+        assert len(res["compared"]) == 8
 
     def test_twenty_percent_regression_fails(self):
         res = perf_gate.evaluate(_line(value=800.0), _line(value=1000.0))
@@ -61,6 +63,36 @@ class TestEvaluate:
                                        wire_per_op=5000.0), _line())
         assert res["ok"]
 
+    def test_pct_of_peak_regression_fails_loose_threshold(self):
+        """The ISSUE-8 acceptance pin: a synthetic %-of-peak cliff flips
+        the verdict to FAIL.  The metric carries a LOOSE default
+        threshold (30%: dispatch wall-clock on a shared host is noisy),
+        so a 50% drop fails while ordinary jitter passes."""
+        res = perf_gate.evaluate(_line(pct_of_peak=20.0),
+                                 _line(pct_of_peak=42.0))
+        assert not res["ok"]
+        assert any("efficiency.pct_of_peak" in f for f in res["failures"])
+        # 20% down is inside the loose threshold: jitter, not a cliff
+        res = perf_gate.evaluate(_line(pct_of_peak=34.0),
+                                 _line(pct_of_peak=42.0))
+        assert res["ok"]
+        # an explicit --threshold still tightens it
+        res = perf_gate.evaluate(
+            _line(pct_of_peak=34.0), _line(pct_of_peak=42.0),
+            thresholds={"efficiency.pct_of_peak": 0.10})
+        assert not res["ok"]
+
+    def test_efficiency_platform_fallback_not_compared(self):
+        # a cpu efficiency block never diffs against a tpu reference —
+        # and the fallback itself already hard-fails the gate
+        res = perf_gate.evaluate(_line(device="cpu", pct_of_peak=90.0),
+                                 _line(device="tpu", pct_of_peak=42.0),
+                                 expect_platform="tpu")
+        assert not res["ok"]
+        assert not any("efficiency.pct_of_peak" in c["metric"]
+                       for c in res["compared"])
+        assert any("platform fallback" in f for f in res["failures"])
+
     def test_latency_regression_direction_is_up(self):
         res = perf_gate.evaluate(_line(p99=3.0), _line(p99=2.0))
         assert any("serving.p99_ms" in f for f in res["failures"])
@@ -85,7 +117,7 @@ class TestEvaluate:
         res = perf_gate.evaluate(_line(device="cpu"),
                                  _line(device="cpu"),
                                  expect_platform="cpu")
-        assert res["ok"] and len(res["compared"]) == 7
+        assert res["ok"] and len(res["compared"]) == 8
 
     def test_custom_threshold(self):
         ref, new = _line(value=1000.0), _line(value=900.0)

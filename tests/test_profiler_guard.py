@@ -1,0 +1,114 @@
+"""Guard: every ``jax.profiler`` use lives in common/profiler_capture.py.
+
+Profiling is process-global and expensive: a stray ``start_trace`` in a
+hot path (or a helper that "just profiles this one section") would tax
+every dispatch and fight the managed capture windows for the single
+process-wide profiler session.  This guard keeps the whole surface —
+``import jax.profiler``, ``from jax import profiler``, attribute access
+``jax.profiler``, and direct ``start_trace``/``stop_trace`` calls —
+inside the one module built to bound it (the ``test_no_host_sync.py``
+AST pattern, so comments and docstrings may mention the names).
+"""
+import ast
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+# the whole production tree: package, tools, and the bench driver
+SCAN = ("ceph_tpu", "tools", "bench.py")
+
+# path -> why the profiler touch is legitimate there
+ALLOWLIST = {
+    "ceph_tpu/common/profiler_capture.py":
+        "IS the capture-window manager (the only sanctioned owner of "
+        "the process-global profiler session)",
+}
+
+_FORBIDDEN_CALLS = {"start_trace", "stop_trace"}
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self):
+        self.offenders: list[tuple[int, str]] = []
+
+    def visit_Import(self, node: ast.Import) -> None:
+        for alias in node.names:
+            if alias.name == "jax.profiler" or \
+                    alias.name.startswith("jax.profiler."):
+                self.offenders.append(
+                    (node.lineno, f"import {alias.name}"))
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        mod = node.module or ""
+        if mod == "jax.profiler" or mod.startswith("jax.profiler."):
+            self.offenders.append(
+                (node.lineno, f"from {mod} import ..."))
+        elif mod == "jax" and any(a.name == "profiler"
+                                  for a in node.names):
+            self.offenders.append(
+                (node.lineno, "from jax import profiler"))
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if node.attr == "profiler" and \
+                isinstance(node.value, ast.Name) and \
+                node.value.id == "jax":
+            self.offenders.append((node.lineno, "jax.profiler"))
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        name = fn.attr if isinstance(fn, ast.Attribute) else \
+            fn.id if isinstance(fn, ast.Name) else None
+        if name in _FORBIDDEN_CALLS:
+            self.offenders.append((node.lineno, f"{name}(...)"))
+        self.generic_visit(node)
+
+
+def _scan_paths():
+    for entry in SCAN:
+        p = ROOT / entry
+        if p.is_file():
+            yield p
+        else:
+            yield from sorted(p.rglob("*.py"))
+
+
+def test_profiler_use_confined_to_capture_module():
+    offenders = []
+    for path in _scan_paths():
+        rel = path.relative_to(ROOT).as_posix()
+        if rel in ALLOWLIST:
+            continue
+        v = _Visitor()
+        v.visit(ast.parse(path.read_text(), filename=rel))
+        offenders.extend(f"{rel}:{lineno}: {what}"
+                         for lineno, what in v.offenders)
+    assert not offenders, (
+        "jax.profiler touches outside common/profiler_capture.py — "
+        "route captures through ProfilerCapture's managed windows (or "
+        "extend the allowlist with a justification):\n"
+        + "\n".join(offenders))
+
+
+def test_allowlist_entries_still_exist():
+    for rel in ALLOWLIST:
+        assert (ROOT / rel).exists(), f"stale allowlist entry: {rel}"
+
+
+def test_guard_catches_a_violation():
+    bad = ("import jax.profiler\n"
+           "from jax import profiler\n"
+           "from jax.profiler import start_trace\n"
+           "def f():\n"
+           "    jax.profiler.start_trace('/tmp/x')\n"
+           "    profiler.stop_trace()\n")
+    v = _Visitor()
+    v.visit(ast.parse(bad))
+    kinds = {what for _ln, what in v.offenders}
+    assert "import jax.profiler" in kinds
+    assert "from jax import profiler" in kinds
+    assert "from jax.profiler import ..." in kinds
+    assert "jax.profiler" in kinds
+    assert "start_trace(...)" in kinds
+    assert "stop_trace(...)" in kinds

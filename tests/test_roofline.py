@@ -1,0 +1,528 @@
+"""Device-efficiency observability (ISSUE 8): the roofline ledger,
+profiler capture windows, HBM watermarks, and the bench preflight.
+
+Covers the acceptance spine: the per-executable ledger joins
+``cost_analysis()`` FLOPs/bytes with measured dispatch seconds into
+nonzero achieved-B/s and a bound classification for the k=8,m=4 encode
+executable; auto-capture produces exactly one bounded profiler artifact
+on an injected WARN transition; the bench preflight aborts with a named
+error on platform mismatch.
+"""
+from __future__ import annotations
+
+import importlib.util
+import json
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from ceph_tpu.common import Context, roofline
+from ceph_tpu.common.profiler_capture import ProfilerCapture
+
+_REPO = Path(__file__).resolve().parent.parent
+
+
+def _load_tool(name: str):
+    path = _REPO / "tools" / f"{name}.py"
+    spec = importlib.util.spec_from_file_location(f"{name}_t", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(autouse=True)
+def _fresh_ledger():
+    roofline.reset()
+    yield
+    roofline.reset()
+
+
+class FakeProfiler:
+    """jax.profiler stand-in: the AST guard keeps the real one out of
+    tests; ProfilerCapture's dependency injection keeps them fast."""
+
+    def __init__(self, fail_start=False):
+        self.calls: list[tuple] = []
+        self.fail_start = fail_start
+
+    def start_trace(self, path):
+        if self.fail_start:
+            raise RuntimeError("profiler backend down")
+        self.calls.append(("start", path))
+
+    def stop_trace(self):
+        self.calls.append(("stop",))
+
+
+class TestPeaks:
+    def test_registry_matches_device_kind(self):
+        p = roofline.lookup_peaks(device_kind="TPU v5e", platform="tpu")
+        assert p["hbm_bytes_s"] == 819e9
+        assert p["source"] == "registry:v5e"
+        assert p["ridge_flops_per_byte"] == pytest.approx(197e12 / 819e9)
+
+    def test_unknown_tpu_defaults_to_baseline_hardware(self):
+        p = roofline.lookup_peaks(device_kind="TPU v99", platform="tpu")
+        assert p["source"] == "default-tpu(v5e)"
+        assert p["hbm_bytes_s"] == 819e9
+
+    def test_cpu_falls_back_to_nominal(self):
+        p = roofline.lookup_peaks(device_kind="cpu", platform="cpu")
+        assert p["source"].startswith("nominal-cpu")
+        assert p["flops"] > 0 and p["hbm_bytes_s"] > 0
+
+    def test_config_overrides_win(self):
+        cct = Context()
+        cct.conf.set("device_peak_flops", 1e12)
+        cct.conf.set("device_peak_hbm_bytes_per_sec", int(2e11))
+        p = roofline.lookup_peaks(cct, device_kind="cpu", platform="cpu")
+        assert p["flops"] == 1e12 and p["hbm_bytes_s"] == 2e11
+        assert p["source"] == "config"
+        assert p["ridge_flops_per_byte"] == pytest.approx(5.0)
+
+
+class TestLedger:
+    def test_join_and_classification(self):
+        key = (((4, 8), "uint8"), ((8, 1024), "uint8"))
+        # memory-bound synthetic: AI 0.5 well under any ridge
+        roofline.record_compile("enc", key, flops_per_call=512.0,
+                                bytes_per_call=1024.0)
+        roofline.record_call("enc", key, 0.001, synced=True)
+        roofline.record_call("enc", key, 0.001)
+        snap = roofline.snapshot()
+        eid = "enc[4x8:uint8,8x1024:uint8]"
+        rec = snap["executables"][eid]
+        assert rec["calls"] == 2 and rec["synced_calls"] == 1
+        assert rec["flops"] == 1024.0 and rec["bytes"] == 2048.0
+        assert rec["achieved_bytes_s"] == pytest.approx(2048.0 / 0.002)
+        assert rec["arithmetic_intensity"] == pytest.approx(0.5)
+        assert rec["bound"] == "memory"
+        peak_b = snap["peaks"]["hbm_bytes_s"]
+        assert rec["pct_of_peak"] == pytest.approx(
+            100.0 * (2048.0 / 0.002) / peak_b, rel=0.05)
+
+    def test_compute_bound_uses_flops_peak(self):
+        key = (((8, 8), "uint8"),)
+        # AI 1e6: over any ridge point
+        roofline.record_compile("mm", key, flops_per_call=1e9,
+                                bytes_per_call=1e3)
+        roofline.record_call("mm", key, 0.01, synced=True)
+        snap = roofline.snapshot()
+        rec = snap["executables"]["mm[8x8:uint8]"]
+        assert rec["bound"] == "compute"
+        assert rec["pct_of_peak"] == pytest.approx(
+            100.0 * (1e9 / 0.01) / snap["peaks"]["flops"], rel=1e-3)
+
+    def test_input_bytes_fallback_when_cost_model_is_empty(self):
+        key = (((2, 2), "uint8"),)
+        roofline.record_compile("nf", key, 0.0, 0.0, input_bytes=4096)
+        roofline.record_call("nf", key, 0.001)
+        rec = roofline.snapshot()["executables"]["nf[2x2:uint8]"]
+        assert rec["modeled_source"] == "input_shapes"
+        assert rec["bytes"] == 4096.0
+        assert rec["achieved_bytes_s"] > 0
+
+    def test_async_undercount_extrapolates_from_synced_samples(self):
+        """An async backend returns from dispatch before the device
+        finishes: the unsynced wall samples under-count and would show
+        an impossible >100% of peak.  The estimator detects the gap via
+        the synced samples (first dispatches) and extrapolates their
+        per-call mean instead."""
+        key = (((4, 8), "uint8"),)
+        roofline.record_compile("async_enc", key, flops_per_call=1e6,
+                                bytes_per_call=1e6)
+        roofline.record_call("async_enc", key, 0.010, synced=True)
+        for _ in range(9):
+            roofline.record_call("async_enc", key, 0.0001)  # early return
+        rec = roofline.snapshot()["executables"]["async_enc[4x8:uint8]"]
+        assert rec["estimator"] == "synced-extrapolated"
+        assert rec["est_seconds"] == pytest.approx(0.010 * 10)
+        assert rec["achieved_bytes_s"] == pytest.approx(1e7 / 0.1,
+                                                        rel=0.01)
+        # a sample set whose synced mean matches stays on the raw clock
+        roofline.record_compile("sync_enc", key, 1e6, 1e6)
+        roofline.record_call("sync_enc", key, 0.010, synced=True)
+        roofline.record_call("sync_enc", key, 0.009)
+        rec = roofline.snapshot()["executables"]["sync_enc[4x8:uint8]"]
+        assert rec["estimator"] == "measured"
+        assert rec["est_seconds"] == pytest.approx(0.019)
+
+    def test_call_without_compile_record_is_dropped(self):
+        roofline.record_call("ghost", ("k",), 0.001)
+        assert roofline.snapshot()["executables"] == {}
+
+    def test_reset_and_totals(self):
+        key = (((2, 2), "uint8"),)
+        roofline.record_compile("a", key, 10.0, 100.0)
+        roofline.record_call("a", key, 0.001)
+        snap = roofline.snapshot()
+        assert snap["totals"]["calls"] == 1
+        assert snap["totals"]["achieved_bytes_s"] > 0
+        roofline.reset()
+        assert roofline.snapshot()["totals"]["calls"] == 0
+
+    def test_flat_series_shape(self):
+        key = (((2, 2), "uint8"),)
+        roofline.record_compile("a", key, 10.0, 100.0)
+        roofline.record_call("a", key, 0.001)
+        s = roofline.flat_series()
+        assert set(s) == {"achieved_flops_s", "achieved_bytes_s",
+                          "pct_of_peak", "executables", "device_busy_s"}
+        assert s["executables"] == 1.0
+
+
+class TestTracedJitFeedsLedger:
+    """The real join on jax-cpu: the k=8,m=4 encode executable lands in
+    the ledger with nonzero achieved-B/s and a bound classification
+    (the ISSUE-8 acceptance row, minus the full bench run)."""
+
+    def test_encode_executable_measured(self):
+        from ceph_tpu.ops.codec import RSCodec
+        codec = RSCodec(8, 4, technique="reed_sol_van", device="jax")
+        data = np.random.default_rng(0).integers(
+            0, 256, (8, 4096), np.uint8)
+        for _ in range(3):
+            codec.encode(data)
+        snap = roofline.snapshot()
+        enc = [rec for eid, rec in snap["executables"].items()
+               if "4x8" in eid]             # the [m=4, k=8] parity matrix
+        assert enc, f"no k=8,m=4 encode executable: "\
+                    f"{list(snap['executables'])}"
+        rec = enc[0]
+        assert rec["calls"] >= 3
+        assert rec["achieved_bytes_s"] > 0
+        assert rec["bound"] in ("memory", "compute")
+        # a fresh compile sync-times its first dispatch; when an earlier
+        # test already compiled this shape, the re-seeded record is all
+        # cache hits — either way the clock in use is named
+        assert rec["estimator"] in ("measured", "synced-extrapolated")
+        assert rec["seconds"] > 0
+
+    def test_admin_command_and_render(self):
+        from ceph_tpu.common import default_context
+        from ceph_tpu.ops.codec import RSCodec
+        codec = RSCodec(4, 2, device="jax")
+        data = np.random.default_rng(1).integers(
+            0, 256, (4, 2048), np.uint8)
+        codec.encode(data)
+        top = default_context().admin_socket.call("device roofline")
+        assert top["executables"] and "peaks" in top
+        text = roofline.render_table(top)
+        assert "BOUND" in text and "gf_apply" in text
+
+    def test_prometheus_family(self):
+        from ceph_tpu.mgr.prometheus import render
+        from ceph_tpu.ops.codec import RSCodec
+        codec = RSCodec(4, 2, device="jax")
+        data = np.random.default_rng(2).integers(
+            0, 256, (4, 2048), np.uint8)
+        codec.encode(data)
+        text = render(Context())
+        lines = text.splitlines()
+        assert lines.count(
+            "# TYPE ceph_tpu_device_efficiency gauge") == 1
+        eff = [line for line in lines
+               if line.startswith("ceph_tpu_device_efficiency{")]
+        assert any('stat="achieved_bytes_s"' in line for line in eff)
+        assert any('stat="pct_of_peak"' in line for line in eff)
+        assert any('stat="memory_bound"' in line for line in eff)
+        assert all('executable="' in line for line in eff)
+        # the aggregate rides the ordinary collection walk
+        assert any("ceph_tpu_pct_of_peak_x100{" in line
+                   for line in lines)
+
+
+    def test_prometheus_family_honours_peak_overrides(self):
+        """The per-executable family must use the SAME (config-
+        overridable) peaks as the aggregate gauges in one scrape —
+        render shares one refresh(cct) snapshot across both."""
+        from ceph_tpu.mgr.prometheus import render
+        key = (((4, 8), "uint8"),)
+        roofline.record_compile("ov", key, flops_per_call=10.0,
+                                bytes_per_call=1e6)      # memory-bound
+        roofline.record_call("ov", key, 0.001, synced=True)  # 1e9 B/s
+        cct = Context()
+        cct.conf.set("device_peak_hbm_bytes_per_sec", int(2e9))
+        text = render(cct)
+        line = next(l for l in text.splitlines()
+                    if 'executable="ov_4x8_uint8_"' in l
+                    and 'stat="pct_of_peak"' in l)
+        assert line.endswith(" 50.0")     # 1e9 / 2e9 of the OVERRIDE
+        # and the aggregate collection gauge agrees
+        assert "ceph_tpu_pct_of_peak_x100{" \
+               'collection="device_efficiency"} 5000' in text
+
+
+class TestRooflineReportTool:
+    def test_renders_bench_artifact(self, tmp_path, capsys):
+        from ceph_tpu.ops.codec import RSCodec
+        codec = RSCodec(8, 4, device="jax")
+        data = np.random.default_rng(3).integers(
+            0, 256, (8, 4096), np.uint8)
+        for _ in range(2):
+            codec.encode(data)
+        block = roofline.bench_block("cpu")
+        art = tmp_path / "art.json"
+        art.write_text(json.dumps(
+            {"metric": "m", "value": 1.0, "efficiency": block}))
+        tool = _load_tool("roofline_report")
+        assert tool.main([str(art)]) == 0
+        out = capsys.readouterr().out
+        row = next(line for line in out.splitlines() if "4x8" in line)
+        # nonzero achieved GB/s + a bound classification on the row
+        assert row.split()[-1] in ("memory", "compute")
+        assert float(row.split()[-4]) > 0            # GB/S column
+        assert tool.main([str(art), "--json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["executables"]
+
+    def test_renders_flight_bundle_and_snapshot(self, tmp_path, capsys):
+        key = (((4, 8), "uint8"),)
+        roofline.record_compile("enc", key, 100.0, 1000.0)
+        roofline.record_call("enc", key, 0.001)
+        tool = _load_tool("roofline_report")
+        bundle = tmp_path / "flight.json"
+        bundle.write_text(json.dumps(
+            {"seq": 1, "efficiency": roofline.snapshot()}))
+        assert tool.main([str(bundle)]) == 0
+        assert "enc[4x8:uint8]" in capsys.readouterr().out
+        raw = tmp_path / "snap.json"
+        raw.write_text(json.dumps(roofline.snapshot()))
+        assert tool.main([str(raw)]) == 0
+
+    def test_rejects_artifact_without_efficiency(self, tmp_path):
+        art = tmp_path / "bare.json"
+        art.write_text(json.dumps({"metric": "m", "value": 1.0}))
+        tool = _load_tool("roofline_report")
+        assert tool.main([str(art)]) == 2
+
+
+class TestProfilerCapture:
+    def test_window_start_stop_writes_bounded_artifacts(self, tmp_path):
+        fp = FakeProfiler()
+        pc = ProfilerCapture(cct=Context(), out_dir=tmp_path,
+                             max_captures=2, profiler=fp)
+        for i in range(3):
+            assert "error" not in pc.start(f"w{i}")
+            res = pc.stop()
+            assert res["duration_s"] >= 0
+            meta = json.loads(
+                (Path(res["path"]) / "capture.json").read_text())
+            assert meta["reason"] == f"w{i}"
+        # bounded: only the newest two survive
+        assert len(pc.captures()) == 2
+        assert fp.calls.count(("stop",)) == 3
+
+    def test_double_start_and_bare_stop_refused(self, tmp_path):
+        pc = ProfilerCapture(cct=Context(), out_dir=tmp_path,
+                             profiler=FakeProfiler())
+        assert "error" in pc.stop()
+        assert "error" not in pc.start("a")
+        assert "error" in pc.start("b")        # process-global window
+        pc.stop()
+
+    def test_no_out_dir_disables(self):
+        pc = ProfilerCapture(cct=Context(), out_dir=None,
+                             profiler=FakeProfiler())
+        assert "error" in pc.start("x")
+        assert pc.auto_capture("WARN") is None
+
+    def test_auto_capture_one_shot_rate_limited(self, tmp_path):
+        pc = ProfilerCapture(cct=Context(), out_dir=tmp_path,
+                             cooldown_s=300.0, auto_window_s=0.0,
+                             profiler=FakeProfiler())
+        first = pc.auto_capture("SLOW_OPS")
+        assert first is not None and "stopped" in first
+        # exactly one artifact; the second transition is inside the
+        # cooldown and must not capture
+        assert pc.auto_capture("SLOW_OPS") is None
+        assert len(pc.captures()) == 1
+        assert pc.auto_captures == 1 and pc.auto_skipped == 1
+
+    def test_timed_auto_window_stops_itself(self, tmp_path):
+        import time as _time
+        fp = FakeProfiler()
+        pc = ProfilerCapture(cct=Context(), out_dir=tmp_path,
+                             auto_window_s=0.05, profiler=fp)
+        info = pc.auto_capture("SLOW_OPS")
+        assert info is not None and "stopped" not in info   # still open
+        deadline = _time.time() + 2.0
+        while pc.status()["active"] is not None and _time.time() < deadline:
+            _time.sleep(0.01)
+        assert pc.status()["active"] is None
+        assert fp.calls.count(("stop",)) == 1
+        assert len(pc.captures()) == 1
+
+    def test_manual_stop_cancels_pending_auto_timer(self, tmp_path):
+        """A stale auto-stop timer must not fire into a LATER window the
+        operator opened (the auto window was already closed by hand)."""
+        import time as _time
+        fp = FakeProfiler()
+        pc = ProfilerCapture(cct=Context(), out_dir=tmp_path,
+                             auto_window_s=0.05, cooldown_s=0.0,
+                             profiler=fp)
+        assert pc.auto_capture("X") is not None
+        pc.stop()                                  # close the auto window
+        assert "error" not in pc.start("operator")
+        _time.sleep(0.15)                          # past the auto window
+        assert pc.status()["active"] is not None, \
+            "stale auto timer killed the operator's window"
+        pc.stop()
+
+    def test_auto_capture_survives_profiler_failure(self, tmp_path):
+        pc = ProfilerCapture(cct=Context(), out_dir=tmp_path,
+                             profiler=FakeProfiler(fail_start=True))
+        assert pc.auto_capture("X") is None
+        assert pc.captures() == []
+        # the global window latch must be released after the failure
+        pc2 = ProfilerCapture(cct=Context(), out_dir=tmp_path,
+                              profiler=FakeProfiler())
+        assert "error" not in pc2.start("ok")
+        pc2.stop()
+
+    def test_admin_commands(self, tmp_path):
+        cct = Context()
+        pc = ProfilerCapture(cct=cct, out_dir=tmp_path,
+                             profiler=FakeProfiler())
+        pc.register_admin()
+        try:
+            assert "error" not in cct.admin_socket.call(
+                "device profile start")
+            st = cct.admin_socket.call("device profile status")
+            assert st["active"] is not None
+            res = cct.admin_socket.call("device profile stop")
+            assert "path" in res
+        finally:
+            pc.close()
+        assert cct.admin_socket.get("device profile start") is None
+
+
+class TestClusterIntegration:
+    def test_injected_warn_produces_exactly_one_capture(self, tmp_path):
+        from ceph_tpu.cluster import MiniCluster
+        from ceph_tpu.mgr.health import CheckResult
+        c = MiniCluster(n_osds=4, osds_per_host=2, chunk_size=1024,
+                        cct=Context(), data_dir=tmp_path)
+        try:
+            c.profiler._profiler = FakeProfiler()
+            c.health_engine.register("TEST_WARN",
+                                     lambda: CheckResult("injected"))
+            c.health()
+            assert len(c.profiler.captures()) == 1
+            # a second, different transition within the cooldown: the
+            # flight recorder still dumps, the profiler does not churn
+            c.health_engine.register("TEST_WARN2",
+                                     lambda: CheckResult("injected2"))
+            c.health()
+            assert len(c.profiler.captures()) == 1
+            # the capture landed under <data_dir>/profiles
+            assert (tmp_path / "profiles").is_dir()
+        finally:
+            c.shutdown()
+
+    def test_efficiency_rides_ts_ring_and_flight_bundle(self, tmp_path):
+        from ceph_tpu.cluster import MiniCluster
+        key = (((4, 8), "uint8"),)
+        roofline.record_compile("enc", key, 100.0, 1000.0)
+        roofline.record_call("enc", key, 0.001)
+        c = MiniCluster(n_osds=4, osds_per_host=2, chunk_size=1024,
+                        cct=Context(), data_dir=tmp_path)
+        try:
+            c.ts.record(force=True)
+            assert "efficiency.achieved_bytes_s" in c.ts.series_names()
+            b = c.flight.dump(reason="test", force=True)
+            assert b["efficiency"]["executables"]
+            assert "HBM_PRESSURE" in c.health_engine.registered()
+        finally:
+            c.shutdown()
+
+
+class TestHbmWatermarks:
+    def test_hbm_pressure_check_fires_on_high_water(self):
+        from ceph_tpu.mgr.health import hbm_pressure_check
+        cct = Context()
+        marks = {}
+        check = hbm_pressure_check(cct, sampler=lambda: marks)
+        assert check() is None                    # no devices: silent
+        marks["tpu:0"] = {"bytes_in_use": 10, "peak_bytes_in_use": 95,
+                          "bytes_limit": 100, "high_water_bytes": 95}
+        res = check()
+        assert res is not None and res.count == 1
+        assert "95/100" in res.detail[0]
+        marks["tpu:0"]["high_water_bytes"] = 10   # below the ratio
+        assert check() is None
+
+    def test_watermarks_guarded_on_cpu(self):
+        """jax-cpu lacks memory_stats: the sampler returns partial (or
+        empty) data and refresh() still succeeds — the satellite-2
+        contract that telemetry never raises on a bare platform."""
+        from ceph_tpu.common import device_telemetry
+        marks = device_telemetry.hbm_watermarks()
+        assert isinstance(marks, dict)
+        for rec in marks.values():
+            assert {"bytes_in_use", "peak_bytes_in_use", "bytes_limit",
+                    "high_water_bytes"} <= set(rec)
+        snap = device_telemetry.refresh(Context())
+        assert "watermarks" in snap
+
+    def test_high_water_retained_across_samples(self, monkeypatch):
+        from ceph_tpu.common import device_telemetry
+
+        class _Dev:
+            platform, id = "faketpu", 0
+
+            def __init__(self):
+                self.stats = {"bytes_in_use": 90, "peak_bytes_in_use": 90,
+                              "bytes_limit": 100}
+
+            def memory_stats(self):
+                return self.stats
+
+        dev = _Dev()
+        monkeypatch.setattr(device_telemetry, "memory_stats",
+                            lambda initialize=False:
+                            {"faketpu:0": dict(dev.stats)})
+        device_telemetry._hbm_high_water.pop("faketpu:0", None)
+        m1 = device_telemetry.hbm_watermarks()
+        assert m1["faketpu:0"]["high_water_bytes"] == 90
+        # the backend's own peak resets; the session mark must not
+        dev.stats.update(bytes_in_use=5, peak_bytes_in_use=5)
+        m2 = device_telemetry.hbm_watermarks()
+        assert m2["faketpu:0"]["high_water_bytes"] == 90
+        assert m2["faketpu:0"]["high_water_ratio"] == pytest.approx(0.9)
+        device_telemetry._hbm_high_water.pop("faketpu:0", None)
+
+
+class TestBenchPreflight:
+    """Satellite 1: the r05 silent-CPU-fallback mode dies at the source."""
+
+    def _bench(self):
+        spec = importlib.util.spec_from_file_location(
+            "bench_t", _REPO / "bench.py")
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_mismatch_raises_named_error(self, monkeypatch):
+        bench = self._bench()
+        monkeypatch.setenv("BENCH_EXPECT_PLATFORM", "tpu")
+        with pytest.raises(bench.PlatformMismatchError):
+            bench.preflight_platform("cpu")
+        with pytest.raises(bench.PlatformMismatchError):
+            bench.preflight_platform(None)
+        bench.preflight_platform("tpu")            # match passes
+
+    def test_jax_platforms_env_is_the_default_request(self, monkeypatch):
+        bench = self._bench()
+        monkeypatch.delenv("BENCH_EXPECT_PLATFORM", raising=False)
+        monkeypatch.setenv("JAX_PLATFORMS", "tpu")
+        assert bench.requested_platform() == "tpu"
+        with pytest.raises(bench.PlatformMismatchError):
+            bench.preflight_platform("cpu")
+        # a comma list is jax's own fallback chain: no hard request
+        monkeypatch.setenv("JAX_PLATFORMS", "tpu,cpu")
+        assert bench.requested_platform() is None
+        bench.preflight_platform("cpu")
+        monkeypatch.delenv("JAX_PLATFORMS")
+        assert bench.requested_platform() is None

@@ -302,6 +302,43 @@ class TestPrometheus:
         finally:
             acct.close()
 
+    def test_device_efficiency_family_rendered(self):
+        """The roofline ledger exports through BOTH surfaces: the
+        ordinary `device_efficiency` collection walk (aggregate gauges)
+        and the labelled per-executable family
+        (`ceph_tpu_device_efficiency{executable,stat}`), with the
+        HELP/TYPE-once invariants and a deterministic synthetic ledger."""
+        from ceph_tpu.common import Context, roofline
+        from ceph_tpu.mgr.prometheus import render
+        roofline.reset()
+        try:
+            key = (((4, 8), "uint8"), ((8, 1024), "uint8"))
+            roofline.record_compile("enc", key, flops_per_call=512.0,
+                                    bytes_per_call=2_000_000.0)
+            roofline.record_call("enc", key, 0.001, synced=True)
+            text = render(Context())
+            lines = text.splitlines()
+            assert lines.count(
+                "# TYPE ceph_tpu_device_efficiency gauge") == 1
+            assert any(line.startswith(
+                "# HELP ceph_tpu_device_efficiency ") for line in lines)
+            eid = "enc_4x8_uint8_8x1024_uint8_"     # sanitized label
+            assert f'ceph_tpu_device_efficiency{{executable="{eid}",' \
+                   f'stat="calls"}} 1.0' in lines
+            assert f'ceph_tpu_device_efficiency{{executable="{eid}",' \
+                   f'stat="achieved_bytes_s"}} 2000000000.0' in lines
+            assert f'ceph_tpu_device_efficiency{{executable="{eid}",' \
+                   f'stat="memory_bound"}} 1.0' in lines
+            # the aggregate rides the ordinary collection walk
+            assert any(line.startswith(
+                'ceph_tpu_achieved_bytes_s{'
+                'collection="device_efficiency"}') for line in lines)
+            types = [line.split(" ", 2)[2].split(" ", 1)[0]
+                     for line in lines if line.startswith("# TYPE ")]
+            assert len(types) == len(set(types)), "duplicate TYPE lines"
+        finally:
+            roofline.reset()
+
     def test_heat_gauge_families_rendered(self):
         """Live HeatTrackers export `ceph_tpu_osd_heat{owner,osd,stat}`
         and `ceph_tpu_pg_heat{owner,pg,stat}` — the hot-shard skew

@@ -49,12 +49,23 @@ METRIC_PATHS = {
     "recovery.wire_per_byte": (("recovery", "wire", "per_byte_repaired"),
                                False),
     "serving.wire_per_op": (("serving", "wire", "per_op"), False),
+    # device efficiency (ISSUE 8): aggregate %-of-roofline-peak from the
+    # per-executable ledger — a drop means the kernels moved AWAY from
+    # what the hardware allows even if raw throughput held (e.g. more
+    # dispatches doing the same work)
+    "efficiency.pct_of_peak": (("efficiency", "pct_of_peak"), True),
 }
 
 # fraction of regression tolerated per metric before the gate fails;
 # latency metrics (higher-is-worse) use the same fraction as an allowed
 # increase.  Overridable per metric via --threshold NAME=0.15.
 DEFAULT_THRESHOLD = 0.10
+
+# per-metric defaults that differ from DEFAULT_THRESHOLD: the %-of-peak
+# join divides modeled work by dispatch WALL seconds, which on a shared
+# cpu host is the noisiest number the gate carries — gate it loosely so
+# only a real efficiency cliff (not scheduler jitter) fails the round
+METRIC_THRESHOLDS = {"efficiency.pct_of_peak": 0.30}
 
 _BLOCK_DEVICE = {
     "core.mib_s": ("device",),
@@ -64,6 +75,7 @@ _BLOCK_DEVICE = {
     "pipeline.mib_s": ("pipeline", "device"),
     "recovery.wire_per_byte": ("recovery", "device"),
     "serving.wire_per_op": ("serving", "device"),
+    "efficiency.pct_of_peak": ("efficiency", "device"),
 }
 
 
@@ -152,7 +164,9 @@ def evaluate(new: dict, reference: dict | None,
                     f"{cur['device'] or 'none'})")
             # cpu-vs-tpu numbers are different experiments: never diffed
             continue
-        thr = thresholds.get(mid, thresholds.get("*", DEFAULT_THRESHOLD))
+        thr = thresholds.get(
+            mid, thresholds.get(
+                "*", METRIC_THRESHOLDS.get(mid, DEFAULT_THRESHOLD)))
         if ref["value"] <= 0:
             continue
         ratio = cur["value"] / ref["value"]
