@@ -303,6 +303,7 @@ _SERVING: dict | None = None     # the serving-engine comparison block
 _RECOVERY: dict | None = None    # the repair-throughput comparison block
 _PIPELINE: dict | None = None    # the async-pipeline comparison block
 _EFFICIENCY: dict | None = None  # the roofline device-efficiency block
+_RESILIENCE: dict | None = None  # goodput under faults + breaker fallback
 
 
 def _pipeline_pass(sinfo, ec, batches, degraded, depth: int,
@@ -585,6 +586,127 @@ def serving_section(platform: str | None) -> dict:
         return {"device": "none", "error": repr(e)[:200]}
 
 
+def _resilience_cluster_pass(device: str, faulted: bool,
+                             n_objects: int = 24) -> dict:
+    """One put+verify-get pass over a MiniCluster — clean, or under a
+    FIXED seeded fault schedule (bus reorder+dup, slow store reads) —
+    returning latency percentiles and acked-goodput MiB/s."""
+    from ceph_tpu.cluster import MiniCluster
+    from ceph_tpu.common import Context
+    c = MiniCluster(n_osds=6, chunk_size=1024, cct=Context())
+    try:
+        pid = c.create_ec_pool(
+            "rz", {"k": "4", "m": "2", "device": device,
+                   "technique": "reed_sol_van"}, pg_num=4)
+        rng = np.random.default_rng(5)
+        payload = rng.integers(0, 256, 8192, np.uint8).tobytes()
+        if faulted:
+            from ceph_tpu.failure import (FaultConfig, FaultPlan,
+                                          StoreFaults)
+            c.inject_faults(FaultPlan(
+                seed=23, bus=FaultConfig(reorder=True, dup_prob=0.2),
+                store=StoreFaults(slow_read_prob=0.10,
+                                  slow_read_ms=0.5)))
+        for i in range(2):            # codec warmup outside the window
+            c.put(pid, f"warm{i}", payload)
+            c.get(pid, f"warm{i}", len(payload))
+        lat: list[float] = []
+        t_all = time.perf_counter()
+        for i in range(n_objects):
+            t0 = time.perf_counter()
+            c.put(pid, f"r{i}", payload)
+            lat.append(time.perf_counter() - t0)
+        for i in range(n_objects):
+            t0 = time.perf_counter()
+            got = c.get(pid, f"r{i}", len(payload))
+            lat.append(time.perf_counter() - t0)
+            assert got == payload, f"read diverged under faults: r{i}"
+        wall = time.perf_counter() - t_all
+        moved = 2 * n_objects * len(payload)
+        lat_ms = sorted(x * 1e3 for x in lat)
+        return {"ops": 2 * n_objects,
+                "goodput_mib_s": round(moved / 2**20 / wall, 2),
+                "p50_ms": round(lat_ms[len(lat_ms) // 2], 3),
+                "p99_ms": round(lat_ms[int(len(lat_ms) * 0.99)], 3)}
+    finally:
+        c.shutdown()
+
+
+def _breaker_fallback_pass(n_batches: int = 12) -> dict:
+    """Encode throughput with the device path FORCED open (dispatch
+    failures at probability 1): every batch serves through the breaker's
+    sync host fallback — the floor the cluster keeps serving at when the
+    device dies."""
+    from ceph_tpu.backend import StripeInfo, ecutil
+    from ceph_tpu.common import Context
+    from ceph_tpu.failure import DeviceFaults, FaultInjector, FaultPlan
+    from ceph_tpu.ops.pipeline import CodecPipeline
+    from ceph_tpu.plugins.registry import ErasureCodePluginRegistry
+    ec = ErasureCodePluginRegistry.instance().factory(
+        "jax_rs", "", {"plugin": "jax_rs", "k": "4", "m": "2",
+                       "technique": "reed_sol_van", "device": "jax"})
+    sinfo = StripeInfo(4, 1024)
+    cct = Context(overrides={"pipeline_breaker_threshold": 2,
+                             "pipeline_breaker_cooldown": 60.0})
+    pl = CodecPipeline(depth=2, name="bench.resilience", cct=cct)
+    try:
+        pl.inject_faults(FaultInjector(FaultPlan(
+            seed=31, device=DeviceFaults(dispatch_fail_prob=1.0))))
+        rng = np.random.default_rng(7)
+        bufs = [rng.integers(0, 256, 64 * 4096, np.uint8).tobytes()
+                for _ in range(n_batches)]
+        t0 = time.perf_counter()
+        futs = [ecutil.encode_many_pipelined(sinfo, ec, [b], pl)
+                for b in bufs]
+        pl.flush()
+        for f in futs:
+            f.result(120)
+        wall = time.perf_counter() - t0
+        moved = sum(len(b) for b in bufs)
+        return {"fallback_mib_s": round(moved / 2**20 / wall, 2),
+                "batches": n_batches,
+                "opens": pl.breaker.opens if pl.breaker else 0,
+                "fallbacks": pl.perf.get("host_fallbacks")}
+    finally:
+        pl.close()
+
+
+def resilience_section(platform: str | None) -> dict:
+    """The `resilience` block (ISSUE 9): p99 + goodput with a fixed
+    seeded fault schedule vs a clean run (the self-healing tax), and
+    breaker-fallback throughput (the floor when the device path dies).
+    Gated by tools/perf_gate.py: a goodput-ratio or fallback-throughput
+    drop past threshold fails the round."""
+    try:
+        device = "jax" if platform is not None else "numpy"
+        with phase("resilience"):
+            clean = _resilience_cluster_pass(device, faulted=False)
+            faulted = _resilience_cluster_pass(device, faulted=True)
+            res = {
+                "device": "tpu" if platform == "tpu" else "cpu",
+                "clean": clean, "faulted": faulted,
+                "goodput_ratio": round(
+                    faulted["goodput_mib_s"]
+                    / max(clean["goodput_mib_s"], 1e-9), 3),
+            }
+            if platform is not None:
+                res["breaker"] = _breaker_fallback_pass()
+        if res["device"] == "cpu":
+            res["note"] = ("no tpu: host-codec cluster pass — the fault "
+                           "tax, not device throughput")
+        brk = res.get("breaker", {})
+        print(f"# resilience: goodput x{res['goodput_ratio']} under "
+              f"faults (clean {clean['goodput_mib_s']} -> faulted "
+              f"{faulted['goodput_mib_s']} MiB/s, p99 "
+              f"{clean['p99_ms']} -> {faulted['p99_ms']} ms)"
+              + (f"; breaker fallback {brk['fallback_mib_s']} MiB/s"
+                 if brk else ""), file=sys.stderr)
+        return res
+    except Exception as e:                 # never fail the artifact
+        print(f"# resilience bench failed: {e!r}", file=sys.stderr)
+        return {"device": "none", "error": repr(e)[:200]}
+
+
 def efficiency_section(platform: str | None) -> dict:
     """The roofline ledger the sections above populated (every
     traced_jit dispatch recorded its measured seconds next to its
@@ -637,6 +759,8 @@ def emit(value, vs_baseline, extra):
         line.setdefault("pipeline", _PIPELINE)
     if _EFFICIENCY is not None:
         line.setdefault("efficiency", _EFFICIENCY)
+    if _RESILIENCE is not None:
+        line.setdefault("resilience", _RESILIENCE)
     # always carried, even on the watchdog/fallback paths: the per-phase
     # breakdown and the per-attempt probe record accumulated so far.  A
     # phase still OPEN when the watchdog fires is exactly the one that
@@ -833,7 +957,7 @@ def main() -> int:
     # serving comparison (coalesced vs op-at-a-time) on whatever device
     # is up — its own subsystem, measured before the device codec pass so
     # a tunnel death mid-codec still leaves the serving block in the line
-    global _SERVING, _RECOVERY, _PIPELINE, _EFFICIENCY
+    global _SERVING, _RECOVERY, _PIPELINE, _EFFICIENCY, _RESILIENCE
     _SERVING = serving_section(platform)
     # repair-throughput comparison (batched waves vs per-object) on the
     # same device — like serving, measured before the codec pass so a
@@ -842,6 +966,8 @@ def main() -> int:
     # codec-pipeline comparison (sync per-batch vs async depth-4, mesh
     # when >1 device) — same placement rationale
     _PIPELINE = pipeline_section(platform)
+    # goodput under a fixed fault schedule + breaker-fallback floor
+    _RESILIENCE = resilience_section(platform)
     # the roofline efficiency block reads the ledger the sections above
     # populated — computed here so a codec-pass death still carries it
     _EFFICIENCY = efficiency_section(platform)

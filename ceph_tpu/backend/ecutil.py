@@ -359,8 +359,13 @@ def encode_many_pipelined(sinfo: StripeInfo, ec_impl,
             off += ln
         return out
 
+    def host_fallback(data_shards):
+        # breaker-open / device-failure path: same parity, host codec
+        return pipeline.host_encode(codec, data_shards, sinfo.chunk_size)
+
     return pipeline.submit(pack, dispatch, unpack, kind="encode",
-                           owner=owner, ops=len(bufs))
+                           owner=owner, host_fallback=host_fallback,
+                           ops=len(bufs))
 
 
 def decode_many_pipelined(sinfo: StripeInfo, ec_impl,
@@ -433,8 +438,16 @@ def _submit_decode_group(sinfo, ec_impl, codec, batches, sig, idxs,
             off += ln
         return out
 
+    def host_fallback(packed):
+        avail_l, erasures_l, stack, _lens = packed
+        if not erasures_l:
+            return None                  # host-only group either way
+        return pipeline.host_decode(codec, stack, erasures_l,
+                                    list(avail_l))
+
     return pipeline.submit(pack, dispatch, unpack, kind="decode",
-                           owner=owner, ops=len(idxs))
+                           owner=owner, host_fallback=host_fallback,
+                           ops=len(idxs))
 
 
 def decode(sinfo: StripeInfo, ec_impl,
@@ -612,9 +625,15 @@ def _decode_shards_groups_pipelined(sinfo, ec_impl, batches, by_sig,
                 off += ln
             return out
 
+        def host_fallback(packed):
+            erasures_l, _want_l, avail_ids, stack, _lens = packed
+            return pipeline.host_decode(codec, stack, erasures_l,
+                                        avail_ids)
+
         pending.append((list(idxs),
                         pipeline.submit(pack, dispatch, unpack,
                                         kind="recover", owner=owner,
+                                        host_fallback=host_fallback,
                                         ops=len(idxs))))
     return pending
 

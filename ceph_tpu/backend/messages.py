@@ -17,6 +17,11 @@ from .extent import ExtentSet
 from .memstore import Transaction
 from ..common import wire_accounting
 from ..common.tracer import default_tracer
+# the bus fault plane now lives in the unified failure/ schema (one
+# schema, one seed across bus/transport/store/device); re-exported here
+# so every existing `from ceph_tpu.backend.messages import FaultConfig`
+# keeps working
+from ..failure.config import FaultConfig  # noqa: F401  (re-export)
 
 
 @dataclass
@@ -233,30 +238,6 @@ class _WireEnvelope:
 
 
 @dataclass
-class FaultConfig:
-    """Message-level fault injection (the messenger half of the Thrasher:
-    the reference's ``ms inject socket failures`` / delivery randomization,
-    qa/tasks/ceph_manager.py).  Faithful to messenger semantics:
-
-    - per-SENDER ordering is always preserved (TCP/ProtocolV2 guarantees
-      in-order delivery per connection; in-process FIFO is load-bearing
-      for rollback ordering too) — ``reorder`` randomizes scheduling
-      ACROSS senders at each destination, which also models arbitrary
-      cross-connection delay;
-    - ``dup_prob`` redelivers a message immediately after the first
-      delivery (connection reset + resend: the reference dedups resent
-      ops by reqid; our shards dedup sub-writes by at_version);
-    - ``drop_prob`` silently discards (a reset with no resend — only for
-      tests that exercise stall handling; real msgr resends, so thrash
-      campaigns should leave this 0).
-    """
-    seed: int = 0
-    reorder: bool = False
-    dup_prob: float = 0.0
-    drop_prob: float = 0.0
-
-
-@dataclass
 class PGEnvelope:
     """Cluster-bus wrapper routing a PG-scoped message to the right PG on
     the destination OSD — the analog of the spg_t every reference OSD
@@ -436,9 +417,18 @@ class MessageBus:
         self.pre_deliver_hooks: list = []
         self._faults: FaultConfig | None = None
         self._fault_rng = None
+        # optional event sink: fn(plane, kind, target=..., **detail) —
+        # a FaultInjector.record, so bus drops/dups/reorders land in the
+        # same seeded campaign log as every other plane's events
+        self.fault_log = None
 
-    def inject_faults(self, cfg: FaultConfig | None) -> None:
-        """Enable (or, with None, disable) fault injection."""
+    def inject_faults(self, cfg) -> None:
+        """Enable (or, with None, disable) fault injection.  Accepts the
+        legacy bus :class:`FaultConfig` or a whole
+        :class:`~ceph_tpu.failure.config.FaultPlan` (its bus plane, with
+        the campaign seed, is what applies here)."""
+        if cfg is not None and hasattr(cfg, "bus_config"):
+            cfg = cfg.bus_config()
         self._faults = cfg
         if cfg is not None:
             import random
@@ -475,6 +465,8 @@ class MessageBus:
         if f is not None and f.drop_prob and \
                 self._fault_rng.random() < f.drop_prob:
             self.dropped += 1
+            if self.fault_log is not None:
+                self.fault_log("bus", "drop", target=to_shard)
             return
         acct = self.wire_stats
         # attribute to the PAYLOAD's type and trace — the envelope is
@@ -539,6 +531,8 @@ class MessageBus:
                 shard not in self.down:
             # immediate redelivery: the resend after a connection reset
             self.duplicated += 1
+            if self.fault_log is not None:
+                self.fault_log("bus", "dup", target=shard)
             handler.handle_message(msg)
         return True
 

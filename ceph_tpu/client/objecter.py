@@ -20,6 +20,8 @@ client-side :2786, ``_send_op`` :3239, and the resend-on-map-change scan
 """
 from __future__ import annotations
 
+import itertools
+import time
 from dataclasses import dataclass, field
 
 from ..common.tracer import default_tracer
@@ -27,6 +29,19 @@ from ..osdmap import PG, OSDMap, ceph_stable_mod
 from ..osdmap.str_hash import ceph_str_hash_rjenkins
 
 MAX_ATTEMPTS = 8      # maps only move forward; a resend loop means a bug
+
+_objecter_ids = itertools.count(1)
+
+# live objecters (the live_daemons/live_engines pattern): the cluster's
+# status() tick sweeps op timeouts on every objecter attached to it, so
+# parked ops age into SLOW_OPS without anyone polling by hand
+import weakref
+
+_OBJECTERS: "weakref.WeakSet[Objecter]" = weakref.WeakSet()
+
+
+def live_objecters() -> list["Objecter"]:
+    return list(_OBJECTERS)
 
 
 @dataclass
@@ -45,6 +60,11 @@ class _Op:
     attempts: int = 0
     done: bool = False
     result: object = None
+    # op-timeout accounting (ISSUE 9): parked ops (inactive PG, a shard
+    # that never answers) older than osd_op_complaint_time get flagged
+    # once by check_op_timeouts and counted on slow_ops -> SLOW_OPS
+    submitted_at: float = 0.0
+    slow: bool = False
     # the op's root TraceContext: every send/resend (and the whole
     # cross-daemon fan-out below it) stitches under ONE trace id
     trace: object = None
@@ -62,6 +82,45 @@ class Objecter:
         self.inflight: dict[int, _Op] = {}
         self.resends = 0
         self.stale_rejects = 0
+        # per-objecter perf collection: in-flight gauge + the slow_ops
+        # counter the SLOW_OPS health check's window delta picks up (the
+        # Objecter::op_timeout -> mon complaint path of the reference)
+        from ..common.perf_counters import PerfCountersBuilder
+        self.perf = (
+            PerfCountersBuilder(f"objecter.{next(_objecter_ids)}")
+            .add_u64("inflight", "client ops submitted and not yet "
+                                 "completed (parked ops included)")
+            .add_u64_counter("ops", "client ops submitted through this "
+                                    "objecter")
+            .add_u64_counter("slow_ops", "in-flight ops older than "
+                                         "osd_op_complaint_time when "
+                                         "check_op_timeouts ran")
+            .create_perf_counters())
+        cluster.cct.perf.add(self.perf)
+        _OBJECTERS.add(self)
+
+    def close(self) -> None:
+        """Unhook the perf collection (a discarded objecter must not
+        leave a frozen inflight gauge behind)."""
+        self.cluster.cct.perf.remove(self.perf.name)
+        _OBJECTERS.discard(self)
+
+    def check_op_timeouts(self, now: float | None = None) -> list[int]:
+        """Flag every in-flight op older than ``osd_op_complaint_time``
+        (once per op) and count it on ``slow_ops`` — the client edge of
+        SLOW_OPS: a black-holed or parked op becomes a health signal
+        instead of a silent hang.  Returns the tids flagged."""
+        now = time.monotonic() if now is None else now
+        complaint = self.cluster.cct.conf.get("osd_op_complaint_time")
+        flagged = []
+        for op in list(self.inflight.values()):
+            if not op.done and not op.slow and \
+                    now - op.submitted_at >= complaint:
+                op.slow = True
+                self.perf.inc("slow_ops")
+                flagged.append(op.tid)
+        self.perf.set("inflight", len(self.inflight))
+        return flagged
 
     # -- target computation (Objecter.cc:2786) -----------------------------
 
@@ -80,9 +139,15 @@ class Objecter:
         self.next_tid += 1
         op = _Op(self.next_tid, pool_id, oid, bytes(data),
                  on_complete=on_complete)
-        self.inflight[op.tid] = op
+        self._track(op)
         self._send_op(op)
         return op.tid
+
+    def _track(self, op: _Op) -> None:
+        op.submitted_at = time.monotonic()
+        self.inflight[op.tid] = op
+        self.perf.inc("ops")
+        self.perf.set("inflight", len(self.inflight))
 
     def operate(self, pool_id: int, oid: str, op,
                 on_complete=None, snapid: int | None = None,
@@ -95,7 +160,7 @@ class Objecter:
         self.next_tid += 1
         o = _Op(self.next_tid, pool_id, oid, None, ops=list(op.ops),
                 snapid=snapid, drain=drain, on_complete=on_complete)
-        self.inflight[o.tid] = o
+        self._track(o)
         self._send_op(o)
         return o.tid
 
@@ -103,7 +168,7 @@ class Objecter:
         """Synchronous read convenience (librados rados_read shape)."""
         self.next_tid += 1
         op = _Op(self.next_tid, pool_id, oid, None, read_len=length)
-        self.inflight[op.tid] = op
+        self._track(op)
         self._send_op(op)
         if not op.done:
             self.inflight.pop(op.tid, None)    # no ghost resends later
@@ -156,6 +221,7 @@ class Objecter:
         op.done = True
         op.result = result
         self.inflight.pop(op.tid, None)
+        self.perf.set("inflight", len(self.inflight))
         if op.on_complete:
             op.on_complete(result)
 

@@ -112,6 +112,19 @@ class Rados:
     def health(self) -> dict:
         return self.cluster.health()
 
+    def shutdown(self) -> None:
+        """librados rados_shutdown: release the objecter's perf
+        collection and live registration (a discarded handle must not
+        keep exporting a frozen inflight gauge)."""
+        self.objecter.close()
+
+    # context-manager sugar: `with Rados(c) as r: ...` shuts down
+    def __enter__(self) -> "Rados":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()
+
 
 class IoCtx:
     """One pool's I/O context (librados::IoCtx)."""

@@ -211,6 +211,9 @@ class MiniCluster:
         # optional recovery scheduler (enable_recovery_scheduler):
         # reservation-gated, prioritized, batch-fused background repair
         self.recovery = None
+        # optional fault injection campaign (inject_faults): one seeded
+        # FaultInjector spanning bus/store/device planes
+        self.fault_injector = None
         # telemetry spine (mgr/stats + mgr/health + flight recorder):
         # status() renders the stats digest, health() is a thin view over
         # the check engine, and any check entering WARN/ERR snapshots a
@@ -432,6 +435,20 @@ class MiniCluster:
                                  "pinned near its capacity (guarded "
                                  "watermark sampler: silent on backends "
                                  "without memory stats)")
+        from .mgr.health import device_degraded_check, osd_flapping_check
+        eng.register("DEVICE_DEGRADED", device_degraded_check(),
+                     description="a codec pipeline circuit-broke its "
+                                 "device path: batches run the sync "
+                                 "host codec until half-open probes "
+                                 "re-close the breaker")
+        eng.register("OSD_FLAPPING",
+                     osd_flapping_check(
+                         lambda: getattr(getattr(self, "monitor", None),
+                                         "markdown", None)),
+                     description="an OSD was marked down too often "
+                                 "within osd_markdown_window: boots are "
+                                 "damped until the operator clears the "
+                                 "markdown record")
 
     def enable_serving(self, start: bool = False, **kw):
         """Attach a :class:`~ceph_tpu.exec.ServingEngine` to every EC
@@ -481,6 +498,78 @@ class MiniCluster:
         self.recovery.attach_backend(
             g.backend, pgid=g.pgid, daemon=self.osds[g.backend.whoami],
             pool_params=pool.params)
+
+    # -- fault injection (failure/) ----------------------------------------
+
+    def inject_faults(self, plan=None):
+        """Arm (or, with ``None``, disarm) cluster-wide fault injection
+        from ONE seeded :class:`~ceph_tpu.failure.config.FaultPlan`:
+
+        - the bus plane drives the shared MessageBus (reorder/dup/drop,
+          stamping its events into the campaign log);
+        - the store plane wraps every PG shard store in a
+          :class:`~ceph_tpu.failure.store.FaultyStore` (EIO / torn
+          writes / slow reads);
+        - the device plane rides the serving/recovery pipelines when
+          those subsystems are enabled.
+
+        The TRANSPORT plane lives on the :class:`~ceph_tpu.net.
+        ClusterServer` (``server.inject_faults(cluster.fault_injector)``)
+        — the sockets are its, not ours.  Returns the
+        :class:`~ceph_tpu.failure.injector.FaultInjector` (or None)."""
+        from .failure import FaultInjector
+        from .failure.store import FaultyStore, unwrap
+        if plan is None:
+            self.bus.inject_faults(None)
+            self.bus.fault_log = None
+            for g in (g for p in self.pools.values()
+                      for g in p["pgs"].values()):
+                for h in g.bus.handlers.values():
+                    st = getattr(h, "store", None)
+                    if isinstance(st, FaultyStore):
+                        h.store = unwrap(st)
+            if self.serving is not None:
+                self.serving.inject_device_faults(None)
+            if self.recovery is not None:
+                self.recovery.inject_device_faults(None)
+            old, self.fault_injector = getattr(self, "fault_injector",
+                                               None), None
+            if old is not None:
+                old.close()
+            return None
+        if self.fault_injector is not None:
+            # re-arming with a new plan: disarm first, so store wrappers
+            # rebind to the NEW injector (stale wrappers would keep
+            # rolling the old plan's faults into the old event log) and
+            # the old perf collection is released before its replacement
+            # registers under the same name
+            self.inject_faults(None)
+        inj = FaultInjector(plan, clusterlog=self.clusterlog,
+                            cct=self.cct, name=f"c{self.cluster_id}")
+        self.fault_injector = inj
+        self.bus.inject_faults(plan)
+        self.bus.fault_log = inj.record
+        for g in (g for p in self.pools.values()
+                  for g in p["pgs"].values()):
+            self._wrap_stores(g, inj)
+        if self.serving is not None:
+            self.serving.inject_device_faults(inj)
+        if self.recovery is not None:
+            self.recovery.inject_device_faults(inj)
+        self.clusterlog.info(
+            f"fault injection armed (seed {plan.seed})", channel="faults")
+        return inj
+
+    @staticmethod
+    def _wrap_stores(g: PGGroup, injector) -> None:
+        """Every shard store of one PG behind a FaultyStore (idempotent:
+        an already-wrapped store is left alone)."""
+        from .failure.store import FaultyStore
+        for shard, h in g.bus.handlers.items():
+            st = getattr(h, "store", None)
+            if st is not None and not isinstance(st, FaultyStore):
+                h.store = FaultyStore(st, injector,
+                                      target=f"osd.{shard}/{g.pgid}")
 
     # -- pool creation (the mon's osd pool create path) --------------------
 
@@ -564,6 +653,9 @@ class MiniCluster:
                 pgs[ps].backend.attach_serving(self.serving)
             if self.recovery is not None:
                 self._attach_recovery(pgs[ps], pool)
+            if getattr(self, "fault_injector", None) is not None:
+                # the store plane covers pools created mid-campaign too
+                self._wrap_stores(pgs[ps], self.fault_injector)
         self.pools[pool.pool_id] = {"pool": pool, "pgs": pgs, "ec": ec}
         self.pool_ids[name] = pool.pool_id
         if not getattr(self, "_restoring", False):
@@ -1254,6 +1346,9 @@ class MiniCluster:
             self.serving.stop()
         if self.recovery is not None:
             self.recovery.close()
+        if self.fault_injector is not None:
+            self.fault_injector.close()
+            self.fault_injector = None
         # telemetry spine down FIRST: a prometheus scrape racing the
         # teardown must not evaluate checks over half-closed PGs
         self.stats.close()
@@ -1454,6 +1549,17 @@ class MiniCluster:
                                 list(acting) != list(g.acting)):
                             self._backfill_pg(pid, ps, list(acting), ec)
         mon.subscribers.append(on_map)
+        # monitor transitions (up/down/flap damping) land in the cluster
+        # log next to the bus-level lines.  In a quorum, apply_committed
+        # runs on EVERY replica: the clog_gate keeps only the current
+        # leader speaking, so one commit logs once, not n_mons times.
+        if hasattr(mon, "mons"):
+            for pm in mon.mons:
+                pm.service.clog = self.clusterlog
+                pm.service.clog_gate = \
+                    (lambda _pm=pm, _mc=mon: _mc.leader() is _pm)
+        else:
+            mon.clog = self.clusterlog
         self.monitor = mon
         return mon
 
@@ -1475,7 +1581,14 @@ class MiniCluster:
                 states[self.pg_state(g)] += 1
         self.stats.sample()
         # status IS the mgr tick: the time-series ring records a point
-        # (interval-gated, so a tight status loop stays bounded)
+        # (interval-gated, so a tight status loop stays bounded), and
+        # every objecter attached to this cluster sweeps its op
+        # timeouts — a parked/black-holed client op ages onto slow_ops
+        # and the SLOW_OPS window delta without anyone polling by hand
+        from .client.objecter import live_objecters
+        for ob in live_objecters():
+            if ob.cluster is self:
+                ob.check_op_timeouts()
         self.ts.record()
         st = {
             "osdmap": {"epoch": self.osdmap.epoch,

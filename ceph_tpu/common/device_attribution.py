@@ -65,6 +65,7 @@ _classes: dict[str, dict] = {}      # owner -> {device_s, batches, bytes}
 _busy_s = 0.0                       # union device-occupancy (the invariant)
 _last_end = 0.0                     # trailing completion edge (clamp point)
 _executables: dict[str, dict] = {}  # label -> {flops, bytes, compiles}
+_fallback: dict[str, dict] = {}     # owner -> {batches, bytes} host-served
 _perf = None
 
 
@@ -124,6 +125,19 @@ def record_batch(owner: str | None, dispatched_at: float,
     return dur
 
 
+def record_host_fallback(owner: str | None, nbytes: int = 0) -> None:
+    """Mark one batch served by the SYNC HOST codec because the device
+    path was circuit-broken (ops/pipeline.py host fallback): the chip
+    did none of this work, so nothing lands in busy_s — the separate
+    fallback ledger is what ``device top``/DEVICE_DEGRADED read to show
+    how degraded the device path currently is."""
+    cls = resolve_owner(owner)
+    with _lock:
+        rec = _fallback.setdefault(cls, {"batches": 0, "bytes": 0})
+        rec["batches"] += 1
+        rec["bytes"] += int(nbytes)
+
+
 def record_executable(label: str, flops: float, bytes_accessed: float
                       ) -> None:
     """Fold one compiled executable's XLA cost analysis into the ledger
@@ -152,7 +166,10 @@ def snapshot() -> dict:
             for cls, rec in sorted(_classes.items())}
         execs = {label: dict(rec)
                  for label, rec in sorted(_executables.items())}
-    return {"classes": classes, "busy_s": busy, "executables": execs}
+        fallback = {cls: dict(rec)
+                    for cls, rec in sorted(_fallback.items())}
+    return {"classes": classes, "busy_s": busy, "executables": execs,
+            "host_fallback": fallback}
 
 
 def device_top(limit: int = 10) -> dict:
@@ -186,6 +203,7 @@ def reset() -> dict:
         n = len(_classes)
         _classes.clear()
         _executables.clear()
+        _fallback.clear()
         _busy_s = 0.0
         _last_end = 0.0
     return {"success": f"dropped {n} owner-class records"}

@@ -142,6 +142,79 @@ OPTIONS: list[Option] = [
     Option("mon_osd_reporter_subtree_level", TYPE_STR, LEVEL_ADVANCED,
            default="host",
            description="crush level for counting distinct failure reporters"),
+    # -- fault injection & self-healing (failure/) -------------------------
+    Option("osd_markdown_count", TYPE_UINT, LEVEL_ADVANCED, default=5,
+           min=1,
+           description="mark-downs within osd_markdown_window before an "
+                       "OSD is declared flapping: further boots are "
+                       "refused (OSD_FLAPPING) until the operator clears "
+                       "the markdown record (osd_markdown_log analog)",
+           see_also=["osd_markdown_window"]),
+    Option("osd_markdown_window", TYPE_FLOAT, LEVEL_ADVANCED,
+           default=600.0, min=1.0,
+           description="sliding window in seconds over which "
+                       "osd_markdown_count mark-downs count as flapping",
+           see_also=["osd_markdown_count"]),
+    Option("ms_inject_socket_failures", TYPE_UINT, LEVEL_ADVANCED,
+           default=0,
+           description="inject a connection reset roughly every N "
+                       "post-auth messages on the TCP transport (0 "
+                       "disables) — the reference's 'ms inject socket "
+                       "failures'; the ClusterServer auto-arms its "
+                       "fault hooks when nonzero",
+           see_also=["ms_inject_delay_prob", "ms_inject_delay_ms"]),
+    Option("ms_inject_delay_prob", TYPE_FLOAT, LEVEL_ADVANCED,
+           default=0.0, min=0.0, max=1.0,
+           description="probability a post-auth TCP message is delayed "
+                       "by ms_inject_delay_ms before hitting the wire "
+                       "('ms inject delay' analog)",
+           see_also=["ms_inject_delay_ms"]),
+    Option("ms_inject_delay_ms", TYPE_FLOAT, LEVEL_ADVANCED,
+           default=0.0, min=0.0,
+           description="milliseconds an ms_inject_delay_prob hit stalls "
+                       "the send"),
+    Option("ms_rpc_timeout", TYPE_FLOAT, LEVEL_ADVANCED, default=30.0,
+           min=0.1,
+           description="overall per-RPC deadline on the TCP client: a "
+                       "call not answered (across resends) within this "
+                       "many seconds raises TimeoutError instead of "
+                       "hanging on a black-holed request"),
+    Option("ms_rpc_retry_attempts", TYPE_UINT, LEVEL_ADVANCED, default=4,
+           min=1,
+           description="send attempts per RPC within ms_rpc_timeout: "
+                       "resends after a connection reset or a silent "
+                       "per-attempt timeout (the server dedups resends "
+                       "by (session, rid), so retries never re-apply)",
+           see_also=["ms_rpc_timeout"]),
+    Option("ms_reconnect_max_attempts", TYPE_UINT, LEVEL_ADVANCED,
+           default=8, min=1,
+           description="bounded reconnect attempts after the TCP link "
+                       "drops before the client gives up "
+                       "(full-jitter exponential backoff between tries)",
+           see_also=["ms_reconnect_backoff_base",
+                     "ms_reconnect_backoff_cap"]),
+    Option("ms_reconnect_backoff_base", TYPE_FLOAT, LEVEL_ADVANCED,
+           default=0.05, min=0.0,
+           description="base seconds of the reconnect backoff schedule: "
+                       "attempt n sleeps uniform[0, min(cap, "
+                       "base * 2^n)] (full jitter)"),
+    Option("ms_reconnect_backoff_cap", TYPE_FLOAT, LEVEL_ADVANCED,
+           default=2.0, min=0.0,
+           description="ceiling seconds any single reconnect backoff "
+                       "sleep can reach"),
+    Option("pipeline_breaker_threshold", TYPE_UINT, LEVEL_ADVANCED,
+           default=3,
+           description="consecutive device-side codec failures before "
+                       "the pipeline's circuit breaker opens and "
+                       "fallback-capable batches run the sync host "
+                       "codec instead (0 disables the breaker)",
+           see_also=["pipeline_breaker_cooldown"]),
+    Option("pipeline_breaker_cooldown", TYPE_FLOAT, LEVEL_ADVANCED,
+           default=5.0, min=0.0,
+           description="seconds an open pipeline breaker waits before "
+                       "admitting one half-open probe dispatch back to "
+                       "the device (success re-closes, failure re-opens)",
+           see_also=["pipeline_breaker_threshold"]),
     Option("ec_batch_max_stripes", TYPE_UINT, LEVEL_ADVANCED, default=256,
            description="stripes coalesced per device dispatch"),
     Option("ec_device_threshold_bytes", TYPE_SIZE, LEVEL_ADVANCED,

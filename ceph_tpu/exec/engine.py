@@ -203,12 +203,21 @@ class ServingEngine:
         return self._thread is not None
 
     def depths(self) -> dict:
-        """mClock queue depth by op class (+ total/bytes gauges)."""
+        """mClock queue depth by op class (+ total/bytes gauges + the
+        device breaker's state when a pipeline is attached)."""
         with self._lock:
             d = self.queue.depths()
             d["_total"] = self._depth
             d["_bytes"] = self._qbytes
-            return d
+        if self.pipeline is not None and self.pipeline.breaker is not None:
+            d["_breaker"] = self.pipeline.breaker.state
+        return d
+
+    def inject_device_faults(self, injector) -> None:
+        """Route the device-plane fault injection (failure/) through this
+        engine's codec pipeline — the chaos harness hook."""
+        if self.pipeline is not None:
+            self.pipeline.inject_faults(injector)
 
     # -- submission ----------------------------------------------------------
 

@@ -1,0 +1,38 @@
+"""ceph_tpu.failure: seeded fault injection + the self-healing machinery.
+
+Two halves (ISSUE 9):
+
+- **Injection** — one :class:`FaultPlan` (one schema, one seed) spanning
+  the in-process bus, the TCP transport, the object stores and the
+  device pipeline, executed by a :class:`FaultInjector` whose every
+  event is logged, perf-counted, clusterlog-stamped, and digested for
+  same-seed reproducibility.
+
+- **Self-healing** — the machinery those faults exercise:
+  :class:`ExponentialBackoff` (full-jitter, bounded) behind the TCP
+  client's reconnect/resend, :class:`CircuitBreaker` behind the codec
+  pipeline's host-fallback (``DEVICE_DEGRADED``), and
+  :class:`MarkDownLimiter` behind the monitor's flap damping
+  (``OSD_FLAPPING``).
+
+``tools/chaos_run.py`` drives both halves as one seeded campaign against
+a real TCP MiniCluster.
+"""
+from .backoff import ExponentialBackoff, RetriesExhausted
+from .breaker import (CLOSED, HALF_OPEN, OPEN, CircuitBreaker,
+                      live_breakers, state_rank)
+from .config import (DeviceFaults, FaultConfig, FaultPlan, StoreFaults,
+                     TransportFaults)
+from .injector import FaultInjector, InjectedFault, InjectedOOM
+from .markdown import MarkDownLimiter
+from .store import FaultyStore, unwrap
+from .transport import TransportFaultHooks
+
+__all__ = [
+    "CLOSED", "HALF_OPEN", "OPEN",
+    "CircuitBreaker", "DeviceFaults", "ExponentialBackoff", "FaultConfig",
+    "FaultInjector", "FaultPlan", "FaultyStore", "InjectedFault",
+    "InjectedOOM", "MarkDownLimiter", "RetriesExhausted", "StoreFaults",
+    "TransportFaultHooks", "TransportFaults", "live_breakers",
+    "state_rank", "unwrap",
+]

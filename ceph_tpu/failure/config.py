@@ -1,0 +1,115 @@
+"""Unified fault-plane configuration: one schema, one seed.
+
+The fault surface grew up piecemeal — the in-process bus had its own
+``FaultConfig`` (seed + reorder/dup/drop), the TCP path had nothing, and
+the device pipeline's failures were whatever a test monkeypatched in.
+This module is the single schema the whole injection surface reads
+(reference: the knob set ``qa/tasks/ceph_manager.py``'s Thrasher drives —
+``ms inject socket failures``, ``ms inject delay``, filestore EIO
+injection, ``bluestore_debug_inject_read_err``): a :class:`FaultPlan`
+carries one campaign seed and one sub-config per plane:
+
+- **bus** (:class:`FaultConfig`, unchanged shape — the in-process
+  messenger): cross-sender reorder, duplicate delivery, silent drops;
+- **transport** (:class:`TransportFaults`, the TCP messenger in
+  ``net.py``): connection resets, black-holed requests, truncated
+  frames, send/recv delays;
+- **store** (:class:`StoreFaults`, any ObjectStore behind
+  :class:`~ceph_tpu.failure.store.FaultyStore`): EIO on read/write,
+  torn writes, slow-read latency;
+- **device** (:class:`DeviceFaults`, the codec pipeline): injected
+  dispatch/completion failures and simulated OOM.
+
+Everything here is a plain dataclass of probabilities — stdlib only, no
+runtime state.  The runtime half (seeded decision streams, the injected-
+event log, clusterlog/perf stamping) lives in
+:class:`~ceph_tpu.failure.injector.FaultInjector`.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+
+
+@dataclass
+class FaultConfig:
+    """Message-level fault injection for the in-process bus (the
+    messenger half of the Thrasher: the reference's ``ms inject socket
+    failures`` / delivery randomization, qa/tasks/ceph_manager.py).
+    Faithful to messenger semantics:
+
+    - per-SENDER ordering is always preserved (TCP/ProtocolV2 guarantees
+      in-order delivery per connection; in-process FIFO is load-bearing
+      for rollback ordering too) — ``reorder`` randomizes scheduling
+      ACROSS senders at each destination, which also models arbitrary
+      cross-connection delay;
+    - ``dup_prob`` redelivers a message immediately after the first
+      delivery (connection reset + resend: the reference dedups resent
+      ops by reqid; our shards dedup sub-writes by at_version);
+    - ``drop_prob`` silently discards (a reset with no resend — only for
+      tests that exercise stall handling; the TCP path now RESENDS with
+      reqid dedup, so thrash campaigns should leave this 0).
+
+    Historically defined in ``backend/messages.py``; it now lives here as
+    the bus plane of the unified :class:`FaultPlan` (``messages.py``
+    re-exports it, and ``MessageBus.inject_faults`` accepts either).
+    """
+    seed: int = 0
+    reorder: bool = False
+    dup_prob: float = 0.0
+    drop_prob: float = 0.0
+
+
+@dataclass
+class TransportFaults:
+    """TCP-plane faults applied by the server's channel hooks
+    (``ms inject socket failures`` territory).  All probabilities are
+    per-message decisions on the post-auth path — the cephx handshake is
+    never faulted, so a reconnecting client always gets back in."""
+    reset_prob: float = 0.0        # abrupt connection close mid-stream
+    blackhole_prob: float = 0.0    # request swallowed: no reply ever
+    truncate_prob: float = 0.0     # partial frame on the wire, then reset
+    delay_prob: float = 0.0        # per-message send stall ...
+    delay_ms: float = 0.0          # ... of this many milliseconds
+
+
+@dataclass
+class StoreFaults:
+    """ObjectStore-plane faults (filestore EIO / bluestore debug read
+    error injection territory)."""
+    eio_read_prob: float = 0.0     # read raises EIO
+    eio_write_prob: float = 0.0    # queue_transaction raises EIO, no apply
+    torn_write_prob: float = 0.0   # a PREFIX of the transaction applies
+    slow_read_prob: float = 0.0    # read stalls ...
+    slow_read_ms: float = 0.0      # ... this long
+
+
+@dataclass
+class DeviceFaults:
+    """Device-plane faults injected into the codec pipeline: the r04
+    "errored" / r05 "silent CPU fallback" bench history as reproducible
+    inputs instead of production surprises."""
+    dispatch_fail_prob: float = 0.0     # async launch raises
+    completion_fail_prob: float = 0.0   # block_until_ready raises
+    oom_prob: float = 0.0               # RESOURCE_EXHAUSTED at dispatch
+
+
+@dataclass
+class FaultPlan:
+    """One campaign: one seed, every plane.  Hand it to
+    ``MiniCluster.inject_faults`` (which builds the
+    :class:`~ceph_tpu.failure.injector.FaultInjector` and fans the plan
+    out to bus/store/device) and ``ClusterServer.inject_faults`` (the
+    transport plane)."""
+    seed: int = 0
+    bus: FaultConfig = field(default_factory=FaultConfig)
+    transport: TransportFaults = field(default_factory=TransportFaults)
+    store: StoreFaults = field(default_factory=StoreFaults)
+    device: DeviceFaults = field(default_factory=DeviceFaults)
+
+    def bus_config(self) -> FaultConfig:
+        """The bus plane with the CAMPAIGN seed (one seed drives every
+        plane; a bus sub-config carrying its own nonzero seed keeps it —
+        the escape hatch for reproducing a legacy per-bus test)."""
+        if self.bus.seed:
+            return self.bus
+        return replace(self.bus, seed=self.seed)

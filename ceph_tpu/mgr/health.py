@@ -362,6 +362,56 @@ def hbm_pressure_check(cct, ratio: float | None = None, sampler=None):
     return check
 
 
+def device_degraded_check():
+    """DEVICE_DEGRADED: one or more codec pipelines have circuit-broken
+    their device path — N consecutive device failures opened the breaker
+    and fallback-capable batches are running the SYNC HOST codec
+    (``ops/pipeline.py``).  Clears when half-open probes re-close every
+    breaker.  Reads the live-breaker registry (``failure/breaker.py``),
+    so any pipeline in the process — serving engine, recovery scheduler,
+    standalone — reports without wiring."""
+    def check():
+        from ..failure.breaker import CLOSED, live_breakers
+        rows: list[str] = []
+        for b in live_breakers():
+            d = b.dump()
+            if d["state"] == CLOSED:
+                continue
+            rows.append(
+                f"{d['name']}: {d['state']} after "
+                f"{d['consecutive_failures']} consecutive device "
+                f"failures ({d['opens']} opens, {d['fallbacks']} "
+                f"host-fallback batches)")
+        if rows:
+            return CheckResult(
+                f"{len(rows)} device codec path(s) degraded to host "
+                f"fallback", detail=rows, count=len(rows))
+        return None
+    return check
+
+
+def osd_flapping_check(limiter_getter):
+    """OSD_FLAPPING: the monitor's mark-down limiter has damped one or
+    more OSDs — marked down too often inside ``osd_markdown_window``,
+    they stay down (boots refused) until the operator clears the record
+    (``Monitor.clear_markdown``).  The osd_markdown_log health surface."""
+    def check():
+        lim = limiter_getter()
+        if lim is None:
+            return None
+        damped = sorted(lim.damped)
+        if damped:
+            return CheckResult(
+                f"{len(damped)} osd(s) flapping: boots damped until "
+                f"operator clear",
+                detail=[f"osd.{o} marked down >= {lim.count} times in "
+                        f"{lim.window:.0f}s; down until cleared"
+                        for o in damped],
+                count=len(damped))
+        return None
+    return check
+
+
 def recompile_storm_check(cct, stats, threshold: float | None = None):
     """RECOMPILE_STORM: the traced_jit registry is compiling at more
     than ``mgr_recompile_storm_compiles`` per MINUTE over the stats

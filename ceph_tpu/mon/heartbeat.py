@@ -51,7 +51,13 @@ class HeartbeatAgent:
 
     def heartbeat_check(self) -> list[int]:
         """(OSD.cc:4746): peers silent past the grace go on the failure
-        queue; recovered peers get their reports canceled."""
+        queue; recovered peers get their reports canceled, and a peer
+        the map says is DOWN gets boot-reported the moment it replies
+        again (the preprocess_boot path heartbeats drive).  The boot is
+        NOT unconditional: the monitor's mark-down limiter refuses it
+        while the peer is flap-damped — without that gate this very
+        first-post-grace-reply re-mark-up is the flapping hole (down,
+        up 6s later, down again, forever)."""
         now = self.clock.now()
         grace = self.mon.cct.conf.get("osd_heartbeat_grace")
         newly_failed = []
@@ -65,9 +71,14 @@ class HeartbeatAgent:
                     newly_failed.append(p)
                 self.mon.prepare_failure(p, self.osd,
                                          failed_since=last, now=now)
-            elif p in self.failure_pending:
-                self.failure_pending.discard(p)
-                self.mon.cancel_failure(p, self.osd)
+            else:
+                if p in self.failure_pending:
+                    self.failure_pending.discard(p)
+                    self.mon.cancel_failure(p, self.osd)
+                if last >= now and self.mon.osdmap.is_down(p):
+                    # fresh reply from a down-marked peer: report the
+                    # boot (flap damping inside osd_boot may refuse)
+                    self.mon.osd_boot(p, now=now)
         return newly_failed
 
     def tick(self) -> list[int]:

@@ -18,6 +18,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 
 from ..common import Context, default_context
+from ..failure.markdown import MarkDownLimiter
 from ..osdmap import Incremental, OSDMap, OSD_UP, apply_incremental
 
 
@@ -47,6 +48,29 @@ class Monitor:
         # (the PaxosService::propose_pending split; single-mon mode keeps
         # the commit==quorum shortcut)
         self.submit_fn = None
+        # flap damping (osd_markdown_log analog, failure/markdown.py): an
+        # OSD marked down osd_markdown_count times within
+        # osd_markdown_window stays down — boots are refused until the
+        # operator clears the record (clear_markdown)
+        self.markdown = MarkDownLimiter(
+            count=self.cct.conf.get("osd_markdown_count"),
+            window=self.cct.conf.get("osd_markdown_window"))
+        # optional cluster log (clog): up/down/flap transitions land
+        # where an incident reads first (MiniCluster.attach_monitor
+        # wires).  In a quorum, apply_committed runs on EVERY replica;
+        # clog_gate (set per replica) keeps only the current leader
+        # logging so one commit is one line, not n_mons lines.
+        self.clog = None
+        self.clog_gate = None
+        self._flap_logged: set[int] = set()
+
+    def _clog(self):
+        """The cluster log iff this monitor should speak (single-mon:
+        always; quorum member: only while leader)."""
+        if self.clog is not None and \
+                (self.clog_gate is None or self.clog_gate()):
+            return self.clog
+        return None
 
     # -- failure reports (OSDMonitor.cc:2874) ------------------------------
 
@@ -113,12 +137,46 @@ class Monitor:
 
     # -- boots / outs ------------------------------------------------------
 
-    def osd_boot(self, osd: int) -> None:
-        """An OSD (re)announcing itself (OSDMonitor preprocess_boot path)."""
+    def osd_boot(self, osd: int, now: float | None = None) -> bool:
+        """An OSD (re)announcing itself (OSDMonitor preprocess_boot
+        path).  Returns False — the boot is REFUSED — while the OSD is
+        flap-damped: marked down too often inside the markdown window,
+        it stays down until :meth:`clear_markdown` (the reference's
+        osd_markdown_log rejection).  ``now`` is accepted for symmetry
+        with the failure-report API; damping is deliberately sticky
+        (operator-cleared), not time-expiring, so the boot decision
+        itself is clock-free."""
+        if not self.markdown.allow_up(osd):
+            if osd not in self._flap_logged:
+                self._flap_logged.add(osd)
+                self.cct.dout("mon", 1,
+                              f"osd.{osd} boot denied: flapping "
+                              f"(damped until operator clear)")
+                clog = self._clog()
+                if clog is not None:
+                    clog.warn(
+                        f"mon: osd.{osd} boot denied — flapping "
+                        f"({self.markdown.count} mark-downs within "
+                        f"{self.markdown.window:.0f}s); down until "
+                        f"cleared", channel="mon")
+            return False
         if not self.osdmap.is_up(osd):
             self.pending.new_state[osd] = \
                 self.pending.new_state.get(osd, 0) | OSD_UP
         self.failure_info.pop(osd, None)
+        return True
+
+    def clear_markdown(self, osd: int) -> bool:
+        """Operator clear of the flap-damping record ('ceph osd
+        clear-markdown' analog): boots are allowed again (the OSD still
+        has to boot — clearing does not itself mark up)."""
+        was = self.markdown.clear(osd)
+        self._flap_logged.discard(osd)
+        clog = self._clog()
+        if was and clog is not None:
+            clog.info(f"mon: osd.{osd} markdown record cleared by "
+                           f"operator", channel="mon")
+        return was
 
     # -- commit (the Paxos propose_pending analog) -------------------------
 
@@ -149,8 +207,26 @@ class Monitor:
                 if old.is_up(o) and not self.osdmap.is_up(o):
                     self.down_stamp[o] = now
                     self.failure_info.pop(o, None)
+                    # flap accounting: every committed mark-down counts
+                    # toward the damping window
+                    tripped = self.markdown.record_down(o, now)
+                    clog = self._clog()
+                    if clog is not None:
+                        clog.warn(f"mon: osd.{o} marked down",
+                                  channel="mon")
+                        if tripped:
+                            clog.warn(
+                                f"mon: osd.{o} is flapping "
+                                f"(>= {self.markdown.count} mark-downs "
+                                f"in {self.markdown.window:.0f}s) — "
+                                f"boots damped until operator clear",
+                                channel="mon")
                 elif not old.is_up(o) and self.osdmap.is_up(o):
                     self.down_stamp.pop(o, None)
+                    clog = self._clog()
+                    if clog is not None:
+                        clog.info(f"mon: osd.{o} marked up",
+                                  channel="mon")
         for fn in self.subscribers:
             fn(self.osdmap, inc)
         return self.osdmap

@@ -481,10 +481,26 @@ class MonCluster:
         if ld is not None:
             ld.service.cancel_failure(target, reporter)
 
-    def osd_boot(self, osd: int) -> None:
+    def osd_boot(self, osd: int, now: float | None = None) -> bool:
         ld = self.leader()
         if ld is not None:
-            ld.service.osd_boot(osd)
+            return ld.service.osd_boot(osd, now=now)
+        return False
+
+    @property
+    def markdown(self):
+        """The leader's flap-damping limiter (OSD_FLAPPING reads it)."""
+        ld = self.leader()
+        return (ld or self.mons[0]).service.markdown
+
+    def clear_markdown(self, osd: int) -> bool:
+        """Operator clear on EVERY replica: mark-downs are recorded by
+        each quorum member's apply_committed, so a leader-only clear
+        would resurrect the damping on the next failover."""
+        was = False
+        for m in self.mons:
+            was = m.service.clear_markdown(osd) or was
+        return was
 
     @property
     def nodown(self) -> set[int]:

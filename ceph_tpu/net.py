@@ -91,6 +91,11 @@ class RpcCall:
     # post-auth frame so the server's spans stitch under the remote
     # client's trace id — the cross-PROCESS half of trace propagation
     trace: object = None
+    # client session id: (session, rid) is the reqid the server dedups
+    # resent calls by, so a resend after a connection reset (or a
+    # black-holed request) never re-applies a non-idempotent op — the
+    # reference's reqid dedup for 'ms inject socket failures' resends
+    session: str = ""
 
 
 @dataclass
@@ -266,6 +271,12 @@ class Channel:
         self.stats = {"tx_msgs": 0, "tx_bytes": 0,
                       "rx_msgs": 0, "rx_bytes": 0}
         self.acct = None
+        # transport fault hooks (failure/transport.py): a ZERO-ARG
+        # PROVIDER returning the current hooks (or None), attached by
+        # the server AFTER auth — a provider rather than a snapshot so
+        # arming/disarming mid-run applies to live connections, and the
+        # handshake is never faulted (reconnects always get back in)
+        self.faults = None
         with self._wlock:
             self.sock.sendall(BANNER)
 
@@ -276,6 +287,16 @@ class Channel:
 
     def send(self, msg) -> None:
         data = _encode(msg, self.secret)
+        action = "ok"
+        hooks = self.faults() if self.faults is not None else None
+        if hooks is not None:
+            # target is the MESSAGE TYPE, not the peer address: ephemeral
+            # ports differ between runs and would break the same-seed
+            # event-digest guarantee
+            from .failure.transport import SEND_TRUNCATE
+            action = hooks.on_send(
+                type(msg).__name__, len(data),
+                target=type(msg).__name__)
         with self._wlock:
             # stats ride the same lock that serializes concurrent
             # senders (dispatch reply vs notify push): counting outside
@@ -290,7 +311,19 @@ class Channel:
                     msg, nbytes=len(data),
                     ctx=getattr(msg, "trace", None)
                     or default_tracer().current_ctx())
-            self.sock.sendall(data)
+            if action == "ok":
+                self.sock.sendall(data)
+        if action != "ok":
+            # injected transport failure: a PARTIAL frame on the wire
+            # (truncate) or nothing at all, then an abrupt close — the
+            # peer sees a cut-off frame / RST and must reconnect+resend
+            if action == SEND_TRUNCATE:
+                try:
+                    self.sock.sendall(data[:max(1, len(data) // 2)])
+                except OSError:
+                    pass
+            self.close()
+            raise ConnectionError(f"injected connection {action}")
 
     def recv_msgs(self) -> list:
         """Blocking read; returns >=1 decoded messages or raises
@@ -373,6 +406,59 @@ class ClusterServer:
         self._watchers: dict[int, Channel] = {}
         self._pending_acks: dict[tuple[int, int], list] = {}
         self._ack_cond = threading.Condition()
+        # transport fault injection (failure/): hooks attached to every
+        # authenticated connection once inject_faults() arms them —
+        # explicitly, or auto-armed from the ms_inject_* options (the
+        # reference's 'ms inject socket failures' config surface)
+        self.fault_hooks = None
+        self._maybe_auto_inject()
+        # resend dedup: (client session, rid) -> cached RpcResult, so a
+        # retried call after a reset/black-hole returns the FIRST
+        # execution's answer instead of re-applying (reqid dedup)
+        self._rpc_cache: "dict[tuple[str, int], RpcResult]" = {}
+        self._rpc_cache_order: list[tuple[str, int]] = []
+        self._rpc_cache_lock = threading.Lock()
+        # reqids currently EXECUTING: a resend that arrives while the
+        # original is still running waits for that execution instead of
+        # starting a second one (slow notify + eager client resend)
+        self._rpc_inflight: "dict[tuple[str, int], threading.Event]" = {}
+        self.rpc_dedup_hits = 0
+
+    RPC_CACHE_MAX = 4096
+
+    # side-effect-free methods are safe to simply RE-EXECUTE on a
+    # resend: caching them would pin every read payload in the dedup
+    # cache (4 MiB gets x 4096 entries) for hits that barely happen
+    IDEMPOTENT_RPCS = frozenset(
+        {"get", "stat", "ls", "pools", "status", "health", "getxattr"})
+
+    def inject_faults(self, injector) -> None:
+        """Arm (or, with None, disarm) transport-plane fault injection:
+        every authenticated connection consults the injector's seeded
+        streams for resets, black-holes, truncations and delays."""
+        from .failure.transport import TransportFaultHooks
+        self.fault_hooks = TransportFaultHooks(injector) \
+            if injector is not None else None
+
+    def _maybe_auto_inject(self) -> None:
+        """The ms_inject_* options arm the hooks without code: a reset
+        roughly every ``ms_inject_socket_failures`` post-auth messages
+        plus ``ms_inject_delay_prob``/``ms_inject_delay_ms`` stalls."""
+        cct = getattr(self.cluster, "cct", None)
+        if cct is None:
+            return
+        n = int(cct.conf.get("ms_inject_socket_failures"))
+        dprob = float(cct.conf.get("ms_inject_delay_prob"))
+        if n <= 0 and dprob <= 0:
+            return
+        from .failure import (FaultInjector, FaultPlan, TransportFaults)
+        plan = FaultPlan(transport=TransportFaults(
+            reset_prob=(1.0 / n) if n > 0 else 0.0,
+            delay_prob=dprob,
+            delay_ms=float(cct.conf.get("ms_inject_delay_ms"))))
+        self._own_injector = FaultInjector(plan, cct=cct,
+                                           name=f"net.{self.port}")
+        self.inject_faults(self._own_injector)
 
     # -- keyring -------------------------------------------------------------
 
@@ -438,6 +524,11 @@ class ClusterServer:
         except OSError:
             pass
         self.wire.close()
+        if getattr(self, "_own_injector", None) is not None:
+            # only the auto-armed injector is ours to close; an operator-
+            # supplied one (inject_faults) belongs to its campaign
+            self._own_injector.close()
+            self._own_injector = None
 
     # -- per-connection ------------------------------------------------------
 
@@ -452,17 +543,40 @@ class ClusterServer:
                 name, session_key = self._handshake(ch)
             sock.settimeout(None)
             ch.secure(session_key)
+            # fault injection arms only POST-auth: a reconnecting client
+            # must always be able to complete the handshake.  A provider,
+            # not a snapshot: inject_faults(None) mid-run disarms LIVE
+            # connections too
+            ch.faults = lambda: self.fault_hooks
             while True:
                 for msg in ch.recv_msgs():
+                    hooks = self.fault_hooks
+                    if hooks is not None and isinstance(msg, RpcCall):
+                        from .failure.transport import (RECV_BLACKHOLE,
+                                                        RECV_RESET)
+                        act = hooks.on_recv(
+                            type(msg).__name__, target=msg.method)
+                        if act == RECV_BLACKHOLE:
+                            continue    # swallowed: no reply, ever
+                        if act == RECV_RESET:
+                            raise ConnectionError("injected recv reset")
                     if isinstance(msg, RpcCall):
                         # thread-per-request: a call blocked on the
                         # cluster lock (e.g. behind a notify waiting for
                         # THIS client's ack) must not stall this reader —
                         # the ack would sit unread behind it forever
-                        threading.Thread(
-                            target=lambda m=msg: ch.send(
-                                self._dispatch(ch, m)),
-                            daemon=True).start()
+                        def _serve(m=msg):
+                            res = self._dispatch(ch, m)
+                            try:
+                                ch.send(res)
+                            except (ConnectionError, OSError):
+                                # link died (or an injected reset) before
+                                # the reply got out: the result is cached
+                                # under its reqid — the client's resend
+                                # on the next connection collects it
+                                pass
+                        threading.Thread(target=_serve,
+                                         daemon=True).start()
                     elif isinstance(msg, NotifyAck):
                         with self._ack_cond:
                             key = (msg.cookie, msg.notify_id)
@@ -514,6 +628,36 @@ class ClusterServer:
 
     def _dispatch(self, ch: Channel, call: RpcCall) -> RpcResult:
         t0 = time.perf_counter()
+        # resend dedup by reqid: a session-stamped call already answered
+        # returns its FIRST execution's cached result — the property that
+        # makes reset/black-hole resends safe for non-idempotent ops
+        key = (call.session, call.rid) \
+            if getattr(call, "session", "") \
+            and call.method not in self.IDEMPOTENT_RPCS else None
+        if key is not None:
+            with self._rpc_cache_lock:
+                hit = self._rpc_cache.get(key)
+                running = None
+                if hit is None:
+                    running = self._rpc_inflight.get(key)
+                    if running is None:
+                        self._rpc_inflight[key] = threading.Event()
+            if hit is not None:
+                self.rpc_dedup_hits += 1
+                return hit
+            if running is not None:
+                # the original execution is still on the cluster lock:
+                # wait for ITS answer rather than double-applying
+                self.rpc_dedup_hits += 1
+                running.wait(NOTIFY_TIMEOUT * 6)
+                with self._rpc_cache_lock:
+                    hit = self._rpc_cache.get(key)
+                if hit is not None:
+                    return hit
+                return RpcResult(call.rid, False, None,
+                                 "duplicate of an execution that never "
+                                 "finished", 0,
+                                 trace=getattr(call, "trace", None))
         try:
             fn = getattr(self, f"_rpc_{call.method}", None)
             if fn is None:
@@ -525,18 +669,35 @@ class ClusterServer:
                                 track="server"), \
                     tr.span(f"rpc.{call.method}", cat="rpc"):
                 value = fn(ch, **call.args)
-            return RpcResult(call.rid, True, value,
-                             trace=getattr(call, "trace", None))
+            return self._rpc_remember(
+                key, RpcResult(call.rid, True, value,
+                               trace=getattr(call, "trace", None)))
         except Exception as e:                 # noqa: BLE001 — RPC boundary
-            return RpcResult(call.rid, False, None,
-                             f"{type(e).__name__}: {e}",
-                             getattr(e, "errno", 0) or 0,
-                             trace=getattr(call, "trace", None))
+            return self._rpc_remember(
+                key, RpcResult(call.rid, False, None,
+                               f"{type(e).__name__}: {e}",
+                               getattr(e, "errno", 0) or 0,
+                               trace=getattr(call, "trace", None)))
         finally:
             # RPC latency lands in the wire histogram whether the call
             # succeeded or not — a failing method is still served time
             self.wire.observe_rpc(call.method,
                                   time.perf_counter() - t0)
+
+    def _rpc_remember(self, key, res: RpcResult) -> RpcResult:
+        if key is None:
+            return res
+        with self._rpc_cache_lock:
+            if key not in self._rpc_cache:
+                self._rpc_cache_order.append(key)
+                while len(self._rpc_cache_order) > self.RPC_CACHE_MAX:
+                    self._rpc_cache.pop(self._rpc_cache_order.pop(0),
+                                        None)
+            self._rpc_cache[key] = res
+            ev = self._rpc_inflight.pop(key, None)
+        if ev is not None:
+            ev.set()
+        return res
 
     def _rpc_mkpool(self, ch, name, profile=None, pg_num=8,
                     replicated=False, size=3):
@@ -671,35 +832,121 @@ class TcpRados:
 
     ``keyring`` is the path the server wrote (client.admin.keyring) —
     reading it from the shared filesystem IS the secret distribution.
+
+    Self-healing (ISSUE 9): the link dropping (reset, truncated frame,
+    server bounce) no longer kills the handle — :meth:`call` reconnects
+    with bounded full-jitter exponential backoff and RESENDS the rpc
+    under its original (session, rid) reqid, which the server dedups, so
+    a reset between send and reply is neither a lost op nor a double
+    apply.  A per-RPC deadline (``ms_rpc_timeout``) bounds the whole
+    dance; a black-holed request times out per attempt and resends.
     """
 
-    def __init__(self, host: str, port: int, keyring: str | os.PathLike):
+    def __init__(self, host: str, port: int, keyring: str | os.PathLike,
+                 cct=None):
+        from .common import default_context
+        self._conf = (cct if cct is not None else default_context()).conf
+        self._host, self._port = host, port
         with open(keyring, "rb") as f:
             saved = pickle.load(f)
-        self._cephx = CephxClient("client.admin", saved["key"])
-        sock = socket.create_connection((host, port))
-        self.ch = Channel(sock)
-        self._handshake()
+        self._key = saved["key"]
+        import uuid
+        self._session = uuid.uuid4().hex    # the reqid namespace
         self._rid = 0
         self._lock = threading.Lock()
         self._pending: dict[int, list] = {}
+        # rids a call() is actively waiting on: the reader DROPS replies
+        # for anything else (a late duplicate reply after a resend must
+        # not recreate a popped _pending entry and pin its payload)
+        self._waiting: set[int] = set()
         self._cond = threading.Condition()
         self._watch_cbs: dict[int, object] = {}
-        self._reader = threading.Thread(target=self._read_loop, daemon=True)
+        self._watch_pools: dict[int, tuple] = {}   # cookie -> (pool, oid)
+        self._dead = True
+        self._closed = False
+        # serializes reconnect attempts: two callers seeing _dead at
+        # once must not dial two connections and clobber self.ch
+        self._conn_lock = threading.Lock()
+        self.reconnects = 0                 # successful re-dials
+        self.resends = 0                    # rpc attempts after the first
+        self.ch: Channel | None = None
+        self._connect()
+
+    def _connect(self) -> None:
+        """Dial + handshake + reader thread (one connection's worth).
+        The new channel is PUBLISHED only after the handshake succeeds,
+        so concurrent senders never see a half-authenticated ``self.ch``
+        (the old, closed channel stays in place until then — their sends
+        fail with OSError and their retry loops come back around)."""
+        self._cephx = CephxClient("client.admin", self._key)
+        sock = socket.create_connection((self._host, self._port),
+                                        timeout=10.0)
+        sock.settimeout(None)
+        ch = Channel(sock)
+        try:
+            self._handshake(ch)
+        except BaseException:
+            ch.close()
+            raise
+        self.ch = ch
+        with self._cond:
+            self._dead = False
+        self._reader = threading.Thread(target=self._read_loop,
+                                        daemon=True)
         self._reader.start()
 
-    def _handshake(self) -> None:
+    def _reconnect(self) -> None:
+        """Bounded reconnect: full-jitter exponential backoff between
+        attempts (failure/backoff.py), then re-register watches.  Raises
+        RetriesExhausted when the budget runs out.  Serialized: a second
+        caller blocks on the lock and returns as soon as the first
+        caller's fresh connection is up."""
+        from .failure.backoff import ExponentialBackoff
+        with self._conn_lock:
+            if self._closed:
+                # a concurrent close() must not be raced back to life by
+                # an in-flight call's retry loop
+                raise ConnectionError("client closed")
+            with self._cond:
+                if not self._dead:
+                    return              # someone else already re-dialed
+            old = self.ch
+            if old is not None:
+                old.close()
+            ExponentialBackoff(
+                base=float(self._conf.get("ms_reconnect_backoff_base")),
+                cap=float(self._conf.get("ms_reconnect_backoff_cap")),
+                max_attempts=int(
+                    self._conf.get("ms_reconnect_max_attempts")),
+            ).run(self._connect, retry_on=(ConnectionError, OSError,
+                                           AuthError, WireError))
+            self.reconnects += 1
+        # watches live server-side per CONNECTION: re-arm them on the new
+        # one (one shot each; a failure here just surfaces on the next
+        # call's own retry loop)
+        for cookie in list(self._watch_cbs):
+            try:
+                self._call_once(self._next_rid(), "watch",
+                                {"pool": self._watch_pools[cookie][0],
+                                 "oid": self._watch_pools[cookie][1],
+                                 "cookie": cookie},
+                                timeout=NOTIFY_TIMEOUT)
+            except (KeyError, ConnectionError, OSError, IOError,
+                    TimeoutError):
+                pass
+
+    def _handshake(self, ch: Channel) -> None:
         from .auth.cephx import _proof, unseal
         now = time.time()
         cx = self._cephx
-        self.ch.send(CephxBegin(cx.name))
-        challenge = self.ch.recv_one()
+        ch.send(CephxBegin(cx.name))
+        challenge = ch.recv_one()
         if not isinstance(challenge, CephxChallenge):
             raise AuthError("expected CephxChallenge")
         client_challenge = os.urandom(16)
         proof = _proof(cx.key, challenge.challenge, client_challenge)
-        self.ch.send(CephxAuthenticate(client_challenge, proof))
-        sess = self.ch.recv_one()
+        ch.send(CephxAuthenticate(client_challenge, proof))
+        sess = ch.recv_one()
         if not isinstance(sess, CephxSession):
             raise AuthError("expected CephxSession")
         cx.session_key = unseal(cx.key, sess.env)["session_key"]
@@ -709,31 +956,38 @@ class TcpRados:
             service=SERVICE, blob=t["blob"], secret_id=t["secret_id"],
             session_key=t["session_key"], expires=t["expires"])
         authz = cx.build_authorizer(SERVICE, now)
-        self.ch.send(CephxAuthorize(authz))
-        done = self.ch.recv_one()
+        ch.send(CephxAuthorize(authz))
+        done = ch.recv_one()
         if not isinstance(done, CephxDone):
             raise AuthError("expected CephxDone")
         cx.verify_reply(SERVICE, done.reply, authz.nonce)  # mutual auth
         # both ends switch to HMAC frames under the service session key
-        self.ch.secure(cx.tickets[SERVICE].session_key)
+        ch.secure(cx.tickets[SERVICE].session_key)
 
     # -- reader / correlation ------------------------------------------------
 
     def _read_loop(self) -> None:
+        ch = self.ch
         try:
             while True:
-                for msg in self.ch.recv_msgs():
+                for msg in ch.recv_msgs():
                     if isinstance(msg, RpcResult):
                         with self._cond:
-                            self._pending.setdefault(msg.rid, []).append(
-                                msg)
-                            self._cond.notify_all()
+                            if msg.rid in self._waiting:
+                                self._pending.setdefault(
+                                    msg.rid, []).append(msg)
+                                self._cond.notify_all()
+                            # else: a late duplicate of an answered
+                            # call — drop it, don't pin its payload
                     elif isinstance(msg, NotifyPush):
                         threading.Thread(target=self._run_watch_cb,
                                          args=(msg,), daemon=True).start()
         except (ConnectionError, WireError, OSError):
+            # the link died (reset, truncated frame, server gone): flag
+            # it and wake every waiter — call() reconnects and resends
             with self._cond:
-                self._pending["dead"] = [ConnectionError("link down")]
+                if self.ch is ch:         # not already superseded
+                    self._dead = True
                 self._cond.notify_all()
 
     def _run_watch_cb(self, push: NotifyPush) -> None:
@@ -744,29 +998,107 @@ class TcpRados:
                 value = cb(push.notify_id, push.cookie, push.payload)
             except Exception as e:             # noqa: BLE001
                 value = repr(e)
-        self.ch.send(NotifyAck(push.cookie, push.notify_id, value))
+        try:
+            self.ch.send(NotifyAck(push.cookie, push.notify_id, value))
+        except (ConnectionError, OSError, AttributeError):
+            # link died under the ack (or is mid-reconnect): the server's
+            # notify times out and reports it — nothing to heal here
+            pass
 
-    def call(self, method: str, **args):
+    def _next_rid(self) -> int:
         with self._lock:
             self._rid += 1
-            rid = self._rid
-        # stamp the call with this thread's active trace (or a fresh
-        # client root): the server side activates it around dispatch
+            return self._rid
+
+    def _call_once(self, rid: int, method: str, args: dict,
+                   timeout: float):
+        """One send + one bounded wait on the CURRENT connection.
+        Raises ConnectionError (link died) or TimeoutError (no reply —
+        e.g. a black-holed request) for the retry loop to handle."""
         from .common.tracer import default_tracer
         tr = default_tracer()
         ctx = tr.current_ctx() or tr.new_trace("client")
-        self.ch.send(RpcCall(rid, method, args, trace=ctx))
+        self.ch.send(RpcCall(rid, method, args, trace=ctx,
+                             session=self._session))
+        deadline = time.monotonic() + timeout
         with self._cond:
             while not self._pending.get(rid):
-                if self._pending.get("dead"):
+                if self._dead:
                     raise ConnectionError("link down")
-                self._cond.wait(30.0)
-            res = self._pending.pop(rid)[0]
-        if not res.ok:
-            err = IOError(res.error)
-            err.errno = res.errno
-            raise err
-        return res.value
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    raise TimeoutError(
+                        f"rpc {method} rid={rid}: no reply within "
+                        f"{timeout:.1f}s")
+                self._cond.wait(left)
+            return self._pending.pop(rid)[0]
+
+    def call(self, method: str, timeout: float | None = None, **args):
+        """One RPC under the self-healing contract: bounded resends
+        (``ms_rpc_retry_attempts``) within one overall deadline
+        (``ms_rpc_timeout``), reconnecting with backoff as needed; the
+        stable (session, rid) reqid makes every resend dedup-safe."""
+        if self._closed:
+            raise ConnectionError("client closed")
+        total = float(self._conf.get("ms_rpc_timeout")
+                      if timeout is None else timeout)
+        attempts = int(self._conf.get("ms_rpc_retry_attempts"))
+        per_attempt = max(0.05, total / attempts)
+        deadline = time.monotonic() + total
+        rid = self._next_rid()
+        with self._cond:
+            self._waiting.add(rid)
+        try:
+            return self._call_with_retries(rid, method, args, total,
+                                           attempts, per_attempt,
+                                           deadline)
+        finally:
+            with self._cond:
+                self._waiting.discard(rid)
+                self._pending.pop(rid, None)   # no ghost replies later
+
+    def _call_with_retries(self, rid, method, args, total, attempts,
+                           per_attempt, deadline):
+        last: BaseException | None = None
+        timeouts = 0
+        for attempt in range(attempts):
+            if attempt:
+                self.resends += 1
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            try:
+                if self._dead:
+                    self._reconnect()
+                res = self._call_once(rid, method, args,
+                                      min(per_attempt, remaining))
+            except TimeoutError as e:
+                last = e                  # black-holed: resend, same rid
+                timeouts += 1
+                if timeouts >= 2:
+                    # two silent attempts on one connection: suspect a
+                    # HALF-OPEN link (peer died without RST) — force a
+                    # re-dial rather than shouting into the void again
+                    with self._cond:
+                        self._dead = True
+                continue
+            except (ConnectionError, OSError) as e:
+                last = e                  # link died mid-call: mark it
+                with self._cond:          # dead so the next attempt
+                    self._dead = True     # re-dials instead of resending
+                continue                  # on the same broken channel
+            if not res.ok:
+                err = IOError(res.error)
+                err.errno = res.errno
+                raise err
+            return res.value
+        if isinstance(last, TimeoutError):
+            raise TimeoutError(f"rpc {method}: no reply within "
+                               f"{total:.1f}s ({attempts} attempts)") \
+                from last
+        raise ConnectionError(
+            f"rpc {method}: link down after {attempts} attempts") \
+            from last
 
     # -- convenience surface -------------------------------------------------
 
@@ -805,10 +1137,12 @@ class TcpRados:
 
     def watch(self, pool, oid, cookie: int, on_notify):
         self._watch_cbs[cookie] = on_notify
+        self._watch_pools[cookie] = (pool, oid)
         return self.call("watch", pool=pool, oid=oid, cookie=cookie)
 
     def unwatch(self, pool, oid, cookie: int):
         self._watch_cbs.pop(cookie, None)
+        self._watch_pools.pop(cookie, None)
         return self.call("unwatch", pool=pool, oid=oid, cookie=cookie)
 
     def notify(self, pool, oid, payload: bytes) -> dict:
@@ -816,4 +1150,13 @@ class TcpRados:
                          payload=bytes(payload))
 
     def close(self) -> None:
-        self.ch.close()
+        self._closed = True
+        with self._cond:
+            self._dead = True
+            self._cond.notify_all()
+        # under the conn lock: any reconnect in flight finishes first,
+        # then we close whatever channel is current — _closed above
+        # keeps later retry loops from dialing again
+        with self._conn_lock:
+            if self.ch is not None:
+                self.ch.close()

@@ -124,6 +124,29 @@ class RSCodec:
             device_attribution.record_batch(None, t0, host.nbytes)
             return host
 
+    def encode_host(self, data: np.ndarray) -> np.ndarray:
+        """Pure-host parity (the exact CPU reference path) REGARDLESS of
+        ``self.device`` — the circuit breaker's fallback when the device
+        side is failing: data [k, N] uint8 -> parity [m, N]."""
+        with trace_span("codec.encode_host", k=self.k, m=self.m,
+                        n=int(data.shape[-1])):
+            return gfref.apply_matrix_fast(
+                self.parity_mat, np.ascontiguousarray(data,
+                                                      dtype=np.uint8))
+
+    def decode_host(self, stack: np.ndarray, erasures: list[int],
+                    available: list[int]) -> np.ndarray:
+        """Pure-host recovery, device never touched: ``stack`` [k', N]
+        survivors already in the ``src`` order ``decode_matrix(erasures,
+        available)`` returns -> recovered rows [len(erasures), N].  The
+        host sibling of :meth:`decode_device` for breaker fallback."""
+        entry = self._decode_entry(sorted(int(e) for e in erasures),
+                                   available=list(available))
+        with trace_span("codec.decode_host", k=self.k, m=self.m,
+                        n=int(stack.shape[-1]), erasures=len(erasures)):
+            return gfref.apply_matrix_fast(
+                entry.D, np.ascontiguousarray(stack, dtype=np.uint8))
+
     def _upload_parity(self) -> None:
         if self._parity_dev is None:
             with trace_span("codec.table_upload",

@@ -49,6 +49,8 @@ from ..common import default_context
 from ..common import device_attribution
 from ..common.perf_counters import PerfCountersBuilder
 from ..common.tracer import trace_span
+from ..failure.breaker import CircuitBreaker, state_rank
+from ..failure.injector import InjectedFault, InjectedOOM
 
 DEPTH_BUCKETS = [0, 1, 2, 4, 8, 16, 32]
 
@@ -69,8 +71,9 @@ class PipelineFuture:
     timed sync — ``block_until_ready`` waits on the device unboundedly.
     """
 
-    __slots__ = ("kind", "meta", "owner", "_pipeline", "_packed", "_dev",
-                 "_unpack", "_dispatched_at", "_event", "_result", "_error",
+    __slots__ = ("kind", "meta", "owner", "fallback", "_pipeline",
+                 "_packed", "_dev", "_unpack", "_host_fallback",
+                 "_dispatched_at", "_event", "_result", "_error",
                  "_callbacks", "_cb_lock")
 
     def __init__(self, pipeline: "CodecPipeline", kind: str, meta: dict,
@@ -81,10 +84,14 @@ class PipelineFuture:
         # (common/device_attribution), resolved on the SUBMITTING thread
         # where the trace context is active
         self.owner = owner
+        # True when the sync host codec served this batch (breaker open
+        # or a device failure healed by the fallback)
+        self.fallback = False
         self._pipeline = weakref.ref(pipeline)
         self._packed = None
         self._dev = None
         self._unpack = None
+        self._host_fallback = None
         self._dispatched_at = 0.0
         self._event = threading.Event()
         self._result = None
@@ -158,6 +165,13 @@ def _build_perf(name: str):
             .add_u64_counter("mesh_dispatches",
                              "batches split across the device mesh's dp "
                              "axis (jax_rs_mesh_devices engaged)")
+            .add_u64_counter("host_fallbacks",
+                             "batches served by the sync host codec "
+                             "because the device breaker was open or "
+                             "the device failed with a fallback in hand")
+            .add_u64("breaker_state",
+                     "circuit breaker state (0 closed, 1 half-open "
+                     "probe in flight, 2 open: device path bypassed)")
             .add_histogram("inflight_depth", DEPTH_BUCKETS,
                            "in-flight depth observed at each dispatch")
             .add_time_avg("pack_time", "host pack stage (overlaps in-flight "
@@ -192,6 +206,19 @@ class CodecPipeline:
         self.cct.perf.add(self.perf)
         self._lock = threading.Lock()
         self._queue: collections.OrderedDict = collections.OrderedDict()
+        # circuit breaker on the device path (failure/breaker.py):
+        # pipeline_breaker_threshold consecutive device failures open it
+        # and fallback-capable submits run the sync host codec until a
+        # half-open probe (after pipeline_breaker_cooldown) re-closes.
+        # Threshold 0 disables (no breaker, pre-ISSUE-9 behavior).
+        thresh = int(conf.get("pipeline_breaker_threshold"))
+        self.breaker = CircuitBreaker(
+            f"{name}.breaker", threshold=thresh,
+            cooldown=float(conf.get("pipeline_breaker_cooldown"))) \
+            if thresh > 0 else None
+        # device-plane fault injection (failure/injector.py): when set,
+        # dispatch/completion rolls may raise InjectedFault/InjectedOOM
+        self.fault_injector = None
         self._mesh = None
         self._mesh_failed = False
         self._enc_steps: "weakref.WeakKeyDictionary" = \
@@ -202,13 +229,85 @@ class CodecPipeline:
 
     def close(self) -> None:
         """Drain and unhook the perf collection (the repo's discipline:
-        a discarded component must not leave frozen gauges behind)."""
+        a discarded component must not leave frozen gauges behind); the
+        breaker leaves the live registry so it stops raising
+        DEVICE_DEGRADED."""
         self.flush()
         self.cct.perf.remove(self.perf.name)
+        if self.breaker is not None:
+            self.breaker.close()
 
     def reopen(self) -> None:
-        """Re-register the perf collection after a close (engine restart)."""
+        """Re-register the perf collection AND the breaker after a close
+        (engine restart) — a reopened pipeline's breaker must be visible
+        to DEVICE_DEGRADED again."""
         self.cct.perf.add(self.perf)
+        if self.breaker is not None:
+            self.breaker.reopen()
+
+    # -- fault injection (device plane) ------------------------------------
+
+    def inject_faults(self, injector) -> None:
+        """Attach (or, with None, detach) a FaultInjector whose device
+        plane rolls dispatch/completion failures and simulated OOM into
+        this pipeline — the chaos harness hook."""
+        self.fault_injector = injector
+
+    def _roll_device_fault(self, stage: str) -> None:
+        inj = self.fault_injector
+        if inj is None:
+            return
+        f = inj.plan.device
+        if stage == "dispatch":
+            if inj.roll("device", "oom", f.oom_prob, target=self.name):
+                raise InjectedOOM("RESOURCE_EXHAUSTED: injected device "
+                                  "OOM at dispatch")
+            if inj.roll("device", "dispatch_fail", f.dispatch_fail_prob,
+                        target=self.name):
+                raise InjectedFault("injected device dispatch failure")
+        elif inj.roll("device", "completion_fail",
+                      f.completion_fail_prob, target=self.name):
+            raise InjectedFault("injected device completion failure")
+
+    # -- breaker bookkeeping -----------------------------------------------
+
+    def _device_failure(self) -> None:
+        if self.breaker is not None:
+            self.breaker.record_failure()
+            self.perf.set("breaker_state", state_rank(self.breaker.state))
+
+    def _device_success(self) -> None:
+        if self.breaker is not None:
+            self.breaker.record_success()
+            self.perf.set("breaker_state", 0)
+
+    def _serve_host(self, fut: PipelineFuture, host_fallback,
+                    unpack) -> PipelineFuture:
+        """Serve one batch entirely on the host codec (breaker open, or
+        a device failure with a fallback in hand).  The batch is marked
+        degraded in device attribution so `device top` shows how much
+        work the chip is NOT doing."""
+        fut.fallback = True
+        self.perf.inc("host_fallbacks")
+        if self.breaker is not None:
+            self.breaker.note_fallback()
+        try:
+            with trace_span("pipeline.host_fallback", kind=fut.kind,
+                            owner=fut.owner), \
+                    self.perf.time("complete_time"):
+                host = host_fallback(fut._packed)
+                result = unpack(fut._packed, host) \
+                    if unpack is not None else host
+            device_attribution.record_host_fallback(
+                fut.owner, getattr(host, "nbytes", 0) or 0)
+            self.perf.inc("completed")
+            fut._packed = fut._host_fallback = None
+            fut._finish(result, None)
+        except BaseException as e:              # noqa: BLE001 — the future
+            self.perf.inc("errors")             # carries the failure
+            fut._packed = fut._host_fallback = None
+            fut._finish(None, e)
+        return fut
 
     @property
     def in_flight(self) -> int:
@@ -218,29 +317,51 @@ class CodecPipeline:
     # -- submission --------------------------------------------------------
 
     def submit(self, pack, dispatch, unpack, kind: str = "op",
-               owner: str | None = None, **meta) -> PipelineFuture:
+               owner: str | None = None, host_fallback=None,
+               **meta) -> PipelineFuture:
         """Run ``pack()`` (host) and ``dispatch(packed)`` (async device
         launch) NOW; defer ``unpack(packed, host_arrays)`` to the
         completion boundary.  Returns the future; errors in any stage
         land on it.  ``owner`` tags the batch's device occupancy
         (client/serving/recovery/scrub/rebalance); when omitted it
-        resolves from the active TraceContext's op class."""
+        resolves from the active TraceContext's op class.
+
+        ``host_fallback(packed)`` — when provided — is the sync host
+        codec's answer to the same batch: it serves the batch when the
+        circuit breaker is open (skipping the doomed dispatch entirely)
+        and HEALS a batch whose dispatch or device compute fails, so a
+        dying device degrades throughput instead of failing ops."""
         fut = PipelineFuture(self, kind, meta,
                              owner=device_attribution.resolve_owner(owner))
         self.perf.inc("submitted")
+        # pack is host work: its failures are the caller's bug, never
+        # breaker evidence — keep it outside the device try
         try:
             with trace_span("pipeline.pack", kind=kind, owner=fut.owner), \
                     self.perf.time("pack_time"):
                 packed = pack() if pack is not None else None
             fut._packed = packed
+        except BaseException as e:              # noqa: BLE001 — the future
+            self.perf.inc("errors")             # carries the failure
+            fut._finish(None, e)
+            return fut
+        if host_fallback is not None and self.breaker is not None \
+                and not self.breaker.allow():
+            return self._serve_host(fut, host_fallback, unpack)
+        try:
+            self._roll_device_fault("dispatch")
             with trace_span("pipeline.dispatch", kind=kind,
                             owner=fut.owner), \
                     self.perf.time("dispatch_time"):
                 fut._dev = dispatch(packed)
             fut._dispatched_at = device_attribution.dispatch_mark()
             fut._unpack = unpack
+            fut._host_fallback = host_fallback
         except BaseException as e:              # noqa: BLE001 — the future
-            self.perf.inc("errors")             # carries the failure
+            self._device_failure()              # carries the failure ...
+            if host_fallback is not None:       # ... unless the host can
+                return self._serve_host(fut, host_fallback, unpack)
+            self.perf.inc("errors")
             fut._finish(None, e)
             return fut
         with self._lock:
@@ -272,12 +393,15 @@ class CodecPipeline:
             fut._event.wait()
             return fut
         result, error = None, None
-        recorded = False
+        recorded = device_ok = False
         try:
             with trace_span("pipeline.complete", kind=fut.kind,
                             owner=fut.owner), \
                     self.perf.time("complete_time"):
+                self._roll_device_fault("completion")
                 dev = jax.block_until_ready(fut._dev)
+                device_ok = True
+                self._device_success()
                 nbytes = getattr(dev, "nbytes", 0) or 0
                 # device occupancy ends at block_until_ready: the
                 # device_get transfer (slow over the axon tunnel) and the
@@ -292,13 +416,22 @@ class CodecPipeline:
                     if fut._unpack is not None else host
         except BaseException as e:              # noqa: BLE001 — device-side
             error = e                           # failures surface on the
-            self.perf.inc("errors")             # future, not the completer
-            if not recorded:
+            if not recorded:                    # future, not the completer
                 # the chip was busy up to the failure either way
                 device_attribution.record_batch(fut.owner,
                                                 fut._dispatched_at, 0)
+            if not device_ok:
+                self._device_failure()
+                if fut._host_fallback is not None:
+                    # a completion-boundary device failure with the host
+                    # answer in hand: heal the batch instead of failing it
+                    fallback, unpack = fut._host_fallback, fut._unpack
+                    fut._dev = fut._unpack = None
+                    return self._serve_host(fut, fallback, unpack)
+            self.perf.inc("errors")
         self.perf.inc("completed")
-        fut._packed = fut._dev = fut._unpack = None   # free buffers promptly
+        # free buffers promptly
+        fut._packed = fut._dev = fut._unpack = fut._host_fallback = None
         fut._finish(result, error)
         return fut
 
@@ -373,6 +506,15 @@ class CodecPipeline:
         self.perf.inc("mesh_dispatches")
         parity = jnp.swapaxes(parity[:stripes], 0, 1)
         return parity.reshape(codec.m, total)
+
+    def host_encode(self, codec, data_shards, chunk_size: int):
+        """The sync-host mirror of :meth:`dispatch_encode` — the
+        ``host_fallback`` the ecutil pipelined entries hand to submit."""
+        return codec.encode_host(data_shards)
+
+    def host_decode(self, codec, stack, erasures, available):
+        """The sync-host mirror of :meth:`dispatch_decode`."""
+        return codec.decode_host(stack, erasures, available)
 
     def dispatch_decode(self, codec, stack, erasures, available):
         """``stack`` [k', S*chunk] host uint8 survivors in the sorted-src

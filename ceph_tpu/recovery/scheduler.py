@@ -227,6 +227,12 @@ class RecoveryScheduler:
         _SCHEDULERS.discard(self)
         self.jobs.clear()
 
+    def inject_device_faults(self, injector) -> None:
+        """Route the device-plane fault injection (failure/) through the
+        scheduler's shared wave pipeline — the chaos harness hook."""
+        if self.pipeline is not None:
+            self.pipeline.inject_faults(injector)
+
     # -- conf --------------------------------------------------------------
 
     def _conf(self, key: str):

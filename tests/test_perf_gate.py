@@ -47,6 +47,32 @@ class TestEvaluate:
         assert not res["ok"]
         assert any("recovery.mib_s" in f for f in res["failures"])
 
+    def test_resilience_block_gated(self):
+        """ISSUE 9: the `resilience` block participates — a goodput-
+        ratio collapse or a breaker-fallback-throughput cliff past the
+        (loose, wall-clock-noisy) 30% threshold fails the round; a
+        within-threshold wiggle passes."""
+        def rline(ratio=0.8, fallback=200.0):
+            line = _line()
+            line["resilience"] = {
+                "device": "tpu", "goodput_ratio": ratio,
+                "breaker": {"fallback_mib_s": fallback, "opens": 1}}
+            return line
+        res = perf_gate.evaluate(rline(), rline())
+        assert res["ok"] and len(res["compared"]) == 10
+        res = perf_gate.evaluate(rline(ratio=0.4), rline(ratio=0.8))
+        assert not res["ok"]
+        assert any("resilience.goodput_ratio" in f
+                   for f in res["failures"])
+        res = perf_gate.evaluate(rline(fallback=100.0),
+                                 rline(fallback=200.0))
+        assert not res["ok"]
+        assert any("resilience.fallback_mib_s" in f
+                   for f in res["failures"])
+        # 20% off is inside the loose 30% band for this metric
+        res = perf_gate.evaluate(rline(ratio=0.65), rline(ratio=0.8))
+        assert res["ok"]
+
     def test_wire_efficiency_regression_direction_is_up(self):
         """Wire metrics gate on INCREASE: repair moving more bytes on
         the wire per byte repaired (or serving per op) is the

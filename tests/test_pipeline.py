@@ -155,6 +155,9 @@ def test_injected_failure_surfaces_on_future():
 
 def test_engine_surfaces_pipeline_failure_on_batch_future(ec, sinfo,
                                                           monkeypatch):
+    """With BOTH the device dispatch and the host fallback failing the
+    error surfaces on the batch future (ISSUE 9: a lone device failure
+    is healed by the breaker's host fallback — see the sibling test)."""
     eng = ServingEngine(ec_impl=ec, sinfo=sinfo, name="efail",
                         pipeline_depth=4)
     try:
@@ -162,11 +165,40 @@ def test_engine_surfaces_pipeline_failure_on_batch_future(ec, sinfo,
             CodecPipeline, "dispatch_encode",
             lambda self, codec, data, chunk: (_ for _ in ()).throw(
                 RuntimeError("injected")))
+        monkeypatch.setattr(
+            CodecPipeline, "host_encode",
+            lambda self, codec, data, chunk: (_ for _ in ()).throw(
+                RuntimeError("injected host too")))
         fut = eng.submit_encode(_payloads(1)[0])
         eng.flush()
         with pytest.raises(RuntimeError, match="injected"):
             fut.result(5)
         assert eng.perf.get("ops_failed") == 1
+    finally:
+        eng.stop()
+
+
+def test_engine_heals_device_failure_via_host_fallback(ec, sinfo,
+                                                       monkeypatch):
+    """A device dispatch failure with the host codec available: the op
+    SUCCEEDS (host-served), nothing fails, the fallback is counted."""
+    eng = ServingEngine(ec_impl=ec, sinfo=sinfo, name="eheal",
+                        pipeline_depth=4)
+    try:
+        monkeypatch.setattr(
+            CodecPipeline, "dispatch_encode",
+            lambda self, codec, data, chunk: (_ for _ in ()).throw(
+                RuntimeError("device down")))
+        buf = _payloads(1)[0]
+        fut = eng.submit_encode(buf)
+        eng.flush()
+        chunks = fut.result(5)
+        from ceph_tpu.backend import ecutil
+        assert {k: bytes(v) for k, v in chunks.items()} == \
+            {k: bytes(v) for k, v in
+             ecutil.encode(sinfo, ec, bytes(buf)).items()}
+        assert eng.perf.get("ops_failed") == 0
+        assert eng.pipeline.perf.get("host_fallbacks") >= 1
     finally:
         eng.stop()
 

@@ -1,0 +1,280 @@
+#!/usr/bin/env python
+"""chaos_run: a seeded fault-injection campaign against a real TCP cluster.
+
+The reproducible harness the thrash soaks improvise per-test (ISSUE 9;
+the role qa/tasks/ceph_manager.py's Thrasher plays in the reference):
+ONE seed drives every fault plane against a live ``MiniCluster`` served
+over real sockets, and the campaign asserts the self-healing invariants
+while it runs:
+
+1. **Faulted traffic** — puts/gets through ``TcpRados`` while the server
+   injects connection resets, black-holed requests, truncated frames and
+   send delays, the bus reorders/duplicates, and stores stall reads.
+   Every ACKED write must read back intact (reconnect + resend + reqid
+   dedup make the acks honest).
+2. **Flapping OSD** — one OSD cycles down/up through the monitor until
+   flap damping trips: the boot is REFUSED, ``OSD_FLAPPING`` raises, an
+   operator clear + boot brings it back and the check clears.
+3. **Device breaker** — injected dispatch failures trip the codec
+   pipeline's circuit breaker: batches keep succeeding through the sync
+   host fallback (bitwise-identical parity), ``DEVICE_DEGRADED`` raises;
+   with injection off, the half-open probe re-closes and health clears.
+4. **Drain** — recovery reservations drain to zero and every acked
+   write verifies, through the TCP client AND the local surface.
+
+Two runs with the same seed produce the same injected-event digest —
+the reproducibility receipt printed in the report.
+
+Usage:
+    python tools/chaos_run.py [--seed N] [--ops N] [--json FILE]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import random
+import shutil
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+K, M = 2, 1
+CHUNK = 256
+STRIPE = K * CHUNK
+
+PROFILE = {"plugin": "jax_rs", "k": str(K), "m": str(M),
+           "device": "numpy", "technique": "reed_sol_van"}
+
+
+def _campaign_context():
+    from ceph_tpu.common import Context
+    return Context(overrides={
+        # short timelines so the campaign heals in seconds, not minutes
+        "ms_rpc_timeout": 8.0,
+        "ms_rpc_retry_attempts": 4,
+        "ms_reconnect_backoff_base": 0.01,
+        "ms_reconnect_backoff_cap": 0.05,
+        "osd_markdown_count": 3,
+        "osd_markdown_window": 1000.0,
+        "pipeline_breaker_threshold": 2,
+        "pipeline_breaker_cooldown": 0.05,
+    })
+
+
+def _health_checks(cluster) -> set[str]:
+    return set(cluster.health().get("checks", ()))
+
+
+def run_campaign(seed: int = 7, ops: int = 40, data_dir=None,
+                 verbose: bool = False) -> dict:
+    """One full campaign; returns the report dict (raises AssertionError
+    on any invariant violation)."""
+    from ceph_tpu.backend import ecutil
+    from ceph_tpu.backend.ecutil import StripeInfo
+    from ceph_tpu.cluster import MiniCluster
+    from ceph_tpu.failure import (FaultConfig, FaultPlan, StoreFaults,
+                                  TransportFaults)
+    from ceph_tpu.net import ClusterServer, TcpRados
+    from ceph_tpu.ops.pipeline import CodecPipeline
+    from ceph_tpu.plugins.registry import ErasureCodePluginRegistry
+
+    def say(msg):
+        if verbose:
+            print(f"[chaos seed={seed}] {msg}", flush=True)
+
+    own_dir = None
+    if data_dir is None:
+        own_dir = tempfile.mkdtemp(prefix="chaos_run_")
+        data_dir = own_dir
+    cct = _campaign_context()
+    cluster = MiniCluster(n_osds=9, osds_per_host=3, chunk_size=CHUNK,
+                          cct=cct, data_dir=data_dir)
+    cluster.enable_recovery_scheduler()
+    plan = FaultPlan(
+        seed=seed,
+        bus=FaultConfig(reorder=True, dup_prob=0.15),
+        transport=TransportFaults(reset_prob=0.04, blackhole_prob=0.03,
+                                  truncate_prob=0.02, delay_prob=0.10,
+                                  delay_ms=2.0),
+        store=StoreFaults(slow_read_prob=0.05, slow_read_ms=1.0))
+    inj = cluster.inject_faults(plan)
+    server = ClusterServer(cluster)
+    server.inject_faults(inj)
+    server.start()
+    mon = cluster.attach_monitor()
+    health_seen: set[str] = set()
+    report: dict = {"seed": seed, "ops": ops}
+    client = None
+    try:
+        client = TcpRados("127.0.0.1", server.port,
+                          Path(data_dir) / "client.admin.keyring", cct=cct)
+        client.mkpool("chaos", profile=dict(PROFILE), pg_num=4)
+        pid = cluster.pool_ids["chaos"]
+
+        # -- phase 1: acked writes + reads under transport+bus+store chaos
+        say("phase 1: faulted traffic")
+        rng = random.Random(f"workload:{seed}")
+        model: dict[str, bytes] = {}
+        for i in range(ops):
+            oid = f"obj{i % max(1, ops // 2)}"
+            data = rng.randbytes(2 * STRIPE)
+            client.put("chaos", oid, data)      # acked == durable
+            model[oid] = data
+            if i % 5 == 4:
+                check = sorted(model)[rng.randrange(len(model))]
+                got = client.get("chaos", check)
+                assert got == model[check], \
+                    f"read of acked {check} diverged under injection"
+        health_seen |= _health_checks(cluster)
+
+        # -- phase 2: flapping OSD -> damping -> operator clear
+        say("phase 2: flapping OSD")
+        primaries = {g.backend.whoami
+                     for g in cluster.pools[pid]["pgs"].values()}
+        victim = min(set(range(9)) - primaries - {0})
+        hosts = {o: o // 3 for o in range(9)}
+        reporters = [o for o in range(9)
+                     if hosts[o] != hosts[victim] and o != victim]
+        rep_a = reporters[0]
+        rep_b = next(o for o in reporters if hosts[o] != hosts[rep_a])
+        now, denied_at = 100.0, None
+        for cycle in range(5):
+            now += 30.0
+            mon.prepare_failure(victim, rep_a, failed_since=now - 25.0,
+                                now=now)
+            mon.prepare_failure(victim, rep_b, failed_since=now - 25.0,
+                                now=now)
+            mon.propose_pending(now)
+            assert cluster.osdmap.is_down(victim), \
+                f"flap cycle {cycle}: victim not marked down"
+            health_seen |= _health_checks(cluster)
+            booted = mon.osd_boot(victim, now=now + 1.0)
+            mon.propose_pending(now + 1.0)
+            if not booted:
+                denied_at = cycle
+                break
+        assert denied_at is not None, "flap damping never tripped"
+        assert cluster.osdmap.is_down(victim)
+        checks = _health_checks(cluster)
+        health_seen |= checks
+        assert "OSD_FLAPPING" in checks, \
+            f"OSD_FLAPPING not raised: {checks}"
+        mon.clear_markdown(victim)
+        assert mon.osd_boot(victim, now=now + 2.0)
+        mon.propose_pending(now + 2.0)
+        assert cluster.osdmap.is_up(victim)
+        assert "OSD_FLAPPING" not in _health_checks(cluster), \
+            "OSD_FLAPPING did not clear after operator clear + boot"
+        report["flap"] = {"victim": victim, "denied_at_cycle": denied_at}
+
+        # -- phase 3: device breaker -> host fallback -> probe re-close
+        say("phase 3: device breaker")
+        ec_dev = ErasureCodePluginRegistry.instance().factory(
+            "jax_rs", "", {**PROFILE, "device": "jax"})
+        sinfo = StripeInfo(K, CHUNK)
+        pipeline = CodecPipeline(depth=2, name=f"chaos{seed}.pipeline",
+                                 cct=cct)
+        try:
+            pipeline.inject_faults(inj)
+            plan.device.dispatch_fail_prob = 1.0
+            bufs = [rng.randbytes(2 * STRIPE) for _ in range(6)]
+            futs = [ecutil.encode_many_pipelined(sinfo, ec_dev, [b],
+                                                 pipeline)
+                    for b in bufs]
+            pipeline.flush()
+            for buf, fut in zip(bufs, futs):
+                got = fut.result(30)[0]
+                want = ecutil.encode(sinfo, ec_dev, buf)
+                assert {c: bytes(v) for c, v in got.items()} == \
+                    {c: bytes(v) for c, v in want.items()}, \
+                    "host-fallback parity diverged from sync encode"
+            assert pipeline.breaker.state == "open", \
+                f"breaker did not open: {pipeline.breaker.dump()}"
+            checks = _health_checks(cluster)
+            health_seen |= checks
+            assert "DEVICE_DEGRADED" in checks, \
+                f"DEVICE_DEGRADED not raised: {checks}"
+            # injection off; after the cooldown the next submit probes
+            plan.device.dispatch_fail_prob = 0.0
+            time.sleep(0.06)
+            probe = ecutil.encode_many_pipelined(sinfo, ec_dev,
+                                                 [bufs[0]], pipeline)
+            pipeline.flush()
+            probe.result(30)
+            assert pipeline.breaker.state == "closed", \
+                f"half-open probe did not re-close: " \
+                f"{pipeline.breaker.dump()}"
+            assert "DEVICE_DEGRADED" not in _health_checks(cluster), \
+                "DEVICE_DEGRADED did not clear after the breaker closed"
+            report["breaker"] = pipeline.breaker.dump()
+        finally:
+            pipeline.close()
+
+        # -- phase 4: drain + verify every acked write, both surfaces
+        say("phase 4: drain + verify")
+        for _ in range(20):
+            cluster.deliver_all()
+            if cluster.recovery.job_counts() == (0, 0):
+                break
+        assert cluster.recovery.job_counts() == (0, 0), \
+            f"recovery reservations not drained: " \
+            f"{cluster.recovery.job_counts()}"
+        for oid, want in sorted(model.items()):
+            assert client.get("chaos", oid) == want, \
+                f"acked write {oid} lost (TCP read)"
+            assert cluster.get(pid, oid, len(want)) == want, \
+                f"acked write {oid} lost (local read)"
+
+        report.update({
+            "ok": True,
+            "acked_writes": len(model),
+            "verified": len(model),
+            "events": inj.summary(),
+            "event_digest": inj.event_digest(),
+            "transport": {"reconnects": client.reconnects,
+                          "resends": client.resends,
+                          "rpc_dedup_hits": server.rpc_dedup_hits},
+            "health_seen": sorted(health_seen),
+        })
+        say(f"done: {report['events']['total']} events, digest "
+            f"{report['event_digest'][:12]}")
+        return report
+    finally:
+        if client is not None:
+            client.close()
+        server.stop()
+        cluster.shutdown()
+        if own_dir is not None:
+            shutil.rmtree(own_dir, ignore_errors=True)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--ops", type=int, default=40,
+                    help="client writes in the faulted-traffic phase")
+    ap.add_argument("--data-dir", default=None,
+                    help="durable cluster home (default: a temp dir)")
+    ap.add_argument("--json", default=None,
+                    help="write the report to this file")
+    ap.add_argument("-q", "--quiet", action="store_true")
+    args = ap.parse_args(argv)
+    try:
+        report = run_campaign(seed=args.seed, ops=args.ops,
+                              data_dir=args.data_dir,
+                              verbose=not args.quiet)
+    except AssertionError as e:
+        print(f"CHAOS FAIL: {e}", file=sys.stderr)
+        return 1
+    out = json.dumps(report, indent=2, default=str)
+    if args.json:
+        Path(args.json).write_text(out + "\n")
+    print(out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

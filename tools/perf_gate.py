@@ -54,6 +54,13 @@ METRIC_PATHS = {
     # what the hardware allows even if raw throughput held (e.g. more
     # dispatches doing the same work)
     "efficiency.pct_of_peak": (("efficiency", "pct_of_peak"), True),
+    # resilience (ISSUE 9): goodput under the fixed fault schedule as a
+    # fraction of the clean run (self-healing tax — a drop means retry/
+    # dedup/fallback machinery got more expensive), and the host-codec
+    # throughput floor while the device breaker is open
+    "resilience.goodput_ratio": (("resilience", "goodput_ratio"), True),
+    "resilience.fallback_mib_s": (("resilience", "breaker",
+                                   "fallback_mib_s"), True),
 }
 
 # fraction of regression tolerated per metric before the gate fails;
@@ -65,7 +72,12 @@ DEFAULT_THRESHOLD = 0.10
 # join divides modeled work by dispatch WALL seconds, which on a shared
 # cpu host is the noisiest number the gate carries — gate it loosely so
 # only a real efficiency cliff (not scheduler jitter) fails the round
-METRIC_THRESHOLDS = {"efficiency.pct_of_peak": 0.30}
+METRIC_THRESHOLDS = {"efficiency.pct_of_peak": 0.30,
+                     # both resilience numbers divide two wall-clock
+                     # measurements on a possibly-shared host: gate only
+                     # real cliffs, not scheduler jitter
+                     "resilience.goodput_ratio": 0.30,
+                     "resilience.fallback_mib_s": 0.30}
 
 _BLOCK_DEVICE = {
     "core.mib_s": ("device",),
@@ -76,6 +88,8 @@ _BLOCK_DEVICE = {
     "recovery.wire_per_byte": ("recovery", "device"),
     "serving.wire_per_op": ("serving", "device"),
     "efficiency.pct_of_peak": ("efficiency", "device"),
+    "resilience.goodput_ratio": ("resilience", "device"),
+    "resilience.fallback_mib_s": ("resilience", "device"),
 }
 
 
