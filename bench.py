@@ -304,6 +304,7 @@ _RECOVERY: dict | None = None    # the repair-throughput comparison block
 _PIPELINE: dict | None = None    # the async-pipeline comparison block
 _EFFICIENCY: dict | None = None  # the roofline device-efficiency block
 _RESILIENCE: dict | None = None  # goodput under faults + breaker fallback
+_SLO: dict | None = None         # critical-path attribution + budget block
 
 
 def _pipeline_pass(sinfo, ec, batches, degraded, depth: int,
@@ -707,6 +708,64 @@ def resilience_section(platform: str | None) -> dict:
         return {"device": "none", "error": repr(e)[:200]}
 
 
+def slo_section(platform: str | None) -> dict:
+    """The `slo` block (ISSUE 10): a short loaded MiniCluster pass whose
+    completed traces fold through the critical-path ledger into
+    per-class p99 + phase attribution, judged against a generous bench
+    objective so the artifact carries budget state too.
+    tools/perf_gate.py gates `slo.client_p99_ms` (regression = p99 rise)
+    and `slo.budget_remaining` (regression = budget burned);
+    tools/slo_report.py reproduces the attribution table from the block
+    alone."""
+    try:
+        from ceph_tpu.cluster import MiniCluster
+        from ceph_tpu.common import Context
+        device = "jax" if platform is not None else "numpy"
+        cct = Context(overrides={
+            # a generous objective: steady-state ops pass it easily, so
+            # budget_remaining ~1.0 and any real latency cliff shows as
+            # a burned budget in the gate
+            "slo_client_p99_ms": 250.0,
+            "slo_client_target": 0.9,
+            "slo_min_ops": 4,
+        })
+        with phase("slo"):
+            # the ledger folds the PROCESS tracer ring: drop the traces
+            # the earlier sections left there (resilience deliberately
+            # ran faulted traffic) so the gated p99/budget numbers
+            # measure THIS pass, not the chaos before it
+            from ceph_tpu.common.tracer import default_tracer
+            default_tracer().reset()
+            c = MiniCluster(n_osds=6, chunk_size=1024, cct=cct)
+            try:
+                pid = c.create_ec_pool(
+                    "slo", {"k": "4", "m": "2", "device": device,
+                            "technique": "reed_sol_van"}, pg_num=4)
+                rng = np.random.default_rng(11)
+                payload = rng.integers(0, 256, 8192, np.uint8).tobytes()
+                for i in range(24):
+                    c.put(pid, f"s{i}", payload)
+                for i in range(24):
+                    c.get(pid, f"s{i}", len(payload))
+                c.status()                      # fold + tick
+                c.critpath.refresh()
+                res = c.slo.bench_block(
+                    "tpu" if platform == "tpu" else "cpu")
+            finally:
+                c.shutdown()
+        cl = res.get("client") or {}
+        if cl:
+            from ceph_tpu.common.critpath import format_phase_mix
+            print(f"# slo: client p99 {cl['p99_ms']:.2f} ms over "
+                  f"{cl['ops']} ops ({format_phase_mix(cl['phases'])}); "
+                  f"budget {100 * cl.get('budget_remaining', 0):.0f}% "
+                  f"left", file=sys.stderr)
+        return res
+    except Exception as e:                 # never fail the artifact
+        print(f"# slo bench failed: {e!r}", file=sys.stderr)
+        return {"device": "none", "error": repr(e)[:200]}
+
+
 def efficiency_section(platform: str | None) -> dict:
     """The roofline ledger the sections above populated (every
     traced_jit dispatch recorded its measured seconds next to its
@@ -761,6 +820,8 @@ def emit(value, vs_baseline, extra):
         line.setdefault("efficiency", _EFFICIENCY)
     if _RESILIENCE is not None:
         line.setdefault("resilience", _RESILIENCE)
+    if _SLO is not None:
+        line.setdefault("slo", _SLO)
     # always carried, even on the watchdog/fallback paths: the per-phase
     # breakdown and the per-attempt probe record accumulated so far.  A
     # phase still OPEN when the watchdog fires is exactly the one that
@@ -957,7 +1018,7 @@ def main() -> int:
     # serving comparison (coalesced vs op-at-a-time) on whatever device
     # is up — its own subsystem, measured before the device codec pass so
     # a tunnel death mid-codec still leaves the serving block in the line
-    global _SERVING, _RECOVERY, _PIPELINE, _EFFICIENCY, _RESILIENCE
+    global _SERVING, _RECOVERY, _PIPELINE, _EFFICIENCY, _RESILIENCE, _SLO
     _SERVING = serving_section(platform)
     # repair-throughput comparison (batched waves vs per-object) on the
     # same device — like serving, measured before the codec pass so a
@@ -968,6 +1029,8 @@ def main() -> int:
     _PIPELINE = pipeline_section(platform)
     # goodput under a fixed fault schedule + breaker-fallback floor
     _RESILIENCE = resilience_section(platform)
+    # critical-path attribution + SLO budget over a loaded cluster pass
+    _SLO = slo_section(platform)
     # the roofline efficiency block reads the ledger the sections above
     # populated — computed here so a codec-pass death still carries it
     _EFFICIENCY = efficiency_section(platform)

@@ -195,8 +195,13 @@ class Objecter:
         tr = default_tracer()
         if op.trace is None:
             op.trace = tr.new_trace("client")
+        # a RESEND is retry overhead by definition: its span is named
+        # apart so the critical-path ledger charges the whole re-sent
+        # attempt to the `retry` phase (the first attempt stays
+        # client.op — the op itself, not its retries)
+        span_name = "client.op" if op.attempts == 1 else "client.op_retry"
         with tr.activate(op.trace, track="client"), \
-                tr.span("client.op", cat="client", oid=op.oid,
+                tr.span(span_name, cat="client", oid=op.oid,
                         tid=op.tid, attempt=op.attempts):
             reply = self.cluster.osd_submit(
                 op.pool_id, ps, primary, self.osdmap.epoch,

@@ -257,6 +257,17 @@ class MiniCluster:
         self.ts.add_source("heat", self.heat.flat_series)
         from .common import roofline
         self.ts.add_source("efficiency", roofline.flat_series)
+        # critical-path latency decomposition + SLO burn engine
+        # (common/critpath.py + mgr/slo.py): status() folds completed
+        # traces into per-class phase attribution; the SLO tracker
+        # judges them against slo_<class>_p99_ms objectives
+        from .common.critpath import CritPathLedger
+        from .mgr.slo import SLOTracker
+        self.critpath = CritPathLedger(cct=self.cct,
+                                       name=f"c{self.cluster_id}")
+        self.slo = SLOTracker(self.critpath, cct=self.cct,
+                              name=f"c{self.cluster_id}")
+        self.ts.add_source("slo", self.slo.flat_series)
         # XLA profiler capture windows (common/profiler_capture.py):
         # `device profile start|stop|status` plus a rate-limited one-shot
         # auto-capture on any WARN/ERR health transition.  Durable mode
@@ -289,7 +300,35 @@ class MiniCluster:
         self.flight.add_source("clusterlog", self.clusterlog.dump)
         self.flight.add_source("timeseries", self.ts.dump)
         self.flight.add_source("efficiency", roofline.snapshot)
+        # a WARN/ERR bundle must answer "which phase blew the budget"
+        # from the artifact alone: both the SLO state and the raw
+        # per-class attribution ride every capture (the fold runs first
+        # so the bundle carries traces completed right up to the dump)
+        self.flight.add_source("slo", self._slo_flight_source)
         self.flight.register_admin()
+        # slo status/dump admin commands (takeover-register, the flight
+        # recorder's idiom: newest owner of the shared name wins)
+        def _slo_status(**kw):
+            self.critpath.refresh()
+            return self.slo.status()
+
+        def _slo_dump(**kw):
+            return self._slo_flight_source()
+        self._slo_admin_fns = {"slo status": _slo_status,
+                               "slo dump": _slo_dump}
+        for cmd, desc in (
+                ("slo status",
+                 "per-class latency objectives, burn rates, and "
+                 "critical-path phase attribution"),
+                ("slo dump",
+                 "full SLO + critical-path ledger snapshot (JSON)")):
+            self.cct.admin_socket.unregister(cmd)
+            self.cct.admin_socket.register(cmd, self._slo_admin_fns[cmd],
+                                           desc)
+
+    def _slo_flight_source(self) -> dict:
+        self.critpath.refresh()
+        return self.slo.dump()
 
     def _heat_topology(self) -> dict:
         """The heat tracker's placement view: pg -> primary + acting."""
@@ -449,6 +488,18 @@ class MiniCluster:
                                  "within osd_markdown_window: boots are "
                                  "damped until the operator clears the "
                                  "markdown record")
+        from .mgr.slo import slo_burn_check, slo_exhausted_check
+        eng.register("SLO_BURN", slo_burn_check(self.slo),
+                     description="a class's latency error budget is "
+                                 "burning past slo_burn_rate_threshold "
+                                 "in BOTH burn windows (fast+slow "
+                                 "agreement: a blip does not page, a "
+                                 "sustained burn does)")
+        eng.register("SLO_EXHAUSTED", slo_exhausted_check(self.slo),
+                     severity=HEALTH_ERR,
+                     description="a class's slow-window burn rate says "
+                                 "the latency error budget is gone "
+                                 "(slo_exhausted_burn_rate)")
 
     def enable_serving(self, start: bool = False, **kw):
         """Attach a :class:`~ceph_tpu.exec.ServingEngine` to every EC
@@ -1001,7 +1052,14 @@ class MiniCluster:
             # deliver=False batching, this runs the parked ops early and
             # fragments the batch — when demand overruns the bound,
             # bounded memory wins over maximal coalescing.
+            import time as _time
+            t0 = _time.monotonic()
             daemon.drain()
+            backoff = _time.monotonic() - t0
+            # the bounce + drain is this op's backoff-and-resend time:
+            # stamped as `retry` phase in its trace
+            tr.complete("client.backoff_resend", _time.time() - backoff,
+                        backoff, ctx=trace_ctx, oid=oid)
             res = daemon.ms_dispatch(g.pgid, m, _done)
         if res is not None:
             return res
@@ -1358,6 +1416,11 @@ class MiniCluster:
         self.flight.close()
         self.profiler.close()
         self.wire.close()
+        self.slo.close()
+        self.critpath.close()
+        for cmd, fn in self._slo_admin_fns.items():
+            if self.cct.admin_socket.get(cmd) is fn:
+                self.cct.admin_socket.unregister(cmd)
         for p in self.pools.values():
             for g in p["pgs"].values():
                 g.shutdown()
@@ -1589,6 +1652,9 @@ class MiniCluster:
         for ob in live_objecters():
             if ob.cluster is self:
                 ob.check_op_timeouts()
+        # fold completed traces into the critical-path ledger BEFORE the
+        # ts point records: the `slo` series reads the ledger
+        self.critpath.refresh()
         self.ts.record()
         st = {
             "osdmap": {"epoch": self.osdmap.epoch,

@@ -366,6 +366,36 @@ OPTIONS: list[Option] = [
            description="fine time-series points folded (mean+max) into "
                        "one coarse archive point",
            see_also=["mgr_ts_capacity"]),
+    # -- latency SLOs & critical-path attribution (mgr/slo.py) -------------
+    Option("slo_fast_window", TYPE_FLOAT, LEVEL_ADVANCED, default=60.0,
+           min=0.05,
+           description="seconds of the FAST burn-rate window: SLO_BURN "
+                       "needs both the fast and slow windows past "
+                       "slo_burn_rate_threshold (multi-window agreement "
+                       "— a blip trips the fast window alone and stays "
+                       "silent)",
+           see_also=["slo_slow_window", "slo_burn_rate_threshold"]),
+    Option("slo_slow_window", TYPE_FLOAT, LEVEL_ADVANCED, default=600.0,
+           min=0.1,
+           description="seconds of the SLOW burn-rate window (budget "
+                       "remaining and SLO_EXHAUSTED are judged over it)",
+           see_also=["slo_fast_window"]),
+    Option("slo_burn_rate_threshold", TYPE_FLOAT, LEVEL_ADVANCED,
+           default=2.0, min=1.0,
+           description="error-budget burn multiple past which SLO_BURN "
+                       "raises when BOTH windows agree (1.0 = spending "
+                       "exactly the sustainable rate)",
+           see_also=["slo_exhausted_burn_rate"]),
+    Option("slo_exhausted_burn_rate", TYPE_FLOAT, LEVEL_ADVANCED,
+           default=10.0, min=1.0,
+           description="slow-window burn multiple past which "
+                       "SLO_EXHAUSTED (HEALTH_ERR) raises: the budget "
+                       "is gone at any plausible compliance period",
+           see_also=["slo_burn_rate_threshold"]),
+    Option("slo_min_ops", TYPE_UINT, LEVEL_ADVANCED, default=8, min=1,
+           description="minimum ops in BOTH burn windows before the SLO "
+                       "checks can page (an idle class holds no "
+                       "evidence either way)"),
     Option("log_file", TYPE_STR, LEVEL_BASIC, default="",
            description="path to log file"),
     Option("log_max_recent", TYPE_UINT, LEVEL_ADVANCED, default=500,
@@ -377,6 +407,27 @@ OPTIONS: list[Option] = [
     Option("debug_crush", TYPE_INT, LEVEL_DEV, default=1,
            description="crush subsystem log level", min=0, max=20),
 ]
+
+# per-owner-class latency objectives (mgr/slo.py): slo_<class>_p99_ms is
+# the bound (0 = no objective), slo_<class>_target the fraction of ops
+# that must meet it — the error budget is 1 - target.  Generated for the
+# canonical owner classes (common/device_attribution.OWNER_CLASSES,
+# inlined here so the schema stays import-light).
+for _cls in ("client", "serving", "recovery", "scrub", "rebalance"):
+    OPTIONS.append(Option(
+        f"slo_{_cls}_p99_ms", TYPE_FLOAT, LEVEL_ADVANCED, default=0.0,
+        min=0.0,
+        description=f"latency objective for {_cls}-class ops in "
+                    f"milliseconds (0 disables the objective; "
+                    f"slo_{_cls}_target sets the compliance fraction)",
+        see_also=[f"slo_{_cls}_target"]))
+    OPTIONS.append(Option(
+        f"slo_{_cls}_target", TYPE_FLOAT, LEVEL_ADVANCED, default=0.999,
+        min=0.0, max=1.0,
+        description=f"fraction of {_cls}-class ops that must complete "
+                    f"within slo_{_cls}_p99_ms (error budget = "
+                    f"1 - target)",
+        see_also=[f"slo_{_cls}_p99_ms"]))
 
 SCHEMA: dict[str, Option] = {o.name: o for o in OPTIONS}
 

@@ -1,0 +1,38 @@
+"""Nearest-rank percentile: the ONE rank definition every surface uses.
+
+Three consumers grew their own copy of this five-liner — the serving
+workload generator (bench p99), the trace report (span p99) and the
+time-series report — with a "change BOTH if the rank definition ever
+moves" comment standing in for actual sharing.  ISSUE 10 unifies them:
+bench p99, trace p99 and SLO-objective p99 are compared against each
+other (the perf gate diffs bench p99; the SLO engine judges ops against
+a p99 target derived from the same distribution), so a drifted rank
+definition would make the gate and the health surface disagree about
+the same latency data.
+
+Stdlib-only on purpose: ``tools/trace_report.py`` / ``tools/ts_report.py``
+load this file by PATH (``importlib.util.spec_from_file_location``), so
+they stay runnable without importing the ``ceph_tpu`` package (which
+pulls numpy).  ``tests/test_critpath.py`` carries the AST guard: no other
+file in the repo may define a function named ``percentile`` /
+``percentile_us`` / ``nearest_rank`` again.
+"""
+from __future__ import annotations
+
+import math
+
+
+def nearest_rank(sorted_vals, q: float) -> float:
+    """Nearest-rank percentile over a PRE-SORTED sequence (q in
+    [0, 100]).  The empirical-distribution definition (rank =
+    ceil(q/100 * n), 1-based): p100 is the max, p0 clamps to the min,
+    and no interpolation ever invents a value that was not observed."""
+    if not sorted_vals:
+        return 0.0
+    rank = max(1, math.ceil(q / 100.0 * len(sorted_vals)))
+    return sorted_vals[min(rank, len(sorted_vals)) - 1]
+
+
+def percentile(values, q: float) -> float:
+    """Convenience over an UNSORTED sequence (sorts a copy)."""
+    return nearest_rank(sorted(values), q)

@@ -104,7 +104,7 @@ class Span:
 
     __slots__ = ("tracer", "name", "cat", "args", "tid", "ts_us", "dur",
                  "_t0", "trace_id", "span_id", "parent_id", "track",
-                 "_ctx_pushed")
+                 "op_class", "_ctx_pushed")
 
     def __init__(self, tracer: "Tracer", name: str, cat: str, args: dict):
         self.tracer = tracer
@@ -120,6 +120,7 @@ class Span:
         self.trace_id = 0
         self.span_id = 0
         self.parent_id = 0
+        self.op_class = ""
         self.track: str | None = None
         self._ctx_pushed = False
 
@@ -135,6 +136,7 @@ class Span:
             self.trace_id = ctx.trace_id
             self.span_id = next(_span_ids)
             self.parent_id = ctx.span_id
+            self.op_class = ctx.op_class
             # nested spans (this thread, while we are open) chain under us
             self.tracer._ctx_stack().append((ctx.child_of(self.span_id),
                                              None))
@@ -246,13 +248,25 @@ class Tracer:
             self._events.append(ev)
 
     def complete(self, name: str, start_wall: float, dur_s: float,
-                 cat: str = "", **args) -> None:
-        """A span observed externally on the WALL clock (TrackedOp ops):
-        mapped onto the tracer timeline via the paired epochs."""
+                 cat: str = "", ctx: TraceContext | None = None,
+                 **args) -> None:
+        """A span observed externally on the WALL clock (TrackedOp ops,
+        queue/batch/backoff waits measured after the fact): mapped onto
+        the tracer timeline via the paired epochs.  With ``ctx`` the
+        event joins that distributed trace as a child span (trace/span/
+        parent ids + op_class stamped like a live span) so the
+        critical-path ledger can attribute it — linkage is EXPLICIT
+        opt-in, never ambient, so TrackedOp timelines that happen to
+        run under an active context don't double-count as tree nodes."""
         ev = {"name": name, "cat": cat or "op", "ph": "X",
               "ts": (start_wall - self._wall0) * 1e6,
               "dur": dur_s * 1e6,
               "pid": self.pid, "tid": threading.get_ident()}
+        if ctx is not None:
+            args["trace_id"] = ctx.trace_id
+            args["span_id"] = next(_span_ids)
+            args["parent_span_id"] = ctx.span_id
+            args.setdefault("op_class", ctx.op_class)
         if args:
             ev["args"] = args
         with self._lock:
@@ -268,6 +282,10 @@ class Tracer:
             args["trace_id"] = span.trace_id
             args["span_id"] = span.span_id
             args["parent_span_id"] = span.parent_id
+            # the owner class rides every traced span so the critical-
+            # path ledger (common/critpath.py) can classify a trace
+            # without re-deriving it from span-name heuristics
+            args.setdefault("op_class", span.op_class)
         if args:
             ev["args"] = args
         if span.track is not None:

@@ -40,12 +40,12 @@ class BatchFuture:
 
     __slots__ = ("kind", "payload", "sinfo", "ec_impl", "op_class",
                  "cost_bytes", "t_submit", "t_submit_wall", "t_dispatch",
-                 "t_done", "eager", "_event", "_result", "_error",
-                 "_callbacks", "_lock")
+                 "t_done", "eager", "trace", "_event", "_result",
+                 "_error", "_callbacks", "_lock")
 
     def __init__(self, kind: str, payload, sinfo, ec_impl, op_class: str,
                  cost_bytes: int, t_submit: float, t_submit_wall: float,
-                 eager: bool = False):
+                 eager: bool = False, trace=None):
         self.kind = kind
         self.payload = payload
         self.sinfo = sinfo
@@ -60,6 +60,10 @@ class BatchFuture:
         # decode()); the coalescer dispatches what has arrived instead
         # of waiting out the deadline for hypothetical companions
         self.eager = eager
+        # the submitter's TraceContext (if any): the engine stamps the
+        # op's batch-formation wait into that trace at dispatch time,
+        # so the critical-path ledger can attribute `batch_delay`
+        self.trace = trace
         self._event = threading.Event()
         self._result = None
         self._error: BaseException | None = None

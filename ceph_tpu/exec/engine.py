@@ -221,6 +221,28 @@ class ServingEngine:
 
     # -- submission ----------------------------------------------------------
 
+    # an admission wait shorter than this never emits a trace event: the
+    # uncontended fast path would otherwise add one ring entry per op
+    # for a phase whose whole point is "the throttle actually blocked"
+    ADMISSION_TRACE_FLOOR_S = 5e-4
+
+    def _admit_traced(self, cost_bytes: int):
+        """Admit, and stamp a ``serving.admission`` event into the
+        submitter's active trace when the throttles measurably blocked
+        (the critical-path ledger's ``admission`` phase).  Returns the
+        submitter's TraceContext so the op's BatchFuture rides the SAME
+        context (one lookup; an ambient change between two lookups
+        would split admission and batch_wait across traces)."""
+        tr = default_tracer()
+        ctx = tr.current_ctx()
+        t0 = time.monotonic()
+        self._admit(cost_bytes)
+        wait = time.monotonic() - t0
+        if ctx is not None and wait >= self.ADMISSION_TRACE_FLOOR_S:
+            tr.complete("serving.admission", time.time() - wait, wait,
+                        ctx=ctx, engine=self.name)
+        return ctx
+
     def _admit(self, cost_bytes: int) -> None:
         if self.fail_fast:
             if not self.op_throttle.get_or_fail(1):
@@ -276,9 +298,10 @@ class ServingEngine:
             arr = np.concatenate(
                 [arr, np.zeros(pad, dtype=np.uint8)])
         cost = int(arr.nbytes)
-        self._admit(cost)
+        ctx = self._admit_traced(cost)
         op = BatchFuture(ENCODE, arr, sinfo, ec, op_class, cost,
-                         time.monotonic(), time.time(), eager=eager)
+                         time.monotonic(), time.time(), eager=eager,
+                         trace=ctx)
         return self._enqueue(op)
 
     def submit_decode(self, chunks: dict, op_class: str = CLIENT_OP,
@@ -293,9 +316,10 @@ class ServingEngine:
                              "sinfo/ec_impl per op or at construction")
         payload = {c: self._as_u8(v) for c, v in chunks.items()}
         cost = int(sum(v.nbytes for v in payload.values()))
-        self._admit(cost)
+        ctx = self._admit_traced(cost)
         op = BatchFuture(DECODE, payload, sinfo, ec, op_class, cost,
-                         time.monotonic(), time.time(), eager=eager)
+                         time.monotonic(), time.time(), eager=eager,
+                         trace=ctx)
         return self._enqueue(op)
 
     # sync conveniences (the ECBackend hook uses these) --------------------
@@ -386,10 +410,18 @@ class ServingEngine:
 
     def _dispatch(self, ops: list[BatchFuture]) -> None:
         t = time.monotonic()
+        tr = default_tracer()
         for op in ops:
             op.t_dispatch = t
             self.perf.tinc("queue_wait_time", t - op.t_submit)
             self.perf.hinc("queue_wait_lat", t - op.t_submit)
+            if op.trace is not None:
+                # the submit-to-dispatch wait IS the batch-formation
+                # deadline the op paid: stamped into the op's trace so
+                # the critical-path ledger attributes `batch_delay`
+                tr.complete("serving.batch_wait", op.t_submit_wall,
+                            t - op.t_submit, ctx=op.trace,
+                            engine=self.name)
         self.perf.inc("batches")
         self.perf.inc("ops_coalesced", len(ops))
         self.perf.hinc("batch_size", len(ops))

@@ -21,27 +21,21 @@ single-thread engine (the driver pumps ``step()``).
 """
 from __future__ import annotations
 
-import math
 import threading
 import time
 
 import numpy as np
 
+from ..common.percentile import nearest_rank as percentile  # noqa: F401
 from ..osd.mclock import CLIENT_OP
 from .engine import ServingEngine
 from .throttle import ThrottleFull
 
-
-def percentile(sorted_vals: list[float], q: float) -> float:
-    """Nearest-rank percentile over a pre-sorted list (q in [0, 100]).
-
-    Mirrored (deliberately) by ``tools/trace_report.py:percentile_us``,
-    which must stay stdlib-only/standalone — change BOTH if the rank
-    definition ever moves, or bench p99 and trace p99 will disagree."""
-    if not sorted_vals:
-        return 0.0
-    rank = max(1, math.ceil(q / 100.0 * len(sorted_vals)))
-    return sorted_vals[min(rank, len(sorted_vals)) - 1]
+# `percentile` is THE shared nearest-rank helper (common/percentile.py):
+# the deliberately-duplicated copies this module and tools/trace_report.py
+# once carried are unified there, and tests/test_critpath.py's AST guard
+# keeps anyone from growing a local redefinition that could let bench p99
+# and trace p99 drift apart again.
 
 
 def _latency_stats(lat_s: list[float]) -> dict:

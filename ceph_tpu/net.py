@@ -1048,22 +1048,48 @@ class TcpRados:
         rid = self._next_rid()
         with self._cond:
             self._waiting.add(rid)
+        # every RPC is (part of) a client op: adopt the caller's trace
+        # or root one, so resend/backoff time below stamps into a trace
+        # the critical-path ledger can attribute to `retry`
+        from .common.tracer import default_tracer
+        tr = default_tracer()
+        ctx = tr.current_ctx() or tr.new_trace("client")
         try:
-            return self._call_with_retries(rid, method, args, total,
-                                           attempts, per_attempt,
-                                           deadline)
+            with tr.activate(ctx, track="client"), \
+                    tr.span("client.rpc", cat="client", method=method):
+                # the INNER ctx (child of the client.rpc span): resend
+                # events must nest UNDER the rpc span, or the span-tree
+                # overlap clamp treats them as clipped sibling roots
+                # and their time files under the span's self time
+                return self._call_with_retries(rid, method, args, total,
+                                               attempts, per_attempt,
+                                               deadline,
+                                               tr.current_ctx() or ctx)
         finally:
             with self._cond:
                 self._waiting.discard(rid)
                 self._pending.pop(rid, None)   # no ghost replies later
 
     def _call_with_retries(self, rid, method, args, total, attempts,
-                           per_attempt, deadline):
+                           per_attempt, deadline, ctx=None):
+        from .common.tracer import default_tracer
+        tr = default_tracer()
         last: BaseException | None = None
         timeouts = 0
+        last_mark = time.monotonic()
         for attempt in range(attempts):
             if attempt:
                 self.resends += 1
+                # time burned since the previous attempt started (the
+                # failed attempt + any reconnect backoff) is retry
+                # overhead: stamp it into the op's trace
+                now = time.monotonic()
+                if ctx is not None:
+                    tr.complete("net.resend",
+                                time.time() - (now - last_mark),
+                                now - last_mark, ctx=ctx,
+                                method=method, attempt=attempt)
+                last_mark = now
             remaining = deadline - time.monotonic()
             if remaining <= 0:
                 break

@@ -48,7 +48,7 @@ import jax.numpy as jnp
 from ..common import default_context
 from ..common import device_attribution
 from ..common.perf_counters import PerfCountersBuilder
-from ..common.tracer import trace_span
+from ..common.tracer import activate_trace, current_trace, trace_span
 from ..failure.breaker import CircuitBreaker, state_rank
 from ..failure.injector import InjectedFault, InjectedOOM
 
@@ -71,19 +71,23 @@ class PipelineFuture:
     timed sync — ``block_until_ready`` waits on the device unboundedly.
     """
 
-    __slots__ = ("kind", "meta", "owner", "fallback", "_pipeline",
-                 "_packed", "_dev", "_unpack", "_host_fallback",
-                 "_dispatched_at", "_event", "_result", "_error",
-                 "_callbacks", "_cb_lock")
+    __slots__ = ("kind", "meta", "owner", "fallback", "trace",
+                 "_pipeline", "_packed", "_dev", "_unpack",
+                 "_host_fallback", "_dispatched_at", "_event", "_result",
+                 "_error", "_callbacks", "_cb_lock")
 
     def __init__(self, pipeline: "CodecPipeline", kind: str, meta: dict,
-                 owner: str = "client"):
+                 owner: str = "client", trace=None):
         self.kind = kind
         self.meta = meta
         # the owner class this batch's device occupancy is charged to
         # (common/device_attribution), resolved on the SUBMITTING thread
         # where the trace context is active
         self.owner = owner
+        # the submitter's TraceContext: completion/fallback spans run on
+        # whatever thread forces the boundary, and activating this keeps
+        # them in the op's trace (critical-path `device`/`retry` phases)
+        self.trace = trace
         # True when the sync host codec served this batch (breaker open
         # or a device failure healed by the fallback)
         self.fallback = False
@@ -292,8 +296,12 @@ class CodecPipeline:
         if self.breaker is not None:
             self.breaker.note_fallback()
         try:
-            with trace_span("pipeline.host_fallback", kind=fut.kind,
-                            owner=fut.owner), \
+            # re-activate the submitter's trace: the fallback is the
+            # op's RETRY time (critical-path phase registry), and it may
+            # run on a different thread than the submit
+            with activate_trace(fut.trace), \
+                    trace_span("pipeline.host_fallback", kind=fut.kind,
+                               owner=fut.owner), \
                     self.perf.time("complete_time"):
                 host = host_fallback(fut._packed)
                 result = unpack(fut._packed, host) \
@@ -332,7 +340,8 @@ class CodecPipeline:
         and HEALS a batch whose dispatch or device compute fails, so a
         dying device degrades throughput instead of failing ops."""
         fut = PipelineFuture(self, kind, meta,
-                             owner=device_attribution.resolve_owner(owner))
+                             owner=device_attribution.resolve_owner(owner),
+                             trace=current_trace())
         self.perf.inc("submitted")
         # pack is host work: its failures are the caller's bug, never
         # breaker evidence — keep it outside the device try
@@ -395,8 +404,9 @@ class CodecPipeline:
         result, error = None, None
         recorded = device_ok = False
         try:
-            with trace_span("pipeline.complete", kind=fut.kind,
-                            owner=fut.owner), \
+            with activate_trace(fut.trace), \
+                    trace_span("pipeline.complete", kind=fut.kind,
+                               owner=fut.owner), \
                     self.perf.time("complete_time"):
                 self._roll_device_fault("completion")
                 dev = jax.block_until_ready(fut._dev)

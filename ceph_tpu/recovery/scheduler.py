@@ -684,7 +684,12 @@ class RecoveryScheduler:
             float(self._conf("osd_recovery_sleep"))
         self.perf.inc("waves")
         self.perf.inc("wave_objects", len(items))
+        # phase="dispatch": the wave span's SELF time is host-side wave
+        # orchestration (the sub-reads and fused decode under it carry
+        # their own wire/device phases) — explicit so the critical-path
+        # registry guard sees a declaration at the call site too
         with trace_span("recovery.wave", owner="recovery",
+                        phase="dispatch",
                         pg=repr(job.pgid), objects=len(items)):
             b.repair_wave(rop, items,
                           on_done=lambda: self._wave_done(job, rop, gen))

@@ -98,6 +98,7 @@ def main(argv=None) -> int:
                     help="status | -s | health [detail] | "
                          "health mute|unmute KEY | top | daemonperf | "
                          "log last [N] | watch | -w | flight dump | "
+                         "slo status | slo dump | "
                          "device roofline | device profile status | "
                          "osd tree | osd df | pg dump | df")
     args = ap.parse_args(argv)
@@ -153,6 +154,17 @@ def main(argv=None) -> int:
             from ..common.clusterlog import format_entry
             for e in c.clusterlog.last(n):
                 print(format_entry(e))
+        elif cmd in ("slo status", "slo dump"):
+            # the admin-socket fns fold the tracer ring first, so the
+            # table reflects every trace this (reopened) process ran;
+            # a live process's `slo status` sees the full history
+            out = c.cct.admin_socket.call(cmd)
+            if cmd == "slo dump":
+                import json as _json
+                print(_json.dumps(out, indent=2, default=str))
+            else:
+                from ..mgr.slo import render_status
+                print(render_status(out))
         elif cmd == "device roofline":
             from ..common import roofline
             print(roofline.render_table(roofline.report(cct=c.cct)))

@@ -15,7 +15,7 @@ spec.loader.exec_module(perf_gate)
 
 def _line(value=1000.0, device="tpu", serving=500.0, recovery=80.0,
           pipeline=120.0, p99=2.0, wire_per_byte=6.0, wire_per_op=9000.0,
-          pct_of_peak=42.0):
+          pct_of_peak=42.0, slo_p99=5.0, budget=1.0):
     return {
         "metric": "rs_k8m4_1MiB_encode_decode_device_resident",
         "value": value, "unit": "MiB/s", "device": device,
@@ -26,6 +26,10 @@ def _line(value=1000.0, device="tpu", serving=500.0, recovery=80.0,
                      "wire": {"per_byte_repaired": wire_per_byte}},
         "pipeline": {"device": device, "async": {"mib_s": pipeline}},
         "efficiency": {"device": device, "pct_of_peak": pct_of_peak},
+        "slo": {"device": device,
+                "client": {"p99_ms": slo_p99, "ops": 48,
+                           "budget_remaining": budget,
+                           "phases": {"device": 0.6, "wire": 0.4}}},
     }
 
 
@@ -34,7 +38,7 @@ class TestEvaluate:
         res = perf_gate.evaluate(_line(value=980.0), _line(),
                                  expect_platform="tpu")
         assert res["ok"] and res["verdict"].startswith("PERF GATE: PASS")
-        assert len(res["compared"]) == 8
+        assert len(res["compared"]) == 10
 
     def test_twenty_percent_regression_fails(self):
         res = perf_gate.evaluate(_line(value=800.0), _line(value=1000.0))
@@ -59,7 +63,7 @@ class TestEvaluate:
                 "breaker": {"fallback_mib_s": fallback, "opens": 1}}
             return line
         res = perf_gate.evaluate(rline(), rline())
-        assert res["ok"] and len(res["compared"]) == 10
+        assert res["ok"] and len(res["compared"]) == 12
         res = perf_gate.evaluate(rline(ratio=0.4), rline(ratio=0.8))
         assert not res["ok"]
         assert any("resilience.goodput_ratio" in f
@@ -71,6 +75,28 @@ class TestEvaluate:
                    for f in res["failures"])
         # 20% off is inside the loose 30% band for this metric
         res = perf_gate.evaluate(rline(ratio=0.65), rline(ratio=0.8))
+        assert res["ok"]
+
+    def test_slo_block_gated(self):
+        """ISSUE 10: the `slo` block participates — a client-p99 cliff
+        (past the loose 50% band: per-op p99 on a shared host is
+        tail-of-the-tail noisy) or a budget burn (budget_remaining
+        drop past 30%) fails the round; within-band wiggles pass."""
+        res = perf_gate.evaluate(_line(slo_p99=20.0),
+                                 _line(slo_p99=5.0))
+        assert not res["ok"]
+        assert any("slo.client_p99_ms" in f for f in res["failures"])
+        # a 40% p99 rise is inside the loose band
+        res = perf_gate.evaluate(_line(slo_p99=7.0), _line(slo_p99=5.0))
+        assert res["ok"]
+        # budget burn: remaining budget dropped 50% -> fail
+        res = perf_gate.evaluate(_line(budget=0.5), _line(budget=1.0))
+        assert not res["ok"]
+        assert any("slo.budget_remaining" in f for f in res["failures"])
+        res = perf_gate.evaluate(_line(budget=0.9), _line(budget=1.0))
+        assert res["ok"]
+        # a latency IMPROVEMENT never fails
+        res = perf_gate.evaluate(_line(slo_p99=1.0), _line(slo_p99=5.0))
         assert res["ok"]
 
     def test_wire_efficiency_regression_direction_is_up(self):
@@ -143,7 +169,7 @@ class TestEvaluate:
         res = perf_gate.evaluate(_line(device="cpu"),
                                  _line(device="cpu"),
                                  expect_platform="cpu")
-        assert res["ok"] and len(res["compared"]) == 8
+        assert res["ok"] and len(res["compared"]) == 10
 
     def test_custom_threshold(self):
         ref, new = _line(value=1000.0), _line(value=900.0)

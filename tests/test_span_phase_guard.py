@@ -1,0 +1,84 @@
+"""Guard: spans in the serving/recovery/pipeline layers must map to a
+DECLARED critical-path phase.
+
+Sibling of ``test_span_owner_guard.py``: the latency-objective layer
+(common/critpath.py) decomposes every completed op's trace into the
+canonical phase taxonomy, and an undeclared span silently files its
+self-time under ``other`` — the attribution table then under-reports
+exactly the new code path someone just added.  Every span opened (or
+``tracer.complete()``-stamped) in ``ceph_tpu/exec/``,
+``ceph_tpu/recovery/`` and ``ceph_tpu/ops/pipeline.py`` must either be
+declared in the registry (``critpath.SPAN_PHASES`` / the prefix rules)
+or carry an explicit constant ``phase=`` keyword.
+"""
+import ast
+from pathlib import Path
+
+from ceph_tpu.common.critpath import PHASES, is_declared
+
+ROOT = Path(__file__).resolve().parent.parent
+SCAN = ("ceph_tpu/exec", "ceph_tpu/recovery", "ceph_tpu/ops/pipeline.py")
+
+_SPAN_CALLS = {"trace_span", "span", "complete"}
+
+
+def _span_name(call: ast.Call) -> str | None:
+    fn = call.func
+    name = fn.id if isinstance(fn, ast.Name) else \
+        fn.attr if isinstance(fn, ast.Attribute) else None
+    if name not in _SPAN_CALLS or not call.args:
+        return None
+    first = call.args[0]
+    return first.value if isinstance(first, ast.Constant) and \
+        isinstance(first.value, str) else None
+
+
+def _paths():
+    for sub in SCAN:
+        p = ROOT / sub
+        yield from (sorted(p.rglob("*.py")) if p.is_dir() else [p])
+
+
+def test_spans_in_serving_recovery_pipeline_declare_a_phase():
+    offenders = []
+    for path in _paths():
+        rel = path.relative_to(ROOT).as_posix()
+        tree = ast.parse(path.read_text())
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _span_name(node)
+            if name is None:
+                continue
+            phase_kw = next((kw.value for kw in node.keywords
+                             if kw.arg == "phase"), None)
+            if isinstance(phase_kw, ast.Constant) and \
+                    phase_kw.value in PHASES:
+                continue                      # explicit declaration
+            if is_declared(name):
+                continue
+            offenders.append(
+                f"{rel}:{node.lineno}: span {name!r} maps to no "
+                f"declared critical-path phase — add it to "
+                f"critpath.SPAN_PHASES or pass phase=<one of {PHASES}>")
+    assert not offenders, (
+        "undeclared span phases (attribution would file these under "
+        "'other'):\n" + "\n".join(offenders))
+
+
+def test_scan_targets_still_exist():
+    for sub in SCAN:
+        assert (ROOT / sub).exists(), f"stale scan target: {sub}"
+
+
+def test_registry_covers_the_process_wide_span_inventory():
+    """The spans the rest of the codebase emits on the client-op path
+    must stay declared too — this is the list the decomposition's
+    fixtures and docs are written against."""
+    for name in ("client.op", "osd.op", "osd.queue_wait", "ec.encode",
+                 "ec.decode", "codec.encode", "codec.decode",
+                 "serving.batch_wait", "serving.admission",
+                 "pipeline.complete", "pipeline.host_fallback",
+                 "net.resend", "client.op_retry", "recovery.wave",
+                 "osd.ECSubWrite", "rpc.put"):
+        assert is_declared(name), name

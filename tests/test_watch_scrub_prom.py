@@ -302,6 +302,49 @@ class TestPrometheus:
         finally:
             acct.close()
 
+    def test_slo_and_latency_phase_families_rendered(self):
+        """ISSUE 10: live SLOTrackers export per-class budget state as
+        `ceph_tpu_slo_budget{owner,class,stat}` and live critical-path
+        ledgers export cumulative attribution as
+        `ceph_tpu_latency_phase_seconds{owner,class,phase}`, with the
+        HELP/TYPE-once invariants."""
+        from ceph_tpu.common import Context
+        from ceph_tpu.common.critpath import CritPathLedger
+        from ceph_tpu.mgr.prometheus import render
+        from ceph_tpu.mgr.slo import SLOTracker
+        cct = Context(overrides={"slo_client_p99_ms": 40.0,
+                                 "slo_client_target": 0.9})
+        led = CritPathLedger(cct=cct, name="promslo")
+        tracker = SLOTracker(led, cct=cct, name="promslo")
+        try:
+            # the scrape folds the process tracer ring into every live
+            # ledger: clear leftovers so the pinned values are exact
+            from ceph_tpu.common.tracer import default_tracer
+            default_tracer().reset()
+            led.ingest("client", 0.010,
+                       {"device": 0.008, "wire": 0.002})
+            text = render(cct)
+            lines = text.splitlines()
+            assert 'ceph_tpu_slo_budget{owner="promslo",' \
+                   'class="client",stat="objective_p99_ms"} 40.0' \
+                in lines
+            assert 'ceph_tpu_slo_budget{owner="promslo",' \
+                   'class="client",stat="budget_remaining"} 1.0' \
+                in lines
+            assert 'ceph_tpu_latency_phase_seconds{owner="promslo",' \
+                   'class="client",phase="device"} 0.008' in lines
+            assert 'ceph_tpu_latency_phase_seconds{owner="promslo",' \
+                   'class="client",phase="wire"} 0.002' in lines
+            assert lines.count("# TYPE ceph_tpu_slo_budget gauge") == 1
+            assert lines.count(
+                "# TYPE ceph_tpu_latency_phase_seconds counter") == 1
+            types = [line.split(" ", 2)[2].split(" ", 1)[0]
+                     for line in lines if line.startswith("# TYPE ")]
+            assert len(types) == len(set(types)), "duplicate TYPE lines"
+        finally:
+            tracker.close()
+            led.close()
+
     def test_device_efficiency_family_rendered(self):
         """The roofline ledger exports through BOTH surfaces: the
         ordinary `device_efficiency` collection walk (aggregate gauges)

@@ -61,6 +61,15 @@ def _campaign_context():
         "osd_markdown_window": 1000.0,
         "pipeline_breaker_threshold": 2,
         "pipeline_breaker_cooldown": 0.05,
+        # an impossible latency objective: EVERY client op in the
+        # faulted window burns budget, so SLO_BURN deterministically
+        # raises while traffic flows and clears once it drains past the
+        # (shortened) windows — the ISSUE-10 raise/heal receipt
+        "slo_client_p99_ms": 0.001,
+        "slo_client_target": 0.9,
+        "slo_fast_window": 2.0,
+        "slo_slow_window": 4.0,
+        "slo_min_ops": 4,
     })
 
 
@@ -129,6 +138,43 @@ def run_campaign(seed: int = 7, ops: int = 40, data_dir=None,
                 assert got == model[check], \
                     f"read of acked {check} diverged under injection"
         health_seen |= _health_checks(cluster)
+
+        # -- phase 1.5: critical-path + SLO receipts for the window
+        # above: retry time appeared (resent RPCs), the impossible
+        # objective burned, and the burn CLEARS once traffic drains
+        # past the burn windows — with the transitions in the clog
+        say("phase 1.5: SLO burn + retry attribution")
+        cluster.critpath.refresh()
+        snap = cluster.critpath.snapshot()
+        retry_s = sum(acc.get("retry", 0.0)
+                      for acc in snap["phase_seconds"].values())
+        # resends only: a reconnect healed during a call's FIRST attempt
+        # stamps no net.resend span (that backoff lands in the rpc
+        # span's self time), so reconnects alone guarantee nothing
+        if client.resends:
+            assert retry_s > 0, \
+                f"{client.resends} resends but zero retry phase time " \
+                f"attributed: {snap['phase_seconds']}"
+        checks = _health_checks(cluster)
+        health_seen |= checks
+        assert "SLO_BURN" in checks or "SLO_EXHAUSTED" in checks, \
+            f"impossible objective did not burn: {checks}"
+        time.sleep(4.2)                      # drain past the slow window
+        checks = _health_checks(cluster)
+        assert "SLO_BURN" not in checks and \
+            "SLO_EXHAUSTED" not in checks, \
+            f"SLO burn did not clear after heal: {checks}"
+        log_lines = [e["message"] for e in cluster.clusterlog.dump()]
+        assert any("SLO_" in ln and "raised" in ln
+                   for ln in log_lines), "no SLO raise in clusterlog"
+        assert any("SLO_" in ln and "cleared" in ln
+                   for ln in log_lines), "no SLO clear in clusterlog"
+        report["slo"] = {
+            "retry_phase_s": round(retry_s, 6),
+            "traces_folded": cluster.critpath.folded,
+            "classes": {cls: {"retry_s": round(acc.get("retry", 0), 6)}
+                        for cls, acc in snap["phase_seconds"].items()},
+        }
 
         # -- phase 2: flapping OSD -> damping -> operator clear
         say("phase 2: flapping OSD")

@@ -61,6 +61,13 @@ METRIC_PATHS = {
     "resilience.goodput_ratio": (("resilience", "goodput_ratio"), True),
     "resilience.fallback_mib_s": (("resilience", "breaker",
                                    "fallback_mib_s"), True),
+    # latency SLO (ISSUE 10): client-class p99 from the critical-path
+    # ledger (lower is better), and the remaining error budget under
+    # the bench objective — a budget-burn regression (drop) fails the
+    # gate even when throughput held
+    "slo.client_p99_ms": (("slo", "client", "p99_ms"), False),
+    "slo.budget_remaining": (("slo", "client", "budget_remaining"),
+                             True),
 }
 
 # fraction of regression tolerated per metric before the gate fails;
@@ -77,7 +84,12 @@ METRIC_THRESHOLDS = {"efficiency.pct_of_peak": 0.30,
                      # measurements on a possibly-shared host: gate only
                      # real cliffs, not scheduler jitter
                      "resilience.goodput_ratio": 0.30,
-                     "resilience.fallback_mib_s": 0.30}
+                     "resilience.fallback_mib_s": 0.30,
+                     # per-op p99 on a shared host is tail-of-the-tail
+                     # noisy; budget_remaining compounds that through a
+                     # threshold — gate only real cliffs
+                     "slo.client_p99_ms": 0.50,
+                     "slo.budget_remaining": 0.30}
 
 _BLOCK_DEVICE = {
     "core.mib_s": ("device",),
@@ -90,6 +102,8 @@ _BLOCK_DEVICE = {
     "efficiency.pct_of_peak": ("efficiency", "device"),
     "resilience.goodput_ratio": ("resilience", "device"),
     "resilience.fallback_mib_s": ("resilience", "device"),
+    "slo.client_p99_ms": ("slo", "device"),
+    "slo.budget_remaining": ("slo", "device"),
 }
 
 

@@ -21,10 +21,23 @@ name's per-occurrence duration distribution — the serving-latency view
 from __future__ import annotations
 
 import argparse
+import importlib.util
 import json
-import math
+import os
 import sys
 from collections import defaultdict
+
+# THE shared nearest-rank definition (ceph_tpu/common/percentile.py),
+# loaded by PATH so this tool stays standalone — no ceph_tpu package
+# import (which would pull numpy).  The module itself is stdlib-only;
+# tests/test_critpath.py's AST guard keeps local redefinitions out.
+_PCTL_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          os.pardir, "ceph_tpu", "common",
+                          "percentile.py")
+_spec = importlib.util.spec_from_file_location("_ceph_tpu_percentile",
+                                               _PCTL_PATH)
+_pctl = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(_pctl)
 
 
 def load_doc(path: str) -> list[dict]:
@@ -39,16 +52,9 @@ def load_events(path: str) -> list[dict]:
 
 
 def percentile_us(durs_us: list[float], q: float) -> float:
-    """Nearest-rank percentile (q in [0, 100]) over raw durations.
-
-    Mirrors ``ceph_tpu/exec/workload.py:percentile`` — this tool stays
-    stdlib-only/standalone on purpose; change BOTH if the rank
-    definition ever moves."""
-    if not durs_us:
-        return 0.0
-    s = sorted(durs_us)
-    rank = max(1, math.ceil(q / 100.0 * len(s)))
-    return s[min(rank, len(s)) - 1]
+    """Nearest-rank percentile (q in [0, 100]) over raw durations —
+    the shared definition from ceph_tpu/common/percentile.py."""
+    return _pctl.percentile(durs_us, q)
 
 
 def self_times(events: list[dict]) -> dict[str, dict]:

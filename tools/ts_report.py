@@ -27,11 +27,25 @@ from __future__ import annotations
 
 import argparse
 import glob
+import importlib.util
 import json
 import os
 import sys
 
 SPARK = "▁▂▃▄▅▆▇█"
+
+# THE shared nearest-rank percentile (ceph_tpu/common/percentile.py),
+# loaded by PATH so this tool stays standalone.  The local copy this
+# replaced had silently drifted to a floor-index definition — the exact
+# failure mode the shared helper + AST guard (tests/test_critpath.py)
+# exist to prevent.
+_PCTL_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          os.pardir, "ceph_tpu", "common",
+                          "percentile.py")
+_spec = importlib.util.spec_from_file_location("_ceph_tpu_percentile",
+                                               _PCTL_PATH)
+_pctl = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(_pctl)
 
 
 def sparkline(values: list[float], width: int = 32) -> str:
@@ -53,11 +67,9 @@ def sparkline(values: list[float], width: int = 32) -> str:
                    for v in values)
 
 
-def percentile(sorted_vals: list[float], p: float) -> float:
-    if not sorted_vals:
-        return 0.0
-    i = min(len(sorted_vals) - 1, int(p / 100.0 * len(sorted_vals)))
-    return sorted_vals[i]
+def _p(sorted_vals: list[float], q: float) -> float:
+    """Shared nearest-rank percentile over a pre-sorted list."""
+    return _pctl.nearest_rank(sorted_vals, q)
 
 
 def load_timeseries(path: str) -> tuple[dict, dict | None]:
@@ -95,8 +107,8 @@ def series_table(ts: dict, match: str | None = None,
             continue
         s = sorted(vals)
         rows.append({"series": name, "n": len(vals),
-                     "min": s[0], "p50": percentile(s, 50),
-                     "p95": percentile(s, 95), "max": s[-1],
+                     "min": s[0], "p50": _p(s, 50),
+                     "p95": _p(s, 95), "max": s[-1],
                      "spark": sparkline(vals)})
     return rows
 
