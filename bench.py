@@ -425,12 +425,16 @@ def pipeline_section(platform: str | None) -> dict:
 
 
 def _recovery_repair_pass(device: str, batched: bool, n_objects: int,
-                          obj_bytes: int) -> dict:
+                          obj_bytes: int, chain: bool = False) -> dict:
     """One degraded-cluster repair: write, kill a shard, overwrite
     everything while it is down, revive, and time the drain to clean.
     ``batched`` routes repair through the recovery scheduler (waves
     fused into decode_shards_many dispatches); otherwise the per-object
-    inline path runs.  Returns MiB/s over the chunk bytes pushed."""
+    inline path runs.  ``chain`` (batched only) lets the scheduler plan
+    partial-sum chains over the survivors instead of centralizing k
+    chunks at the primary.  Returns MiB/s over the chunk bytes pushed
+    plus the wire decomposition (total / coordinator-ingress /
+    newcomer-ingress per repaired byte)."""
     from ceph_tpu.cluster import MiniCluster
     from ceph_tpu.common import Context
     # fresh Context: the conf knobs below must not leak into the rest
@@ -438,6 +442,9 @@ def _recovery_repair_pass(device: str, batched: bool, n_objects: int,
     c = MiniCluster(n_osds=8, osds_per_host=2, chunk_size=4096,
                     cct=Context())
     try:
+        # chains default ON cluster-wide, so the CENTRALIZED arms must
+        # pin them off explicitly to measure what they claim to measure
+        c.cct.conf.set("osd_recovery_chain_enable", bool(chain))
         if batched:
             c.cct.conf.set("osd_recovery_max_active", 16)
             c.enable_recovery_scheduler()
@@ -457,31 +464,61 @@ def _recovery_repair_pass(device: str, batched: bool, n_objects: int,
         # shapes), the second is the steady-state measurement — same
         # warm-vs-cold discipline as the chain timer above
         dt = pushed = wire = 0
+        tdelta: dict = {}
+        chain_objects = chain_fallbacks = 0
         for payload in (b"\x01", b"\x02"):
             g.bus.mark_down(victim)
             for oid in objs:              # the writes the victim misses
                 c.put(pid, oid, payload + objs[oid][1:])
             before = g.backend.perf.get("recovery_bytes")
+            co_before = g.backend.perf.get("chain_objects")
+            cf_before = g.backend.perf.get("chain_fallbacks")
             wire_before = c.wire.class_bytes()["recovery"]
+            types_before = {t: v["tx_bytes"]
+                            for t, v in c.wire.per_type().items()}
             t0 = time.perf_counter()
             g.bus.mark_up(victim)
             c.deliver_all()
             dt = time.perf_counter() - t0
             pushed = g.backend.perf.get("recovery_bytes") - before
+            chain_objects = g.backend.perf.get("chain_objects") - co_before
+            chain_fallbacks = (g.backend.perf.get("chain_fallbacks")
+                               - cf_before)
             wire = c.wire.class_bytes()["recovery"] - wire_before
+            tdelta = {t: v["tx_bytes"] - types_before.get(t, 0)
+                      for t, v in c.wire.per_type().items()}
             assert not g.backend.stale, "repair did not drain"
         report = c.scrub_pool(pid, repair=False)
         assert report == {}, f"repair left scrub findings: {report}"
+        # wire decomposition from per-type deltas: the message types
+        # below flow to exactly one role in a repair (read replies +
+        # chain acks/aborts land on the coordinating primary; pushes +
+        # chain applies land on the repair target)
+        coord_in = sum(tdelta.get(t, 0) for t in
+                       ("ECSubReadReply", "ECPartialSumApplied",
+                        "ECPartialSumAbort"))
+        newcomer_in = sum(tdelta.get(t, 0) for t in
+                          ("PushOp", "ECPartialSumApply"))
         return {"mib_s": round(pushed / 2**20 / dt, 2),
                 "objects": n_objects, "pushed_bytes": pushed,
                 "elapsed_s": round(dt, 3),
                 # bytes-on-wire per byte repaired (ROADMAP item 3's
                 # success metric): recovery-class wire traffic of the
                 # measured cycle over the chunk bytes pushed — ~k for
-                # centralized repair, the number pipelined repair must
-                # beat
+                # centralized repair.  The k-transfer information floor
+                # means NO repair scheme gets total wire below ~k-1;
+                # what chains eliminate is the COORDINATOR ingress
+                # (~k+m-1 chunks per object centralized, ~0 chained)
+                # while the newcomer keeps receiving ~1 byte per byte
+                # repaired
                 "wire_bytes": int(wire),
-                "wire_per_byte": round(wire / max(pushed, 1), 3)}
+                "wire_per_byte": round(wire / max(pushed, 1), 3),
+                "coordinator_ingress_per_byte": round(
+                    coord_in / max(pushed, 1), 3),
+                "newcomer_ingress_per_byte": round(
+                    newcomer_in / max(pushed, 1), 3),
+                "chain_objects": int(chain_objects),
+                "chain_fallbacks": int(chain_fallbacks)}
     finally:
         c.shutdown()
 
@@ -501,6 +538,10 @@ def recovery_section(platform: str | None) -> dict:
             batched = _recovery_repair_pass(device, batched=True,
                                             n_objects=48,
                                             obj_bytes=64 * 1024)
+            chained = _recovery_repair_pass(device, batched=True,
+                                            n_objects=48,
+                                            obj_bytes=64 * 1024,
+                                            chain=True)
         res = {
             "device": "tpu" if platform == "tpu" else "cpu",
             "codec": device,
@@ -512,6 +553,32 @@ def recovery_section(platform: str | None) -> dict:
             # efficiency regresses when this number rises
             "wire": {"per_byte_repaired": batched["wire_per_byte"],
                      "per_object_arm": per_object["wire_per_byte"]},
+            # chained streaming repair vs the centralized wave on the
+            # SAME cluster shape (k=4/m=2, one victim).  Total wire
+            # cannot beat the k-transfer information floor; the honest
+            # wins the gate holds are (a) total wire well under the
+            # centralized arm, (b) coordinator ingress ~0, (c) newcomer
+            # ingress ~1x bytes repaired (<= 1.5 gated absolutely in
+            # tools/perf_gate.py)
+            "chain": {
+                "mib_s": chained["mib_s"],
+                "pushed_bytes": chained["pushed_bytes"],
+                "wire_per_byte": chained["wire_per_byte"],
+                "centralized_wire_per_byte": batched["wire_per_byte"],
+                "wire_reduction": round(
+                    batched["wire_per_byte"] /
+                    max(chained["wire_per_byte"], 1e-9), 2),
+                "speedup_vs_centralized": round(
+                    chained["mib_s"] / max(batched["mib_s"], 1e-9), 2),
+                "coordinator_ingress_per_byte":
+                    chained["coordinator_ingress_per_byte"],
+                "centralized_coordinator_ingress_per_byte":
+                    batched["coordinator_ingress_per_byte"],
+                "newcomer_ingress_per_byte":
+                    chained["newcomer_ingress_per_byte"],
+                "chain_objects": chained["chain_objects"],
+                "chain_fallbacks": chained["chain_fallbacks"],
+            },
         }
         if res["device"] == "cpu":
             res["note"] = ("no tpu: repair dispatch overhead measured "
@@ -519,7 +586,11 @@ def recovery_section(platform: str | None) -> dict:
                            " path")
         print(f"# recovery: batched {batched['mib_s']:.1f} MiB/s vs "
               f"per-object {per_object['mib_s']:.1f} MiB/s -> "
-              f"{res['speedup']}x on {res['device']}", file=sys.stderr)
+              f"{res['speedup']}x on {res['device']}; chain wire "
+              f"{chained['wire_per_byte']:.2f}/B vs centralized "
+              f"{batched['wire_per_byte']:.2f}/B, newcomer ingress "
+              f"{chained['newcomer_ingress_per_byte']:.2f}/B",
+              file=sys.stderr)
         return res
     except Exception as e:                 # never fail the artifact
         print(f"# recovery bench failed: {e!r}", file=sys.stderr)

@@ -36,7 +36,8 @@ from . import ecutil
 from .extent import ExtentSet
 from .extent_cache import ExtentCache
 from .memstore import GObject, Transaction
-from .messages import ECSubRead, ECSubReadReply, MessageBus, PushOp
+from .messages import (ECPartialSumAbort, ECPartialSumApplied, ECSubRead,
+                       ECSubReadReply, MessageBus, PushOp)
 from .pg_backend import (Op, OSDShard, PG_META, PGBackend, RecoveryOp,
                          shard_store,
                          RecoveryState, RepairState, ShardRepairOp,
@@ -112,6 +113,8 @@ class ECBackend(PGBackend):
         # batched recovery waves in their READ phase, keyed by read tid
         # (push-phase tracking lives in PGBackend._wave_pushes)
         self._recovery_waves: dict[int, _RecoveryWave] = {}
+        # in-flight partial-sum chains (recovery/chain.py), keyed by tid
+        self._recovery_chains: dict[int, object] = {}
         # optional serving engine (ceph_tpu/exec): when attached, encode/
         # decode dispatches route through its admission+coalescing queue
         # so CONCURRENT ops across PGs fuse into one device batch
@@ -538,6 +541,21 @@ class ECBackend(PGBackend):
                 wave.failed.add(oid)
                 if not pend:
                     self._finish_wave_oid(wave, oid)
+        # chained streaming repair: a dead HOP strands the partial sum —
+        # pop the chain record first (late acks/aborts become inert),
+        # then re-drive its unfinished objects per-object; a dead TARGET
+        # was already handled by the push loop above
+        for tid, chain in list(self._recovery_chains.items()):
+            if shard in getattr(chain, "hop_shards", ()):
+                del self._recovery_chains[tid]
+                self.perf.inc("chain_fallbacks")
+                for oid in sorted(chain.pending_pushes):
+                    self._wave_pushes.pop(oid, None)
+                    self._wave_fallback_one(chain, oid)
+                chain.pending_pushes.clear()
+        for tid, chain in list(self._recovery_chains.items()):
+            if not chain.pending_pushes:
+                del self._recovery_chains[tid]
         # RMW pipeline reads: re-issue from the remaining shards
         for op in list(self.waiting_reads):
             if shard in op.pending_read_shards:
@@ -1065,6 +1083,13 @@ class ECBackend(PGBackend):
             super()._recover_many(singles, on_each)
         if not batch:
             return
+        # chained streaming repair takes every eligible object first
+        # (linear whole-chunk codes, targets up, plan metadata present);
+        # its leftovers fall through to the centralized wave below
+        from ..recovery.chain import plan_chains
+        batch = plan_chains(self, batch, on_each)
+        if not batch:
+            return
         if len(batch) == 1:
             super()._recover_many(batch, on_each)
             return
@@ -1231,6 +1256,57 @@ class ECBackend(PGBackend):
         ok = oid not in wave.failed
         self.perf.inc("recoveries" if ok else "recovery_failures")
         wave.on_each(oid, ok)
+
+    # -- chained streaming repair completion (recovery/chain.py) -----------
+
+    def handle_message(self, msg) -> None:
+        if isinstance(msg, ECPartialSumApplied):
+            self._chain_applied(msg)
+        elif isinstance(msg, ECPartialSumAbort):
+            self._chain_abort(msg)
+        else:
+            super().handle_message(msg)
+
+    def _chain_applied(self, msg: ECPartialSumApplied) -> None:
+        chain = self._recovery_chains.get(msg.tid)
+        if chain is None:
+            return                        # late ack of an aborted chain
+        pend = chain.pending_pushes.get(msg.oid)
+        if pend is None or msg.from_shard not in pend:
+            return                        # dup delivery
+        pend.discard(msg.from_shard)
+        # recovery_bytes counts chunk bytes LANDED on targets; the
+        # centralized paths count at push-send — a chain's payloads
+        # never transit the primary, so the ack is where the byte is
+        # known delivered
+        self.perf.inc("recovery_bytes", chain.lengths.get(msg.oid, 0))
+        if pend:
+            return
+        if self.pg_log.last_version_of(msg.oid) != chain.at_version[msg.oid]:
+            # a write raced the chain (the target-side stale gate already
+            # refused genuinely older data): re-drive through the
+            # verified per-object path rather than trust the mix
+            self._wave_pushes.pop(msg.oid, None)
+            chain.pending_pushes.pop(msg.oid, None)
+            self._wave_fallback_one(chain, msg.oid)
+        else:
+            self.perf.inc("chain_objects")
+            self._finish_wave_oid(chain, msg.oid)
+        if not chain.pending_pushes:
+            self._recovery_chains.pop(msg.tid, None)
+            self.perf.inc("chain_repairs")
+
+    def _chain_abort(self, msg: ECPartialSumAbort) -> None:
+        """A hop refused its leg (missing/rotten/raced local chunk): the
+        whole chain re-drives through the centralized verified path."""
+        chain = self._recovery_chains.pop(msg.tid, None)
+        if chain is None:
+            return
+        self.perf.inc("chain_fallbacks")
+        for oid in sorted(chain.pending_pushes):
+            self._wave_pushes.pop(oid, None)
+            self._wave_fallback_one(chain, oid)
+        chain.pending_pushes.clear()
 
     # -- deep scrub (ECBackend.cc:2461-2546) -------------------------------
 

@@ -649,4 +649,50 @@ def decode_shards(sinfo: StripeInfo, ec_impl, available: dict[int, np.ndarray],
     return ec_impl.decode(set(want), chunks, chunk_size)
 
 
+def partial_sum_accumulate(coeffs, stream, acc, pipeline=None,
+                           owner: str | None = "recovery",
+                           use_device: bool = False) -> list[bytes]:
+    """One streaming-repair hop's partial-sum update: scale the hop's
+    local chunk ``stream`` (every plan object concatenated) by its
+    per-erased-row decode ``coeffs`` and XOR into ``acc``.
+
+    ``acc`` is ``None`` on the first hop, else one running buffer per
+    erased row.  Returns one bytes buffer per row.  With a ``pipeline``
+    and ``use_device`` the single fused scale-accumulate dispatch rides
+    the shared CodecPipeline — breaker, host fallback, and device-time
+    attribution for free; otherwise (or when the breaker trips) the
+    exact host GF math runs."""
+    from ..gf import ref as gfref                       # noqa: F401 (host path)
+    from ..ops import codec as _codec
+    data = _as_u8(stream).reshape(1, -1)
+    mat = np.asarray([[int(c) & 0xFF] for c in coeffs], dtype=np.uint8)
+    acc_stack = None if acc is None \
+        else np.stack([_as_u8(a) for a in acc])
+
+    def _rows(out) -> list[bytes]:
+        out = np.asarray(out, dtype=np.uint8)
+        return [out[i].tobytes() for i in range(out.shape[0])]
+
+    if pipeline is None or not use_device:
+        return _rows(_codec.scale_accumulate_host(mat, data, acc_stack))
+
+    def pack():
+        return mat, data, acc_stack
+
+    def dispatch(packed):
+        m, d, a = packed
+        return _codec.scale_accumulate_device(m, d, a)
+
+    def unpack(packed, host):
+        return _rows(host)
+
+    def host_fallback(packed):
+        m, d, a = packed
+        return _codec.scale_accumulate_host(m, d, a)
+
+    fut = pipeline.submit(pack, dispatch, unpack, kind="partial_sum",
+                          owner=owner, host_fallback=host_fallback, ops=1)
+    return fut.result()
+
+
 HINFO_KEY = "hinfo_key"  # xattr name (ECUtil.cc:235, get_hinfo_key)

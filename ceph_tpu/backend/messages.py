@@ -193,6 +193,63 @@ class PGActivateAck:
     epoch: int
 
 
+@dataclass
+class ECPartialSum:
+    """Hop -> next hop: chained streaming repair leg (RapidRAID-style
+    pipelined partial sums, PAPERS.md arXiv:1207.6744).  Each survivor
+    GF-scales its local chunk by its decode coefficients and XORs the
+    result into ``acc`` before forwarding, so the newcomer receives ~1x
+    the lost bytes instead of the primary pulling k full shards."""
+    from_shard: int
+    tid: int
+    coordinator: int              # shard Applied/Abort replies go to
+    oids: list = field(default_factory=list)       # plan order
+    lengths: list = field(default_factory=list)    # per-oid chunk bytes
+    versions: list = field(default_factory=list)   # per-oid pg_log version
+    rows: list = field(default_factory=list)       # erased chunks, acc order
+    targets: list = field(default_factory=list)    # target shard per row
+    # remaining legs: [(shard, chunk, ((coeff per row)...)), ...]
+    hops: list = field(default_factory=list)
+    attrs: dict = field(default_factory=dict)      # oid -> replicated attrs
+    # one running partial-sum buffer per erased row (concatenation over
+    # plan oids; sliced apart by ``lengths`` at the final hop)
+    acc: list | None = None
+    use_device: bool = False
+    trace: object = None
+
+
+@dataclass
+class ECPartialSumApply:
+    """Final hop -> repair target: one reconstructed chunk, applied like
+    a PushOp (stale-version guard included) but scoped to the chain tid."""
+    from_shard: int
+    tid: int
+    coordinator: int
+    oid: str
+    data: bytes
+    attrs: dict = field(default_factory=dict)
+    trace: object = None
+
+
+@dataclass
+class ECPartialSumApplied:
+    """Repair target -> coordinator: chunk for ``oid`` is durable."""
+    from_shard: int
+    tid: int
+    oid: str
+
+
+@dataclass
+class ECPartialSumAbort:
+    """Any hop -> coordinator: the chain cannot complete (missing or
+    rotten local chunk, version skew, misroute); coordinator falls back
+    to centralized verified repair for the unfinished objects."""
+    from_shard: int
+    tid: int
+    reason: str = ""
+    trace: object = None
+
+
 # -- wire accounting (common/wire_accounting.py) -----------------------------
 #
 # Every PG message type registers its payload sizer here, next to its
@@ -223,6 +280,14 @@ wire_accounting.register_wire_sizes({
     PGLogUpdate: lambda m: 24 + _blob(m.entries),
     PGActivate: lambda m: 16,
     PGActivateAck: lambda m: 16,
+    ECPartialSum: lambda m: (_blob(m.acc) + _blob(m.hops) + _blob(m.attrs)
+                             + _blob(m.oids) + _blob(m.rows)
+                             + _blob(m.targets) + 8 * len(m.lengths)
+                             + 8 * len(m.versions)),
+    ECPartialSumApply: lambda m: (len(m.data) + _blob(m.attrs)
+                                  + len(m.oid) + 16),
+    ECPartialSumApplied: lambda m: 16 + len(m.oid),
+    ECPartialSumAbort: lambda m: 16 + len(m.reason),
     # the cluster-bus wrapper: header + the routed payload
     "PGEnvelope": lambda m: 16 + wire_accounting.wire_size(m.msg),
 })

@@ -43,7 +43,9 @@ from enum import Enum
 
 from .bluestore import ChecksumError
 from .memstore import GObject, MemStore, Transaction
-from .messages import (ECSubRead, ECSubReadReply, ECSubWrite, ECSubWriteReply,
+from .messages import (ECPartialSum, ECPartialSumAbort, ECPartialSumApplied,
+                       ECPartialSumApply,
+                       ECSubRead, ECSubReadReply, ECSubWrite, ECSubWriteReply,
                        MessageBus, PGActivate, PGActivateAck, PGLogInfo,
                        PGLogQuery, PGLogUpdate,
                        PGScan, PGScanReply, PushOp, PushReply,
@@ -358,26 +360,127 @@ class OSDShard:
                 self.bus.send(msg.from_shard, PushReply(self.shard,
                                                         msg.oid))
                 return
-            t = Transaction()
-            # the remove wipes everything, so omap=None ("leave alone")
-            # must re-apply the PRE-push omap to honour its contract
-            if msg.omap is not None:
-                keep_omap, keep_header = dict(msg.omap), msg.omap_header
-            elif self.store.exists(obj):
-                keep_omap = self.store.get_omap(obj)
-                keep_header = self.store.get_omap_header(obj)
-            else:
-                keep_omap, keep_header = {}, b""
-            t.remove(obj).write(obj, 0, msg.data)
-            for name, value in msg.attrs.items():
-                t.setattr(obj, name, value)
-            if keep_omap or keep_header:
-                t.omap_setkeys(obj, keep_omap)
-                t.omap_setheader(obj, keep_header)
-            self.store.queue_transaction(t)
+            self._apply_push(obj, msg.data, msg.attrs, msg.omap,
+                             msg.omap_header)
             self.bus.send(msg.from_shard, PushReply(self.shard, msg.oid))
+        elif isinstance(msg, ECPartialSum):
+            self._partial_sum_hop(msg)
+        elif isinstance(msg, ECPartialSumApply):
+            # a chain's final hop pushing a finished chunk: same stale
+            # rule as PushOp (ack without applying so the chain
+            # completes — this shard already holds newer state)
+            obj = GObject(msg.oid, self.shard)
+            if not self._push_is_stale(msg, obj):
+                self._apply_push(obj, msg.data, msg.attrs, None, b"")
+            self.bus.send(msg.coordinator,
+                          ECPartialSumApplied(self.shard, msg.tid, msg.oid))
         else:
             raise TypeError(f"shard {self.shard}: unexpected {msg!r}")
+
+    def _apply_push(self, obj: GObject, data: bytes, attrs: dict,
+                    omap, omap_header: bytes) -> None:
+        """Replace this shard's copy with pushed recovery state (shared by
+        PushOp and the chain's ECPartialSumApply)."""
+        t = Transaction()
+        # the remove wipes everything, so omap=None ("leave alone")
+        # must re-apply the PRE-push omap to honour its contract
+        if omap is not None:
+            keep_omap, keep_header = dict(omap), omap_header
+        elif self.store.exists(obj):
+            keep_omap = self.store.get_omap(obj)
+            keep_header = self.store.get_omap_header(obj)
+        else:
+            keep_omap, keep_header = {}, b""
+        t.remove(obj).write(obj, 0, data)
+        for name, value in attrs.items():
+            t.setattr(obj, name, value)
+        if keep_omap or keep_header:
+            t.omap_setkeys(obj, keep_omap)
+            t.omap_setheader(obj, keep_header)
+        self.store.queue_transaction(t)
+
+    def _partial_sum_hop(self, msg: ECPartialSum) -> None:
+        """One leg of a chained streaming repair (recovery/chain.py):
+        GF-scale the local chunk of every plan object by this hop's
+        decode coefficients, XOR into the running accumulator, forward
+        to the next hop — the final hop pushes finished chunks straight
+        to the repair targets.  ANY validation failure aborts the WHOLE
+        chain back to the coordinator, which re-drives unfinished
+        objects through the centralized verified path; a hop never
+        guesses around bad state."""
+        from . import ecutil
+        from .ecutil import HINFO_KEY, crc32c
+
+        def abort(reason: str) -> None:
+            self.bus.send(msg.coordinator,
+                          ECPartialSumAbort(self.shard, msg.tid, reason))
+
+        if not msg.hops or msg.hops[0][0] != self.shard:
+            abort(f"misrouted to shard {self.shard}")
+            return
+        _, chunk, coeffs = msg.hops[0]
+        bufs: list[bytes] = []
+        for oid, length, version in zip(msg.oids, msg.lengths,
+                                        msg.versions):
+            obj = GObject(oid, self.shard)
+            try:
+                data = self.store.read(obj, 0, None)
+                stored = self.store.getattr(obj, HINFO_KEY)
+            except (FileNotFoundError, KeyError):
+                abort(f"{oid}: no local copy")
+                return
+            except ChecksumError:
+                # at-rest rot: centralized recovery re-verifies sources
+                # and routes around the rotten shard
+                abort(f"{oid}: rotten chunk")
+                return
+            if stored.get("version", 0) != version:
+                # a write landed here after the plan was cut — the other
+                # hops' contributions may predate it, so the sum would
+                # mix versions; the coordinator re-drives coherently
+                abort(f"{oid}: version skew")
+                return
+            if len(data) > length:
+                abort(f"{oid}: longer than plan")
+                return
+            if len(data) < length:
+                data = data + b"\0" * (length - len(data))
+            hashes = (msg.attrs.get(oid, {}).get(HINFO_KEY) or {}).get(
+                "cumulative_shard_hashes") or []
+            if hashes and crc32c(0xFFFFFFFF, data) != hashes[chunk]:
+                abort(f"{oid}: chunk hash mismatch")
+                return
+            bufs.append(data)
+        stream = b"".join(bufs)
+        with trace_span("recovery.chain_hop", owner="recovery",
+                        objects=len(msg.oids), nbytes=len(stream)):
+            acc = ecutil.partial_sum_accumulate(
+                coeffs, stream, msg.acc,
+                pipeline=getattr(self, "recovery_pipeline", None),
+                use_device=msg.use_device)
+        if len(msg.hops) > 1:
+            # forward a FRESH message (the bus's dup-delivery injection
+            # may still hold a reference to this one); the trace ctx
+            # rides along so every leg keeps recovery attribution
+            self.bus.send(msg.hops[1][0], ECPartialSum(
+                from_shard=self.shard, tid=msg.tid,
+                coordinator=msg.coordinator, oids=msg.oids,
+                lengths=msg.lengths, versions=msg.versions,
+                rows=msg.rows, targets=msg.targets, hops=msg.hops[1:],
+                attrs=msg.attrs, acc=acc, use_device=msg.use_device,
+                trace=msg.trace))
+            return
+        # final hop: slice each accumulator row per object and push the
+        # finished chunks to their targets; the coordinator completes
+        # each object on the targets' ECPartialSumApplied acks
+        for row, target in enumerate(msg.targets):
+            off = 0
+            for oid, length in zip(msg.oids, msg.lengths):
+                self.bus.send(target, ECPartialSumApply(
+                    self.shard, msg.tid, msg.coordinator, oid,
+                    acc[row][off:off + length],
+                    attrs=dict(msg.attrs.get(oid, {}))))
+                off += length
 
 
 def _slice_subchunks(data: bytes, runs: list[tuple[int, int]],
@@ -583,6 +686,12 @@ class PGBackend:
                              "chunk bytes pushed to recovery targets "
                              "(the mgr digest's recovery B/s source)")
             .add_u64_counter("recovery_failures", "recovery ops failed")
+            .add_u64_counter("chain_repairs",
+                             "partial-sum chain waves completed")
+            .add_u64_counter("chain_objects",
+                             "objects repaired via streaming chains")
+            .add_u64_counter("chain_fallbacks",
+                             "chains aborted to centralized repair")
             .add_u64_counter("log_repairs_clean",
                              "shard repairs satisfied by log equality alone")
             .add_u64_counter("log_repairs", "log-based shard catch-ups")

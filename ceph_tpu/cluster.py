@@ -546,6 +546,10 @@ class MiniCluster:
         return self.recovery
 
     def _attach_recovery(self, g: PGGroup, pool: Pool) -> None:
+        # chain planning is topology-aware: osd -> host bucket, the same
+        # layout the crush map above was built with
+        g.backend.osd_locations = {o: o // self.osds_per_host
+                                   for o in range(self.n_osds)}
         self.recovery.attach_backend(
             g.backend, pgid=g.pgid, daemon=self.osds[g.backend.whoami],
             pool_params=pool.params)

@@ -115,6 +115,10 @@ SPAN_PHASES: dict[str, str] = {
     "jit.trace": DISPATCH,
     "jit.compile": DISPATCH,
     "recovery.wave": DISPATCH,
+    # chained streaming repair: plan building on the coordinator, then
+    # one scale-accumulate per survivor hop (device or exact host GF)
+    "recovery.chain": DISPATCH,
+    "recovery.chain_hop": DISPATCH,
     # device: compute + transfers (the codec spans wrap the actual
     # device/SIMD work; ec.* self-time is pack/scatter around it)
     "codec.encode": DEVICE,
@@ -130,6 +134,7 @@ SPAN_PHASES: dict[str, str] = {
     "ec.encode": DEVICE,
     "ec.decode": DEVICE,
     "ec.decode_wave": DEVICE,
+    "codec.scale_accumulate": DEVICE,
     # retry: resends / backoff / circuit-broken host fallback
     "pipeline.host_fallback": RETRY,
     "net.resend": RETRY,

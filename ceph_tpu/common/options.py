@@ -125,6 +125,23 @@ OPTIONS: list[Option] = [
                        "(throttles background repair like the "
                        "reference's recovery sleep)",
            see_also=["osd_recovery_max_bytes_per_sec"]),
+    Option("osd_recovery_chain_enable", TYPE_BOOL, LEVEL_ADVANCED,
+           default=True,
+           description="chained streaming repair: scheduler waves plan a "
+                       "partial-sum chain over survivor OSDs (each hop "
+                       "GF-scales its local shard and forwards the "
+                       "running sum) instead of pulling k full shards "
+                       "to the primary; falls back to centralized "
+                       "verified repair per object on any mid-chain "
+                       "failure and for sub-chunked codes",
+           see_also=["osd_recovery_chain_max_len",
+                     "osd_recovery_max_active"]),
+    Option("osd_recovery_chain_max_len", TYPE_UINT, LEVEL_ADVANCED,
+           default=12, min=2,
+           description="longest partial-sum chain (hop count = decode "
+                       "sources); repairs needing more sources than "
+                       "this stay centralized",
+           see_also=["osd_recovery_chain_enable"]),
     Option("osd_heartbeat_interval", TYPE_INT, LEVEL_ADVANCED, default=6,
            description="seconds between peer heartbeats", min=1, max=60),
     Option("osd_heartbeat_grace", TYPE_INT, LEVEL_ADVANCED, default=20,

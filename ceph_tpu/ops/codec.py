@@ -65,6 +65,40 @@ def _donation_supported() -> bool:
         return False
 
 
+@functools.partial(jax.jit, static_argnames=("variant",))
+def _gf_scale_accumulate(mat, data, acc, variant):
+    """One chained-repair hop's partial-sum update: ``mat @ data XOR acc``
+    over GF(2^8) — the survivor scales its local chunk by its decode
+    coefficients and folds it into the running sum in a single fused
+    dispatch (no intermediate host round-trip)."""
+    return jnp.bitwise_xor(rs_kernels.gf_apply(mat, data, variant), acc)
+
+
+def scale_accumulate_device(mat, data, acc, variant: str = "auto"):
+    """Device scale-accumulate for a chain hop: ``mat`` [r, 1] decode
+    coefficients, ``data`` [1, N] the hop's local chunk stream, ``acc``
+    [r, N] running partial sums (or None on the first hop) -> [r, N] on
+    device.  One jitted dispatch either way; the shapes are static per
+    (r, N) so chains over a wave share a single compilation."""
+    if acc is None:
+        return rs_kernels.gf_apply(jnp.asarray(mat), jnp.asarray(data),
+                                   variant)
+    return _gf_scale_accumulate(jnp.asarray(mat), jnp.asarray(data),
+                                jnp.asarray(acc), variant)
+
+
+def scale_accumulate_host(mat: np.ndarray, data: np.ndarray,
+                          acc: np.ndarray | None) -> np.ndarray:
+    """Exact host sibling of :func:`scale_accumulate_device` (breaker
+    fallback and the no-pipeline path)."""
+    out = gfref.apply_matrix_fast(
+        np.ascontiguousarray(mat, dtype=np.uint8),
+        np.ascontiguousarray(data, dtype=np.uint8))
+    if acc is not None:
+        np.bitwise_xor(out, acc, out=out)
+    return out
+
+
 class RSCodec:
     """Systematic RS(k, m) over GF(2^8), poly 0x11D.
 

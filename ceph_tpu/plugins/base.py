@@ -179,7 +179,30 @@ class ErasureCode(ErasureCodeInterface):
 
     def minimum_to_decode_with_cost(self, want_to_read: set,
                                     available: Mapping[int, int]) -> set:
-        return self._minimum_to_decode(want_to_read, set(available))
+        """Pick decode sources by repair cost (ErasureCode.cc:137-146
+        semantics, made topology-aware): when the wanted chunks all
+        survive, read them directly regardless of cost; otherwise take
+        the cheapest |minimum| sources — ``available`` maps chunk id to
+        a cost such as CRUSH distance from the repair target, so chains
+        prefer near survivors (cf. the repair-cost-aware selection of
+        the product-matrix regenerating-code work, arXiv:1412.3022)."""
+        if set(want_to_read) <= set(available):
+            return set(want_to_read)
+        base = self._minimum_to_decode(want_to_read, set(available))
+        ranked = sorted(available, key=lambda c: (available[c], c))
+        return set(ranked[:len(base)])
+
+    def partial_sum_coefficients(self, erasures: set, sources: list[int]):
+        """Per-source decode coefficients for chained streaming repair:
+        ``(coeffs, rows)`` where ``coeffs[source chunk]`` is one GF
+        coefficient per erased row and ``rows`` lists the erased chunk
+        each row reconstructs, such that XOR over sources of
+        ``coeff * chunk`` yields each erased chunk — the partial sums a
+        RapidRAID-style hop chain accumulates.  None (the default) means
+        the code has no whole-chunk linear repair form (sub-chunked/
+        clay, LRC locality) and the caller must keep centralized
+        decode."""
+        return None
 
     # -- encode (ErasureCode.cc:151-204) -----------------------------------
 

@@ -127,6 +127,30 @@ class ErasureCodeJaxRS(DeviceRouting, ErasureCode):
         for e, buf in rec.items():
             decoded[self.chunk_index(e)][:] = buf
 
+    def partial_sum_coefficients(self, erasures: set, sources: list[int]):
+        """RS is linear over GF(2^8): the decode matrix row for each
+        erased chunk IS the per-source coefficient vector, so a hop
+        chain can accumulate ``coeff * local_chunk`` partial sums and
+        reconstruct without centralizing k shards.  Chunk ids in and
+        out are PHYSICAL; the codec works in logical rows (the same
+        remap decode_chunks applies).  Returns ``(coeffs, rows)`` —
+        ``coeffs[source] = (c_row0, c_row1, ...)`` and ``rows`` the
+        erased physical chunk each coefficient row reconstructs."""
+        # remap_for_decode carries the VALUE through: {logical: physical}
+        avail_l, erasures_l = self.remap_for_decode(
+            {int(c): int(c) for c in sources},
+            sorted(int(e) for e in erasures))
+        if len(avail_l) < self.k or not erasures_l:
+            return None
+        erasures_l = sorted(erasures_l)
+        D, src = self.codec.decode_matrix(erasures_l,
+                                          available=list(avail_l))
+        coeffs = {int(avail_l[s]): tuple(int(D[r, i])
+                                         for r in range(D.shape[0]))
+                  for i, s in enumerate(src)}
+        rows = [self.chunk_index(e) for e in erasures_l]
+        return coeffs, rows
+
 
 class ErasureCodePluginJaxRS(ErasureCodePlugin):
     def factory(self, directory: str,

@@ -275,6 +275,13 @@ class RecoveryScheduler:
         backend.recovery_pipeline = self.pipeline
         backend._recovery_ctx = {"pgid": pgid, "daemon": daemon,
                                  "pool_params": dict(pool_params or {})}
+        # chained streaming repair runs its scale-accumulate on SURVIVOR
+        # shards, not the primary: hand every shard handler the same
+        # shared pipeline so hop dispatches get the breaker / host
+        # fallback / device attribution the wave decodes already have
+        for handler in backend.bus.handlers.values():
+            getattr(handler, "local_shard",
+                    handler).recovery_pipeline = self.pipeline
 
     # -- priorities --------------------------------------------------------
 

@@ -1,0 +1,328 @@
+"""Chained streaming repair (recovery/chain.py + the ECPartialSum hop
+path): bitwise equivalence against centralized repair across geometries,
+forced fallbacks (clay, mid-chain death, rotten sources), cost-aware
+planning, and the scale-accumulate primitive."""
+import numpy as np
+import pytest
+
+from ceph_tpu.cluster import MiniCluster
+from ceph_tpu.common import Context
+from ceph_tpu.recovery import chain as chainmod
+
+CHUNK = 512
+
+
+def _cluster(k, m, enable=True, profile=None, conf=None):
+    c = MiniCluster(n_osds=9, osds_per_host=3, chunk_size=CHUNK,
+                    cct=Context())
+    c.cct.conf.set("osd_recovery_chain_enable", enable)
+    for key, value in (conf or {}).items():
+        c.cct.conf.set(key, value)
+    c.enable_recovery_scheduler()
+    prof = {"k": str(k), "m": str(m), "device": "numpy",
+            "technique": "reed_sol_van"}
+    prof.update(profile or {})
+    pid = c.create_ec_pool("p", prof, pg_num=1)
+    g = next(iter(c.pools[pid]["pgs"].values()))
+    return c, pid, g
+
+
+def _write_degrade_revive(c, pid, g, k, n_objects, victims=1, seed=3):
+    """Write, kill ``victims`` shards, overwrite everything they miss,
+    revive, drain.  Returns the expected object contents."""
+    rng = np.random.default_rng(seed)
+    obj_bytes = 3 * CHUNK * k
+    data = {f"o{i}": rng.integers(0, 256, obj_bytes, np.uint8).tobytes()
+            for i in range(n_objects)}
+    for oid, d in data.items():
+        c.put(pid, oid, d)
+    vs = [g.acting[i + 1] for i in range(victims)]
+    for v in vs:
+        g.bus.mark_down(v)
+    for oid in list(data):
+        data[oid] = rng.integers(0, 256, obj_bytes, np.uint8).tobytes()
+        c.put(pid, oid, data[oid])
+    for v in vs:
+        g.bus.mark_up(v)
+    c.deliver_all()
+    return data
+
+
+def _perf(g):
+    return {x: g.backend.perf.get(x) for x in
+            ("recoveries", "recovery_failures", "chain_repairs",
+             "chain_objects", "chain_fallbacks")}
+
+
+def _shard_state(g, oids):
+    """Every shard's stored bytes + hinfo digest dict, for bitwise
+    comparison between repair arms."""
+    from ceph_tpu.backend.ecutil import HINFO_KEY
+    from ceph_tpu.backend.memstore import GObject
+    from ceph_tpu.backend.pg_backend import shard_store
+    out = {}
+    for oid in sorted(oids):
+        for s in g.acting:
+            st = shard_store(g.backend.bus, s)
+            obj = GObject(oid, s)
+            out[(oid, s)] = (st.read(obj, 0, None),
+                             st.getattr(obj, HINFO_KEY))
+    return out
+
+
+def _run_arm(k, m, enable, n_objects=8, victims=1, profile=None):
+    c, pid, g = _cluster(k, m, enable=enable, profile=profile)
+    try:
+        data = _write_degrade_revive(c, pid, g, k, n_objects,
+                                     victims=victims)
+        assert not g.backend.stale
+        perf = _perf(g)
+        for oid, want in data.items():
+            assert c.get(pid, oid, len(want)) == want
+        assert c.scrub_pool(pid, repair=False) == {}
+        state = _shard_state(g, data)
+    finally:
+        c.shutdown()
+    return perf, state
+
+
+class TestChainBitwiseEquivalence:
+    @pytest.mark.parametrize("k,m,victims", [(2, 2, 1), (4, 2, 1),
+                                             (4, 3, 2), (6, 3, 1)])
+    def test_chain_matches_centralized(self, k, m, victims):
+        """Chain repair must land byte-identical shard contents AND
+        hinfo digests vs the centralized wave, across geometries and
+        single/double erasure."""
+        chain_perf, chain_state = _run_arm(k, m, True, victims=victims)
+        cent_perf, cent_state = _run_arm(k, m, False, victims=victims)
+        assert chain_perf["chain_objects"] == 8
+        assert chain_perf["chain_fallbacks"] == 0
+        assert chain_perf["recovery_failures"] == 0
+        assert cent_perf["chain_objects"] == 0
+        assert chain_state == cent_state
+
+    def test_clay_forces_centralized_fallback(self):
+        """Sub-chunked codes have no whole-chunk linear repair form:
+        chains must never plan (the gate is upstream of the planner),
+        and repair still completes through the verified path."""
+        perf, _state = _run_arm(4, 2, True,
+                                profile={"plugin": "clay", "k": "4",
+                                         "m": "2", "d": "5",
+                                         "scalar_mds": "jax_rs"})
+        assert perf["chain_objects"] == 0
+        assert perf["chain_repairs"] == 0
+        assert perf["recovery_failures"] == 0
+        assert perf["recoveries"] >= 8
+
+
+class TestChainFallbacks:
+    def test_mid_chain_death_blackholes_then_centralized(self):
+        """Kill a hop the moment it receives a partial sum: the
+        in-flight accumulator is black-holed, the coordinator's down
+        listener pops the chain, and every unfinished object re-drives
+        through the verified per-object path — zero acked-write loss,
+        fault stamped in the campaign log.  (The primary's own hop is
+        exempt: killing the coordinator is a peering event, not a
+        mid-chain leg failure.)"""
+        from ceph_tpu.failure import FaultInjector, FaultPlan
+        c, pid, g = _cluster(4, 2, enable=True)
+        inj = FaultInjector(FaultPlan(seed=11))
+        try:
+            killed = []
+
+            def dying_hop(msg, _shard=None):
+                # the OSD dies mid-leg: no forward, no abort — only the
+                # bus down event tells the coordinator anything
+                killed.append(_shard.shard)
+                inj.record("chain", "hop_blackhole", target=_shard.shard)
+                g.bus.mark_down(_shard.shard)
+
+            for s in g.acting[1:]:
+                h = g.bus.handlers.get(s)
+                shard_obj = getattr(h, "local_shard", h)
+                orig = shard_obj._partial_sum_hop
+
+                def hook(msg, _o=orig, _s=shard_obj):
+                    if not killed:
+                        dying_hop(msg, _shard=_s)
+                    else:
+                        _o(msg)
+                shard_obj._partial_sum_hop = hook
+            data = _write_degrade_revive(c, pid, g, 4, n_objects=8)
+            assert len(killed) == 1
+            g.bus.mark_up(killed[0])
+            c.deliver_all()
+            assert not g.backend.stale
+            perf = _perf(g)
+            assert perf["chain_fallbacks"] >= 1
+            assert perf["recovery_failures"] == 0
+            for oid, want in data.items():          # zero acked loss
+                assert c.get(pid, oid, len(want)) == want
+            assert c.scrub_pool(pid, repair=False) == {}
+            assert inj.summary()["planes"]["chain"]["hop_blackhole"] == 1
+        finally:
+            c.shutdown()
+
+    def test_rotten_hop_chunk_aborts_to_verified_path(self):
+        """Corrupt the first hop's stored chunk of one object without
+        touching its hinfo: the hop's crc-vs-plan-hinfo check must abort
+        the chain (never launder rot into the rebuilt chunk), and the
+        centralized fallback routes around the rotten source.  Objects
+        are CREATED while the victim is down — fresh appends carry chunk
+        hashes; RMW overwrites invalidate them (and with no hash there
+        is nothing for either repair path to check against)."""
+        from ceph_tpu.backend.memstore import GObject, Transaction
+        from ceph_tpu.backend.pg_backend import shard_store
+        c, pid, g = _cluster(4, 2, enable=True)
+        try:
+            rng = np.random.default_rng(5)
+            obj_bytes = 3 * CHUNK * 4
+            victim = g.acting[1]
+            g.bus.mark_down(victim)
+            data = {f"o{i}": rng.integers(0, 256, obj_bytes,
+                                          np.uint8).tobytes()
+                    for i in range(6)}
+            for oid, d in data.items():
+                c.put(pid, oid, d)
+            # first hop of the plan the coordinator will cut: replicate
+            # its ranking with the same helpers it uses
+            be = g.backend
+            sig = {g.acting.index(victim)}
+            avail = {ch for ch, s in enumerate(g.acting)
+                     if s != victim and ch not in sig}
+            costs = chainmod.source_costs(avail, [victim], g.acting,
+                                          be.osd_locations)
+            srcs = be.ec_impl.minimum_to_decode_with_cost(sig, costs)
+            coeffs, _rows = be.ec_impl.partial_sum_coefficients(
+                sig, sorted(srcs))
+            hop0 = chainmod.order_hops(coeffs, [victim], g.acting,
+                                       be.osd_locations)[0]
+            s = g.acting[hop0]
+            st = shard_store(g.bus, s)
+            obj = GObject("o0", s)
+            rot = bytes(b ^ 0xFF for b in st.read(obj, 0, None))
+            st.queue_transaction(Transaction().write(obj, 0, rot))
+            g.bus.mark_up(victim)
+            c.deliver_all()
+            assert not g.backend.stale
+            perf = _perf(g)
+            assert perf["chain_fallbacks"] >= 1
+            assert perf["recovery_failures"] == 0
+            for oid, want in data.items():
+                assert c.get(pid, oid, len(want)) == want
+            # the fallback already routed around (and healed) the rot:
+            # a verifying scrub must come back clean
+            assert c.scrub_pool(pid, repair=False) == {}
+        finally:
+            c.shutdown()
+
+
+class TestPlanner:
+    def test_crush_distance_buckets(self):
+        loc = {0: 0, 1: 0, 2: 1}
+        assert chainmod.crush_distance(0, 0, loc) == chainmod.SAME_OSD
+        assert chainmod.crush_distance(0, 1, loc) == chainmod.SAME_HOST
+        assert chainmod.crush_distance(0, 2, loc) == chainmod.CROSS_HOST
+        # topology unknown: every remote OSD equidistant
+        assert chainmod.crush_distance(0, 2, None) == chainmod.SAME_HOST
+
+    def test_order_hops_puts_nearest_survivor_last(self):
+        # acting: chunk -> osd; targets on host 0; source chunk 2 shares
+        # the target's host, chunks 0/1 are cross-host
+        acting = [3, 4, 1, 5]
+        loc = {1: 0, 3: 1, 4: 2, 5: 0}
+        order = chainmod.order_hops([0, 1, 2], targets=[5],
+                                    acting=acting, locations=loc)
+        assert order[-1] == 2                  # same-host leg runs last
+        assert order == [0, 1, 2]              # ties break on chunk id
+
+    def test_cost_aware_selection_prefers_cheap_sources(self):
+        from ceph_tpu.plugins.registry import ErasureCodePluginRegistry
+        ec = ErasureCodePluginRegistry.instance().factory(
+            "jax_rs", "", {"k": "4", "m": "2", "device": "numpy"})
+        # chunk 0 erased; chunk 5 is expensive (cross-host), the rest
+        # cheap — the minimum must take the 4 cheapest survivors
+        costs = {1: 1, 2: 1, 3: 1, 4: 1, 5: 3}
+        assert ec.minimum_to_decode_with_cost({0}, costs) == {1, 2, 3, 4}
+        # when everything wanted survives, cost is irrelevant
+        assert ec.minimum_to_decode_with_cost({1}, costs) == {1}
+
+    def test_coefficients_reconstruct_erasures(self):
+        """XOR over sources of coeff*chunk must equal the erased chunks
+        — the exact identity every hop chain relies on."""
+        from ceph_tpu.gf import ref as gfref
+        from ceph_tpu.plugins.registry import ErasureCodePluginRegistry
+        ec = ErasureCodePluginRegistry.instance().factory(
+            "jax_rs", "", {"k": "4", "m": "2", "device": "numpy"})
+        rng = np.random.default_rng(7)
+        raw = rng.integers(0, 256, 4 * CHUNK, np.uint8).tobytes()
+        enc = ec.encode(set(range(6)), raw)
+        for erased in ({1}, {0, 5}, {2, 3}):
+            sources = sorted(set(range(6)) - erased)[:4]
+            coeffs, rows = ec.partial_sum_coefficients(erased, sources)
+            assert set(coeffs) == set(sources)
+            assert set(rows) == erased
+            acc = [np.zeros(len(enc[0]), np.uint8) for _ in rows]
+            for src, cs in coeffs.items():
+                for r, coeff in enumerate(cs):
+                    term = gfref.apply_matrix_fast(
+                        np.array([[coeff]], np.uint8),
+                        np.asarray(enc[src], np.uint8).reshape(1, -1))
+                    acc[r] ^= term[0]
+            for r, e in enumerate(rows):
+                assert bytes(acc[r]) == bytes(enc[e]), f"row {e}"
+
+    def test_partial_sum_accumulate_host_path(self):
+        from ceph_tpu.backend import ecutil
+        rng = np.random.default_rng(1)
+        stream = rng.integers(0, 256, 1024, np.uint8).tobytes()
+        prev = [rng.integers(0, 256, 1024, np.uint8).tobytes()
+                for _ in range(2)]
+        out = ecutil.partial_sum_accumulate([3, 7], stream, prev)
+        from ceph_tpu.gf import ref as gfref
+        want = gfref.apply_matrix_fast(
+            np.array([[3], [7]], np.uint8),
+            np.frombuffer(stream, np.uint8).reshape(1, -1))
+        for r in range(2):
+            ref = bytes(want[r] ^ np.frombuffer(prev[r], np.uint8))
+            assert out[r] == ref
+        first = ecutil.partial_sum_accumulate([3, 7], stream, None)
+        assert [bytes(w) for w in want] == list(first)
+
+
+class TestChainWire:
+    def test_hops_account_to_recovery_class_and_partition_holds(self):
+        """Every chain leg is charged ONCE, to the recovery op class,
+        and the class partition invariant survives the new types."""
+        c, pid, g = _cluster(4, 2, enable=True)
+        try:
+            before_cls = c.wire.class_bytes()["recovery"]
+            _write_degrade_revive(c, pid, g, 4, n_objects=6)
+            per_type = c.wire.per_type()
+            assert per_type["ECPartialSum"]["tx_bytes"] > 0
+            assert per_type["ECPartialSumApply"]["tx_bytes"] > 0
+            assert per_type["ECPartialSumApplied"]["tx_msgs"] >= 6
+            chain_bytes = sum(per_type[t]["tx_bytes"] for t in
+                              ("ECPartialSum", "ECPartialSumApply",
+                               "ECPartialSumApplied"))
+            assert (c.wire.class_bytes()["recovery"] - before_cls
+                    >= chain_bytes)
+            totals = c.wire.totals()
+            assert sum(c.wire.class_bytes().values()) == \
+                totals["tx_bytes"] + totals["rx_bytes"]
+        finally:
+            c.shutdown()
+
+
+def test_chain_module_is_queue_guard_scanned():
+    """Satellite guard coverage: the unbounded-queue AST scan must walk
+    recovery/chain.py (it rglobs ceph_tpu/recovery)."""
+    import pathlib
+    import test_no_unbounded_queue as guard
+    scanned = {p.name for p in guard._scan_files()} \
+        if hasattr(guard, "_scan_files") else None
+    if scanned is None:
+        root = pathlib.Path(guard.__file__).resolve().parent.parent
+        assert (root / "ceph_tpu" / "recovery" / "chain.py").exists()
+    else:
+        assert "chain.py" in scanned

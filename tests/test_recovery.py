@@ -197,8 +197,11 @@ class TestSchedulerCluster:
 
     def test_batched_waves_fuse_decodes(self):
         """A wave's objects share one decode dispatch per survivor
-        signature — far fewer codec calls than objects recovered."""
-        conf = {"osd_recovery_max_active": 6}
+        signature — far fewer codec calls than objects recovered.
+        Chains are pinned OFF: this exercises the centralized wave path
+        (with chains on, no primary-side decode runs at all)."""
+        conf = {"osd_recovery_max_active": 6,
+                "osd_recovery_chain_enable": False}
         c, sched, pid, data = _degraded_cluster(n_objects=12, conf=conf,
                                                 pg_num=1)
         try:

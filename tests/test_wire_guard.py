@@ -92,3 +92,15 @@ def test_sizers_measure_payloads():
     r = ECSubReadReply(from_shard=1, tid=1,
                        buffers_read={"o": [(0, b"z" * 2048)]})
     assert wire_size(r) >= 2048
+    from ceph_tpu.backend.messages import ECPartialSum
+    ps_small = ECPartialSum(from_shard=0, tid=1, coordinator=0,
+                            oids=["o"], lengths=[512], versions=[1],
+                            rows=[1], targets=[3],
+                            hops=[(2, 1, (7,))], attrs={},
+                            acc=[b"a" * 512])
+    ps_big = ECPartialSum(from_shard=0, tid=1, coordinator=0,
+                          oids=["o"], lengths=[512], versions=[1],
+                          rows=[1], targets=[3],
+                          hops=[(2, 1, (7,))], attrs={},
+                          acc=[b"a" * 8_192])
+    assert wire_size(ps_big) - wire_size(ps_small) == 8_192 - 512

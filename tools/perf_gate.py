@@ -68,6 +68,36 @@ METRIC_PATHS = {
     "slo.client_p99_ms": (("slo", "client", "p99_ms"), False),
     "slo.budget_remaining": (("slo", "client", "budget_remaining"),
                              True),
+    # chained streaming repair (ISSUE 12): throughput and the wire
+    # decomposition of the chain arm, diffed against the reference like
+    # every other block AND capped absolutely (METRIC_LIMITS below) so
+    # the bandwidth-optimality claims can't silently erode
+    "recovery.chain.mib_s": (("recovery", "chain", "mib_s"), True),
+    "recovery.chain.wire_per_byte": (
+        ("recovery", "chain", "wire_per_byte"), False),
+    "recovery.chain.coordinator_ingress_per_byte": (
+        ("recovery", "chain", "coordinator_ingress_per_byte"), False),
+    "recovery.chain.newcomer_ingress_per_byte": (
+        ("recovery", "chain", "newcomer_ingress_per_byte"), False),
+    "recovery.chain.speedup_vs_centralized": (
+        ("recovery", "chain", "speedup_vs_centralized"), True),
+}
+
+# absolute bounds checked on the NEW artifact alone — no reference
+# needed, so a first-ever chain artifact is still held to the claims.
+# ("max": value must stay at or below; "min": at or above.)  Total
+# chain wire cannot beat the k-transfer information floor (~k per
+# repaired byte at k=4 with one erasure); what IS gated hard is that
+# the newcomer receives ~1x the bytes it re-hosts (<= 1.5, the ISSUE 12
+# criterion), the coordinator stays out of the data path, total wire
+# keeps beating the centralized arm's ~6x, and the chain arm is not
+# slower than the centralized wave it replaces (0.95 absorbs timer
+# jitter between the two back-to-back passes).
+METRIC_LIMITS = {
+    "recovery.chain.newcomer_ingress_per_byte": (1.5, "max"),
+    "recovery.chain.coordinator_ingress_per_byte": (0.5, "max"),
+    "recovery.chain.wire_per_byte": (4.6, "max"),
+    "recovery.chain.speedup_vs_centralized": (0.95, "min"),
 }
 
 # fraction of regression tolerated per metric before the gate fails;
@@ -89,7 +119,10 @@ METRIC_THRESHOLDS = {"efficiency.pct_of_peak": 0.30,
                      # noisy; budget_remaining compounds that through a
                      # threshold — gate only real cliffs
                      "slo.client_p99_ms": 0.50,
-                     "slo.budget_remaining": 0.30}
+                     "slo.budget_remaining": 0.30,
+                     # a ratio of two wall-clock arms: gate cliffs only
+                     # (the absolute floor in METRIC_LIMITS still holds)
+                     "recovery.chain.speedup_vs_centralized": 0.30}
 
 _BLOCK_DEVICE = {
     "core.mib_s": ("device",),
@@ -104,6 +137,11 @@ _BLOCK_DEVICE = {
     "resilience.fallback_mib_s": ("resilience", "device"),
     "slo.client_p99_ms": ("slo", "device"),
     "slo.budget_remaining": ("slo", "device"),
+    "recovery.chain.mib_s": ("recovery", "device"),
+    "recovery.chain.wire_per_byte": ("recovery", "device"),
+    "recovery.chain.coordinator_ingress_per_byte": ("recovery", "device"),
+    "recovery.chain.newcomer_ingress_per_byte": ("recovery", "device"),
+    "recovery.chain.speedup_vs_centralized": ("recovery", "device"),
 }
 
 
@@ -212,6 +250,18 @@ def evaluate(new: dict, reference: dict | None,
                 f"{mid}: {cur['value']:.2f} vs {ref['value']:.2f} "
                 f"({100 * (ratio - 1):.0f}% increase > {100 * thr:.0f}% "
                 f"threshold, {cur['device']})")
+
+    for mid, (bound, kind) in sorted(METRIC_LIMITS.items()):
+        cur = new_metrics.get(mid)
+        if cur is None:
+            continue
+        v = cur["value"]
+        if kind == "max" and v > bound:
+            failures.append(
+                f"{mid}: {v:.2f} exceeds absolute cap {bound}")
+        elif kind == "min" and v < bound:
+            failures.append(
+                f"{mid}: {v:.2f} below absolute floor {bound}")
 
     ok = not failures
     if ok:
