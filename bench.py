@@ -624,6 +624,18 @@ def _serving_wire_pass(device: str, n_ops: int = 64) -> dict:
         c.shutdown()
 
 
+def _serving_async_pass() -> dict:
+    """The async-messenger block (`serving.async`): 10k logical
+    closed-loop clients multiplexed over 8 TCP connections to an async
+    ClusterServer (tools/rados_bench.run_mux_bench) — goodput + p99 at
+    clean capacity, and goodput + shed-rate with the dispatch queue
+    pinned tiny (the overload arm: the shed ladder must refuse work by
+    class while completed work keeps flowing)."""
+    from tools.rados_bench import run_mux_overload_pair
+    return run_mux_overload_pair(n_clients=10000, ops_per_client=2,
+                                 n_conns=8)
+
+
 def serving_section(platform: str | None) -> dict:
     """Closed-loop serving comparison (coalesced vs op-at-a-time on the
     SAME device) for the JSON artifact's `serving` block: throughput +
@@ -652,6 +664,19 @@ def serving_section(platform: str | None) -> dict:
               f"{res['unbatched']['ops_s']:.0f} ops/s (p99 "
               f"{res['unbatched']['p99_ms']:.2f} ms) -> "
               f"{res['speedup']}x on {res['device']}", file=sys.stderr)
+        try:                               # async-messenger concurrency
+            with phase("serving.async"):
+                res["async"] = _serving_async_pass()
+            a = res["async"]
+            print(f"# serving.async: {a['clients']} clients "
+                  f"{a['ops_s']:.0f} ops/s p99 {a['p99_ms']:.1f} ms "
+                  f"({a['threads']} threads); overload shed-rate "
+                  f"{a['overload']['shed_rate']:.0%} with "
+                  f"{a['overload']['ops_s']:.0f} ops/s goodput",
+                  file=sys.stderr)
+        except Exception as e:             # never fail the artifact
+            print(f"# serving.async bench failed: {e!r}", file=sys.stderr)
+            res["async"] = {"error": repr(e)[:200]}
         return res
     except Exception as e:                 # never fail the artifact
         print(f"# serving bench failed: {e!r}", file=sys.stderr)

@@ -219,6 +219,40 @@ OPTIONS: list[Option] = [
            default=2.0, min=0.0,
            description="ceiling seconds any single reconnect backoff "
                        "sleep can reach"),
+    # -- async messenger (msg/) --------------------------------------------
+    Option("ms_async_op_threads", TYPE_UINT, LEVEL_ADVANCED, default=3,
+           min=1,
+           description="dispatch worker threads per async server "
+                       "transport (the reference's ms_async_op_threads): "
+                       "the FIXED pool that executes RPCs off the "
+                       "dmClock dispatch queue — never grows with "
+                       "connection count"),
+    Option("ms_async_dispatch_queue_max", TYPE_UINT, LEVEL_ADVANCED,
+           default=1024, min=1,
+           description="dispatch-queue depth limit the overload-shedding "
+                       "ladder measures against: each dmClock class may "
+                       "occupy only its fraction of this before its "
+                       "arrivals bounce with EBUSY (client ops shed only "
+                       "at the full limit)"),
+    Option("ms_async_write_queue_bytes", TYPE_SIZE, LEVEL_ADVANCED,
+           default=4 * 1024 * 1024,
+           description="per-connection write-queue byte budget "
+                       "(exec/throttle.py): senders block (bounded) when "
+                       "a peer stops draining, and the connection closes "
+                       "when the budget stays exhausted a full send "
+                       "timeout — backpressure instead of unbounded "
+                       "buffering"),
+    Option("ms_async_batch_max", TYPE_UINT, LEVEL_ADVANCED, default=64,
+           min=1,
+           description="max RpcCalls the mux client coalesces into one "
+                       "RpcBatch frame (one pickle, one MAC, one "
+                       "syscall per admission window)"),
+    Option("ms_async_batch_delay_ms", TYPE_FLOAT, LEVEL_ADVANCED,
+           default=0.5, min=0.0,
+           description="how long the mux client's sender waits for more "
+                       "calls to coalesce once one is queued (0 sends "
+                       "immediately)",
+           see_also=["ms_async_batch_max"]),
     Option("pipeline_breaker_threshold", TYPE_UINT, LEVEL_ADVANCED,
            default=3,
            description="consecutive device-side codec failures before "

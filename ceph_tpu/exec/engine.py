@@ -213,6 +213,14 @@ class ServingEngine:
             d["_breaker"] = self.pipeline.breaker.state
         return d
 
+    def pressure(self) -> float:
+        """Admission occupancy in [0, 1]: the larger of the byte and op
+        throttle fill fractions — the overload signal the sharded front
+        end (msg/frontend.py) sheds on before work ever queues here."""
+        b, o = self.byte_throttle, self.op_throttle
+        return max(b.count / b.max if b.max else 0.0,
+                   o.count / o.max if o.max else 0.0)
+
     def inject_device_faults(self, injector) -> None:
         """Route the device-plane fault injection (failure/) through this
         engine's codec pipeline — the chaos harness hook."""

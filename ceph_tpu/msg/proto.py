@@ -1,0 +1,72 @@
+"""Session-multiplexing frame types for the async messenger.
+
+``net.py`` owns the base RPC vocabulary (cephx handshake frames,
+RpcCall/RpcResult, watch/notify).  This module adds the frames the
+multiplexed transport introduces — carrying MANY logical sessions'
+calls per TCP connection in one frame, the reference messenger's
+out-queue coalescing made explicit on the wire:
+
+- :class:`RpcBatch`   — client->server: a vector of RpcCalls (possibly
+  from many logical sessions) submitted as one frame: one pickle, one
+  MAC, one send for a whole admission window;
+- :class:`RpcResultBatch` — server->client: the results a dispatch
+  worker produced for one batch, returned as one frame.
+
+Both register wire-accounting sizers (test_wire_guard's no-unmetered-
+types contract) and join ``net._TYPES`` so the shared codec
+(``net._encode``/``net._decode``) carries them: they are post-auth
+pickle frames, never valid before the HMAC session.
+
+Reqid-dedup semantics are untouched: every inner call keeps its own
+``(session, rid)``, so a resent batch (or a single resent call from a
+dead batch) dedups per call, exactly like the unbatched path.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..common import wire_accounting
+from .. import net
+
+
+@dataclass
+class RpcBatch:
+    """A vector of :class:`~ceph_tpu.net.RpcCall` riding one frame."""
+    calls: list = field(default_factory=list)
+
+
+@dataclass
+class RpcResultBatch:
+    """The :class:`~ceph_tpu.net.RpcResult` vector for one RpcBatch."""
+    results: list = field(default_factory=list)
+
+
+_blob = wire_accounting.blob_size
+wire_accounting.register_wire_sizes({
+    RpcBatch: lambda m: sum(
+        len(c.method) + _blob(c.args) + 16 for c in m.calls) + 8,
+    RpcResultBatch: lambda m: sum(
+        _blob(r.value) + len(r.error) + 16 for r in m.results) + 8,
+})
+
+# join the shared RPC registry: the codec resolves frame type names
+# through net._TYPES, and test_wire_guard pins that every name in it is
+# individually metered
+net._TYPES.update({
+    "RpcBatch": RpcBatch,
+    "RpcResultBatch": RpcResultBatch,
+})
+
+
+def batch_trace_ctx(msg):
+    """The trace context a batch frame's wire bytes charge to: batches
+    are client-op vectors, so the first traced member speaks for the
+    frame (the per-class byte partition stays exact — one frame, one
+    class — while mixed-class batches are a documented approximation)."""
+    items = getattr(msg, "calls", None) or getattr(msg, "results", None) \
+        or ()
+    for m in items:
+        ctx = getattr(m, "trace", None)
+        if ctx is not None:
+            return ctx
+    return None

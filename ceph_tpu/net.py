@@ -20,11 +20,16 @@ Secret distribution matches deployment practice: the server writes
 ``client.admin.keyring`` into the cluster's data dir; clients read it
 from the shared filesystem.
 
-Threading: one reader thread per client connection on the server; every
-cluster call serializes through one lock (the MiniCluster is a
-single-threaded construct).  Notify pushes deliberately bypass that lock
-so a notify blocked on remote acks cannot deadlock against the acking
-client's reader thread.
+Threading (post-ISSUE-14): the server runs the async messenger (msg/):
+ONE reactor thread owns the listener and every connection — accept,
+handshake state machines, frame reassembly, reply writes — and a small
+fixed dmClock-ordered worker pool executes RPCs against the cluster
+(every cluster call still serializes through one lock; the MiniCluster
+is a single-threaded construct).  No per-connection or per-request
+threads exist on either side: the client's replies arrive as readiness
+callbacks on a shared client reactor.  NotifyAcks are handled inline on
+the reactor so a notify blocked on remote acks can never deadlock
+against the acking client's queued work.
 """
 from __future__ import annotations
 
@@ -96,6 +101,10 @@ class RpcCall:
     # black-holed request) never re-applies a non-idempotent op — the
     # reference's reqid dedup for 'ms inject socket failures' resends
     session: str = ""
+    # dmClock op class (osd/mclock constants): orders the async server's
+    # dispatch queue and picks the overload-shedding threshold; absent on
+    # frames from older peers — readers use getattr with this default
+    op_class: str = "client_op"
 
 
 @dataclass
@@ -234,14 +243,17 @@ def _encode(msg, secret: bytes | None) -> bytes:
 
 
 def _decode(tag: int, segs: list[bytes], *, authed: bool):
+    # segs may be bytes (FrameParser) or memoryviews into the async
+    # stream parser's receive buffer; only the tiny name/handshake
+    # segments materialize — the pickle payload decodes in place
     if tag != TAG_MESSAGE or len(segs) != 2:
         raise WireError(f"unexpected frame tag {tag}")
-    name = segs[0].decode()
+    name = bytes(segs[0]).decode()
     klass = _TYPES.get(name)
     if klass is None:
         raise WireError(f"unknown rpc type {name!r}")
     if name in _HANDSHAKE_FIELDS:
-        return _handshake_loads(name, segs[1])
+        return _handshake_loads(name, bytes(segs[1]))
     if not authed:
         # pickle is reachable ONLY behind the HMAC (pre-auth unpickling
         # of peer bytes would be remote code execution)
@@ -397,13 +409,14 @@ class ClusterServer:
         self.wire = wire_accounting.WireAccounting(
             cct=getattr(cluster, "cct", None), name=f"net.{self.port}")
         self._stop = threading.Event()
-        self._threads: list[threading.Thread] = []
-        # the KeyServer's per-entity challenge/session slots are single
-        # (cephx.py _pending/_sessions): concurrent handshakes for the
-        # same entity must serialize or they clobber each other
-        self._auth_lock = threading.Lock()
-        # cookie -> (channel, client name) for remote watchers
-        self._watchers: dict[int, Channel] = {}
+        # the serving front door: reactor + handshake state machines +
+        # dmClock dispatch (msg/server.py), created by start().  The
+        # KeyServer's single per-entity challenge slot is serialized by
+        # the transport's auth FIFO (the old _auth_lock, made async)
+        self._transport = None
+        # cookie -> connection for remote watchers
+        self._watchers: dict[int, object] = {}
+        self._watch_lock = threading.Lock()
         self._pending_acks: dict[tuple[int, int], list] = {}
         self._ack_cond = threading.Condition()
         # transport fault injection (failure/): hooks attached to every
@@ -430,7 +443,8 @@ class ClusterServer:
     # resend: caching them would pin every read payload in the dedup
     # cache (4 MiB gets x 4096 entries) for hits that barely happen
     IDEMPOTENT_RPCS = frozenset(
-        {"get", "stat", "ls", "pools", "status", "health", "getxattr"})
+        {"get", "stat", "ls", "pools", "status", "health", "getxattr",
+         "ping"})
 
     def inject_faults(self, injector) -> None:
         """Arm (or, with None, disarm) transport-plane fault injection:
@@ -491,34 +505,31 @@ class ClusterServer:
 
     # -- lifecycle -----------------------------------------------------------
 
-    def serve_forever(self) -> None:
-        try:
-            self._listener.settimeout(0.25)
-        except OSError:
-            if self._stop.is_set():
-                return              # stopped before the loop started
-            raise
-        while not self._stop.is_set():
-            try:
-                sock, _addr = self._listener.accept()
-            except socket.timeout:
-                continue
-            except OSError:
-                if self._stop.is_set():
-                    return              # listener closed by stop()
-                raise
-            t = threading.Thread(target=self._serve_conn, args=(sock,),
-                                 daemon=True)
-            t.start()
-            self._threads.append(t)
+    def start(self):
+        """Bring up the async serving transport (idempotent).  The
+        listener, every connection's handshake, frame reassembly and
+        reply writes all live on ONE reactor thread; dispatch runs on a
+        small fixed worker pool — no per-connection or per-request
+        thread is ever spawned."""
+        if self._transport is None:
+            from .msg.server import AsyncServerTransport
+            self._transport = AsyncServerTransport(
+                self, self._listener,
+                cct=getattr(self.cluster, "cct", None),
+                name=f"net.{self.port}")
+            self._transport.start()
+        return self._transport
 
-    def start(self) -> threading.Thread:
-        t = threading.Thread(target=self.serve_forever, daemon=True)
-        t.start()
-        return t
+    def serve_forever(self) -> None:
+        """Blocking form (rados_cli serve): start + wait for stop()."""
+        self.start()
+        self._stop.wait()
 
     def stop(self) -> None:
         self._stop.set()
+        if self._transport is not None:
+            self._transport.stop()
+            self._transport = None
         try:
             self._listener.close()
         except OSError:
@@ -530,99 +541,26 @@ class ClusterServer:
             self._own_injector.close()
             self._own_injector = None
 
-    # -- per-connection ------------------------------------------------------
+    # -- transport callbacks (msg/server.py) ---------------------------------
 
-    def _serve_conn(self, sock: socket.socket) -> None:
-        ch = Channel(sock)
-        ch.acct = self.wire
-        try:
-            # the auth lock is held across handshake round-trips: bound
-            # them so a stalled client cannot freeze everyone's connects
-            sock.settimeout(10.0)
-            with self._auth_lock:
-                name, session_key = self._handshake(ch)
-            sock.settimeout(None)
-            ch.secure(session_key)
-            # fault injection arms only POST-auth: a reconnecting client
-            # must always be able to complete the handshake.  A provider,
-            # not a snapshot: inject_faults(None) mid-run disarms LIVE
-            # connections too
-            ch.faults = lambda: self.fault_hooks
-            while True:
-                for msg in ch.recv_msgs():
-                    hooks = self.fault_hooks
-                    if hooks is not None and isinstance(msg, RpcCall):
-                        from .failure.transport import (RECV_BLACKHOLE,
-                                                        RECV_RESET)
-                        act = hooks.on_recv(
-                            type(msg).__name__, target=msg.method)
-                        if act == RECV_BLACKHOLE:
-                            continue    # swallowed: no reply, ever
-                        if act == RECV_RESET:
-                            raise ConnectionError("injected recv reset")
-                    if isinstance(msg, RpcCall):
-                        # thread-per-request: a call blocked on the
-                        # cluster lock (e.g. behind a notify waiting for
-                        # THIS client's ack) must not stall this reader —
-                        # the ack would sit unread behind it forever
-                        def _serve(m=msg):
-                            res = self._dispatch(ch, m)
-                            try:
-                                ch.send(res)
-                            except (ConnectionError, OSError):
-                                # link died (or an injected reset) before
-                                # the reply got out: the result is cached
-                                # under its reqid — the client's resend
-                                # on the next connection collects it
-                                pass
-                        threading.Thread(target=_serve,
-                                         daemon=True).start()
-                    elif isinstance(msg, NotifyAck):
-                        with self._ack_cond:
-                            key = (msg.cookie, msg.notify_id)
-                            self._pending_acks.setdefault(key, []).append(
-                                msg.value)
-                            self._ack_cond.notify_all()
-                    else:
-                        raise WireError(f"unexpected {type(msg).__name__}")
-        except (ConnectionError, WireError, AuthError, OSError):
-            pass
-        finally:
-            with self.lock:
-                dead = [c for c, w in self._watchers.items() if w is ch]
-                for cookie in dead:
-                    del self._watchers[cookie]
-            ch.close()
+    def _note_ack(self, msg: "NotifyAck") -> None:
+        """A remote watcher's NotifyAck arrived: wake the notify that is
+        blocked on it.  Runs INLINE on the reactor (never queued behind
+        dispatch): the notify holding the cluster lock is what a queued
+        ack would be stuck behind."""
+        with self._ack_cond:
+            key = (msg.cookie, msg.notify_id)
+            self._pending_acks.setdefault(key, []).append(msg.value)
+            self._ack_cond.notify_all()
 
-    def _handshake(self, ch: Channel) -> tuple[str, bytes]:
-        """Server side of the cephx exchange; returns (entity name,
-        service session key) — the secure-mode key."""
-        hello = ch.recv_one()
-        if not isinstance(hello, CephxBegin):
-            raise WireError("expected CephxBegin")
-        now = time.time()
-        ch.send(CephxChallenge(self.keyserver.get_challenge(hello.name)))
-        auth = ch.recv_one()
-        if not isinstance(auth, CephxAuthenticate):
-            raise WireError("expected CephxAuthenticate")
-        env = self.keyserver.issue_session_key(
-            hello.name, auth.client_challenge, auth.proof, now)
-        ticket_env = self.keyserver.issue_service_ticket(
-            hello.name, SERVICE, now)
-        ch.send(CephxSession(env, ticket_env))
-        authz_msg = ch.recv_one()
-        if not isinstance(authz_msg, CephxAuthorize):
-            raise WireError("expected CephxAuthorize")
-        name, reply = self.handler.verify_authorizer(
-            authz_msg.authorizer, now)
-        # recover the service session key the authorizer was sealed under
-        _, secret = self.keyserver.service_secret(
-            SERVICE, authz_msg.authorizer.secret_id)
-        from .auth.cephx import unseal
-        session_key = unseal(secret, authz_msg.authorizer.blob)[
-            "session_key"]
-        ch.send(CephxDone(reply))
-        return name, session_key
+    def _conn_closed(self, conn) -> None:
+        """Connection teardown: drop the watches registered on it.  Under
+        its own small lock, NOT the cluster lock — this runs on the
+        reactor thread, which must never wait on a dispatch in flight."""
+        with self._watch_lock:
+            dead = [c for c, w in self._watchers.items() if w is conn]
+            for cookie in dead:
+                del self._watchers[cookie]
 
     # -- RPC dispatch --------------------------------------------------------
 
@@ -766,10 +704,16 @@ class ClusterServer:
     def _rpc_health(self, ch):
         return self.cluster.health()
 
+    def _rpc_ping(self, ch, payload=None):
+        """Echo: the serving-path microbenchmark op (rados_bench mux
+        mode) — round-trips the transport without touching the cluster."""
+        return payload
+
     def _rpc_watch(self, ch, pool, oid, cookie):
         from .osd.osd_ops import ObjectOperation
         pid = self.cluster.pool_ids[pool]
-        self._watchers[cookie] = ch
+        with self._watch_lock:
+            self._watchers[cookie] = ch
 
         def on_notify(notify_id, ck, payload, _ch=ch, _cookie=cookie):
             # push OUTSIDE the ack wait; the remote client answers on its
@@ -792,7 +736,8 @@ class ClusterServer:
         from .osd.osd_ops import ObjectOperation
         pid = self.cluster.pool_ids[pool]
         self.cluster.operate(pid, oid, ObjectOperation().unwatch(cookie))
-        self._watchers.pop(cookie, None)
+        with self._watch_lock:
+            self._watchers.pop(cookie, None)
         return True
 
     def _rpc_notify(self, ch, pool, oid, payload):
@@ -826,6 +771,58 @@ def cli_connect(connect: str, keyring: str | None, data_dir: str | None):
 
 
 # -- client ------------------------------------------------------------------
+
+def _client_handshake(ch: "Channel", cx: CephxClient) -> bytes:
+    """Client side of the cephx exchange over a blocking Channel; fills
+    ``cx`` with the session key + service ticket, switches ``ch`` to
+    secure mode, and returns the service session key."""
+    from .auth.cephx import Ticket, _proof, unseal
+    now = time.time()
+    ch.send(CephxBegin(cx.name))
+    challenge = ch.recv_one()
+    if not isinstance(challenge, CephxChallenge):
+        raise AuthError("expected CephxChallenge")
+    client_challenge = os.urandom(16)
+    proof = _proof(cx.key, challenge.challenge, client_challenge)
+    ch.send(CephxAuthenticate(client_challenge, proof))
+    sess = ch.recv_one()
+    if not isinstance(sess, CephxSession):
+        raise AuthError("expected CephxSession")
+    cx.session_key = unseal(cx.key, sess.env)["session_key"]
+    t = unseal(cx.session_key, sess.ticket_env)
+    cx.tickets[SERVICE] = Ticket(
+        service=SERVICE, blob=t["blob"], secret_id=t["secret_id"],
+        session_key=t["session_key"], expires=t["expires"])
+    authz = cx.build_authorizer(SERVICE, now)
+    ch.send(CephxAuthorize(authz))
+    done = ch.recv_one()
+    if not isinstance(done, CephxDone):
+        raise AuthError("expected CephxDone")
+    cx.verify_reply(SERVICE, done.reply, authz.nonce)  # mutual auth
+    # both ends switch to HMAC frames under the service session key
+    key = cx.tickets[SERVICE].session_key
+    ch.secure(key)
+    return key
+
+
+def dial_and_handshake(host: str, port: int, key: bytes,
+                       timeout: float = 10.0):
+    """Blocking dial + full cephx handshake; returns the authenticated
+    ``(socket, session_key)`` ready to hand to an async connection.
+    This is the msg/ package's entry point for new connections — the
+    only legitimately-blocking socket work stays HERE, outside the
+    reactor's readiness discipline."""
+    cx = CephxClient("client.admin", key)
+    sock = socket.create_connection((host, port), timeout=timeout)
+    sock.settimeout(None)
+    ch = Channel(sock)
+    try:
+        session_key = _client_handshake(ch, cx)
+    except BaseException:
+        ch.close()
+        raise
+    return sock, session_key
+
 
 class TcpRados:
     """A remote cluster handle: cephx-authenticated, HMAC-secured RPC.
@@ -869,15 +866,21 @@ class TcpRados:
         self._conn_lock = threading.Lock()
         self.reconnects = 0                 # successful re-dials
         self.resends = 0                    # rpc attempts after the first
-        self.ch: Channel | None = None
+        # one AsyncConnection on the shared client reactor (msg/): the
+        # old per-client reader THREAD is gone — replies and pushes
+        # arrive as readiness callbacks.  Same surface as before:
+        # .ch.secret, .ch.stats, .ch.send(), .ch.close()
+        self.ch = None
         self._connect()
 
     def _connect(self) -> None:
-        """Dial + handshake + reader thread (one connection's worth).
-        The new channel is PUBLISHED only after the handshake succeeds,
-        so concurrent senders never see a half-authenticated ``self.ch``
-        (the old, closed channel stays in place until then — their sends
-        fail with OSError and their retry loops come back around)."""
+        """Dial + blocking cephx handshake, then hand the authenticated
+        socket to the shared client reactor (one connection's worth).
+        The new connection is PUBLISHED only after the handshake
+        succeeds, so concurrent senders never see a half-authenticated
+        ``self.ch`` (the old, closed connection stays in place until
+        then — their sends fail with OSError and their retry loops come
+        back around)."""
         self._cephx = CephxClient("client.admin", self._key)
         sock = socket.create_connection((self._host, self._port),
                                         timeout=10.0)
@@ -888,12 +891,18 @@ class TcpRados:
         except BaseException:
             ch.close()
             raise
-        self.ch = ch
+        # the Channel wrapper retires; the socket lives on, secured,
+        # readiness-driven, on the shared reactor
+        from .msg.connection import AsyncConnection
+        from .msg.reactor import client_reactor
+        self.ch = AsyncConnection(
+            sock, client_reactor(),
+            secret=self._cephx.tickets[SERVICE].session_key,
+            name=f"rados.{self._session[:8]}",
+            on_message=self._on_message,
+            on_closed=self._on_conn_closed)
         with self._cond:
             self._dead = False
-        self._reader = threading.Thread(target=self._read_loop,
-                                        daemon=True)
-        self._reader.start()
 
     def _reconnect(self) -> None:
         """Bounded reconnect: full-jitter exponential backoff between
@@ -936,59 +945,31 @@ class TcpRados:
                 pass
 
     def _handshake(self, ch: Channel) -> None:
-        from .auth.cephx import _proof, unseal
-        now = time.time()
-        cx = self._cephx
-        ch.send(CephxBegin(cx.name))
-        challenge = ch.recv_one()
-        if not isinstance(challenge, CephxChallenge):
-            raise AuthError("expected CephxChallenge")
-        client_challenge = os.urandom(16)
-        proof = _proof(cx.key, challenge.challenge, client_challenge)
-        ch.send(CephxAuthenticate(client_challenge, proof))
-        sess = ch.recv_one()
-        if not isinstance(sess, CephxSession):
-            raise AuthError("expected CephxSession")
-        cx.session_key = unseal(cx.key, sess.env)["session_key"]
-        t = unseal(cx.session_key, sess.ticket_env)
-        from .auth.cephx import Ticket
-        cx.tickets[SERVICE] = Ticket(
-            service=SERVICE, blob=t["blob"], secret_id=t["secret_id"],
-            session_key=t["session_key"], expires=t["expires"])
-        authz = cx.build_authorizer(SERVICE, now)
-        ch.send(CephxAuthorize(authz))
-        done = ch.recv_one()
-        if not isinstance(done, CephxDone):
-            raise AuthError("expected CephxDone")
-        cx.verify_reply(SERVICE, done.reply, authz.nonce)  # mutual auth
-        # both ends switch to HMAC frames under the service session key
-        ch.secure(cx.tickets[SERVICE].session_key)
+        _client_handshake(ch, self._cephx)
 
-    # -- reader / correlation ------------------------------------------------
+    # -- reply / push callbacks (reactor thread) -----------------------------
 
-    def _read_loop(self) -> None:
-        ch = self.ch
-        try:
-            while True:
-                for msg in ch.recv_msgs():
-                    if isinstance(msg, RpcResult):
-                        with self._cond:
-                            if msg.rid in self._waiting:
-                                self._pending.setdefault(
-                                    msg.rid, []).append(msg)
-                                self._cond.notify_all()
-                            # else: a late duplicate of an answered
-                            # call — drop it, don't pin its payload
-                    elif isinstance(msg, NotifyPush):
-                        threading.Thread(target=self._run_watch_cb,
-                                         args=(msg,), daemon=True).start()
-        except (ConnectionError, WireError, OSError):
-            # the link died (reset, truncated frame, server gone): flag
-            # it and wake every waiter — call() reconnects and resends
+    def _on_message(self, conn, msg) -> None:
+        if isinstance(msg, RpcResult):
             with self._cond:
-                if self.ch is ch:         # not already superseded
-                    self._dead = True
-                self._cond.notify_all()
+                if msg.rid in self._waiting:
+                    self._pending.setdefault(msg.rid, []).append(msg)
+                    self._cond.notify_all()
+                # else: a late duplicate of an answered call — drop it,
+                # don't pin its payload
+        elif isinstance(msg, NotifyPush):
+            # the watch callback is user code and may block (it often
+            # answers with its own RPCs): off the reactor thread
+            threading.Thread(target=self._run_watch_cb,
+                             args=(msg,), daemon=True).start()
+
+    def _on_conn_closed(self, conn, exc) -> None:
+        # the link died (reset, truncated frame, server gone): flag it
+        # and wake every waiter — call() reconnects and resends
+        with self._cond:
+            if self.ch is conn:           # not already superseded
+                self._dead = True
+            self._cond.notify_all()
 
     def _run_watch_cb(self, push: NotifyPush) -> None:
         cb = self._watch_cbs.get(push.cookie)

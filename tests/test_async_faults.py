@@ -1,0 +1,231 @@
+"""Async stack under transport faults (ISSUE 14 satellite 3).
+
+The ``failure/`` transport planes — truncated frames, connection
+resets, black-holed requests, delays — driven against the reactor
+transport and the mux client: the frame state machine must survive any
+recv chunking, and the session layer must deliver ZERO acked-op loss
+(every put whose ack arrived reads back) with clean reconnects, exactly
+the contract tests/test_chaos.py pins for the threaded client.
+"""
+import threading
+
+import numpy as np
+import pytest
+
+from ceph_tpu.backend.wire import TAG_MESSAGE, WireError, frame_encode
+from ceph_tpu.common import Context
+from ceph_tpu.failure import FaultInjector, FaultPlan, TransportFaults
+from ceph_tpu.msg import MuxClient, StreamParser
+
+
+def _data(n, seed=0):
+    return np.random.default_rng(seed).integers(
+        0, 256, n, dtype=np.uint8).tobytes()
+
+
+# -- frame state machine vs hostile byte delivery ----------------------------
+
+class TestParserUnderFaults:
+    SECRET = b"f" * 32
+
+    def _stream(self, n=8):
+        return b"".join(
+            frame_encode(TAG_MESSAGE, [bytes([i])] * 2 + [b"p" * (100 * i)],
+                         secret=self.SECRET)
+            for i in range(1, n + 1))
+
+    def test_one_byte_at_a_time(self):
+        """The pathological recv pattern: every frame reassembles."""
+        blob = self._stream()
+        sp = StreamParser(self.SECRET)
+        tags = []
+        for i in range(len(blob)):
+            for tag, _segs in sp.feed(blob[i:i + 1]):
+                tags.append(tag)
+        assert tags == [TAG_MESSAGE] * 8
+        assert sp.pending() == 0
+
+    def test_reordered_partial_reads(self):
+        """Chunk boundaries shuffled across frame boundaries (a frame's
+        tail arriving fused with the next frame's head, in bursts):
+        byte ORDER is TCP's to keep, boundary placement is not."""
+        import random
+        blob = self._stream()
+        rng = random.Random(17)
+        cuts = sorted(rng.sample(range(1, len(blob)), 40))
+        pieces = [blob[a:b] for a, b in
+                  zip([0] + cuts, cuts + [len(blob)])]
+        # deliver in bursts of 1..4 pieces joined back-to-back
+        sp = StreamParser(self.SECRET)
+        got = 0
+        i = 0
+        while i < len(pieces):
+            k = rng.randint(1, 4)
+            got += len(sp.feed(b"".join(pieces[i:i + k])))
+            i += k
+        assert got == 8 and sp.pending() == 0
+
+    def test_truncated_stream_yields_nothing_then_heals_on_reconnect(self):
+        """A cut-off frame (mid-frame RST) parses to NOTHING — no
+        partially-validated output — and a FRESH parser on the new
+        connection replays the full frame cleanly."""
+        frame = frame_encode(TAG_MESSAGE, [b"op-payload" * 50],
+                             secret=self.SECRET)
+        sp = StreamParser(self.SECRET)
+        assert sp.feed(frame[:len(frame) // 2]) == []
+        assert sp.pending() == len(frame) // 2
+        # the transport closes on EOF; the resend rides a new parser
+        sp2 = StreamParser(self.SECRET)
+        out = sp2.feed(frame)
+        assert len(out) == 1
+        assert bytes(out[0][1][0]) == b"op-payload" * 50
+
+    def test_garbage_after_truncation_is_detected(self):
+        """Bytes resuming mid-frame after a truncation can't silently
+        decode: the preamble crc refuses the misaligned stream."""
+        frame = frame_encode(TAG_MESSAGE, [b"x" * 500],
+                             secret=self.SECRET)
+        sp = StreamParser(self.SECRET)
+        sp.feed(frame[:60])
+        with pytest.raises(WireError):
+            # a fresh frame glued onto the cut — misaligned preamble
+            sp.feed(frame)
+
+
+# -- the mux stack over injected transport faults ----------------------------
+
+def _served(tmp_path, plan, **overrides):
+    from ceph_tpu.cluster import MiniCluster
+    from ceph_tpu.net import ClusterServer
+    cct = Context(overrides={
+        "ms_rpc_timeout": 6.0, "ms_rpc_retry_attempts": 8,
+        "ms_reconnect_backoff_base": 0.01,
+        "ms_reconnect_backoff_cap": 0.05, **overrides})
+    c = MiniCluster(n_osds=3, osds_per_host=3, chunk_size=512,
+                    cct=cct, data_dir=tmp_path)
+    inj = c.inject_faults(plan)
+    server = ClusterServer(c)
+    server.inject_faults(inj)
+    server.start()
+    return c, server, inj, cct
+
+
+class TestMuxTransportFaults:
+    N_OPS = 24
+
+    def _mux(self, server, tmp_path, cct):
+        return MuxClient("127.0.0.1", server.port,
+                         tmp_path / "client.admin.keyring", cct=cct,
+                         n_conns=2)
+
+    def _hammer(self, mux, tag):
+        """Closed-loop puts across many sessions; returns the ACKED
+        model {oid: data} (an unacked op may or may not have landed —
+        only acked ones carry the zero-loss contract)."""
+        sessions = [mux.session() for _ in range(8)]
+        s0 = sessions[0]
+        s0.call("mkpool", {"name": "p", "replicated": True, "size": 3},
+                timeout=30.0)
+        model = {}
+        for i in range(self.N_OPS):
+            oid = f"{tag}{i % 6}"
+            data = _data(1536, seed=i)
+            try:
+                sessions[i % len(sessions)].call(
+                    "put", {"pool": "p", "oid": oid, "data": data})
+            except (ConnectionError, TimeoutError, IOError):
+                continue                      # unacked: no contract
+            model[oid] = data
+        return model
+
+    def _verify(self, mux, model):
+        s = mux.session()
+        for oid, want in sorted(model.items()):
+            for attempt in range(6):
+                try:
+                    assert s.call("get", {"pool": "p", "oid": oid}) \
+                        == want, oid
+                    break
+                except (ConnectionError, TimeoutError):
+                    continue
+            else:
+                raise AssertionError(f"get {oid} never completed")
+
+    def test_resets_zero_acked_loss_clean_reconnect(self, tmp_path):
+        plan = FaultPlan(seed=5, transport=TransportFaults(
+            reset_prob=0.10))
+        c, server, inj, cct = _served(tmp_path, plan)
+        mux = None
+        try:
+            mux = self._mux(server, tmp_path, cct)
+            model = self._hammer(mux, "r")
+            assert model, "no op was ever acked under resets"
+            self._verify(mux, model)
+            kinds = inj.summary()["planes"].get("transport", {})
+            assert kinds.get("reset", 0) + kinds.get("recv_reset", 0) > 0
+            assert mux.stats()["reconnects"] > 0, "no clean reconnect"
+            assert mux.live_connections() >= 1
+        finally:
+            if mux is not None:
+                mux.close()
+            server.stop()
+            c.shutdown()
+
+    def test_blackholes_resend_and_dedup(self, tmp_path):
+        """Swallowed requests heal by per-attempt resend; reqid dedup
+        keeps the re-applied puts exactly-once on the server."""
+        plan = FaultPlan(seed=9, transport=TransportFaults(
+            blackhole_prob=0.10))
+        c, server, inj, cct = _served(tmp_path, plan, ms_rpc_timeout=3.0)
+        mux = None
+        try:
+            mux = self._mux(server, tmp_path, cct)
+            model = self._hammer(mux, "b")
+            assert model
+            self._verify(mux, model)
+            assert inj.summary()["planes"][
+                "transport"].get("blackhole", 0) > 0
+            assert mux.stats()["resends"] > 0
+        finally:
+            if mux is not None:
+                mux.close()
+            server.stop()
+            c.shutdown()
+
+    def test_truncated_replies_and_delays(self, tmp_path):
+        """Cut frames + delays on the reply path: the client parser
+        hits EOF mid-frame, reconnects, resends — nothing acked lost."""
+        plan = FaultPlan(seed=4, transport=TransportFaults(
+            truncate_prob=0.08, delay_prob=0.2, delay_ms=1.0))
+        c, server, inj, cct = _served(tmp_path, plan)
+        mux = None
+        try:
+            mux = self._mux(server, tmp_path, cct)
+            model = self._hammer(mux, "t")
+            assert model
+            self._verify(mux, model)
+            assert inj.summary()["planes"][
+                "transport"].get("truncate", 0) > 0
+        finally:
+            if mux is not None:
+                mux.close()
+            server.stop()
+            c.shutdown()
+
+    def test_handshake_never_faulted(self, tmp_path):
+        """reset_prob=1.0: post-auth frames always die, yet a fresh mux
+        client can still dial and complete cephx — injection arms only
+        after authentication, so reconnects always get back in."""
+        plan = FaultPlan(seed=1, transport=TransportFaults(
+            reset_prob=1.0))
+        c, server, inj, cct = _served(tmp_path, plan)
+        mux = None
+        try:
+            mux = self._mux(server, tmp_path, cct)
+            mux.connect()
+            assert mux.live_connections() >= 1
+        finally:
+            if mux is not None:
+                mux.close()
+            server.stop()
+            c.shutdown()

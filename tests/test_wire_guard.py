@@ -20,7 +20,8 @@ ROOT = Path(__file__).resolve().parent.parent
 # transport-internal wrappers (the _-prefixed ones are excluded by name)
 NOT_WIRE_MESSAGES = {"FaultConfig"}
 
-MESSAGE_MODULES = ("ceph_tpu/backend/messages.py", "ceph_tpu/net.py")
+MESSAGE_MODULES = ("ceph_tpu/backend/messages.py", "ceph_tpu/net.py",
+                   "ceph_tpu/msg/proto.py")
 
 
 def _dataclass_names(path: Path) -> set[str]:
@@ -50,6 +51,7 @@ def test_ast_finds_message_dataclasses():
 def test_every_wire_message_registers_a_sizer():
     # importing the modules runs their register_wire_sizes() blocks
     import ceph_tpu.backend.messages  # noqa: F401
+    import ceph_tpu.msg.proto  # noqa: F401
     import ceph_tpu.net  # noqa: F401
     from ceph_tpu.common.wire_accounting import registered_wire_types
     registered = registered_wire_types()
@@ -68,11 +70,14 @@ def test_every_wire_message_registers_a_sizer():
 
 def test_rpc_registry_fully_metered():
     """Every type in net.py's RPC registry — the set that can actually
-    arrive on a socket — is individually metered."""
+    arrive on a socket, including the mux batch frames msg/proto.py
+    joins to it — is individually metered."""
+    import ceph_tpu.msg.proto  # noqa: F401 — joins net._TYPES
     import ceph_tpu.net as net
     from ceph_tpu.common.wire_accounting import registered_wire_types
     missing = sorted(set(net._TYPES) - registered_wire_types())
     assert not missing, f"unmetered RPC types: {missing}"
+    assert {"RpcBatch", "RpcResultBatch"} <= set(net._TYPES)
 
 
 def test_sizers_measure_payloads():

@@ -1,0 +1,6 @@
+"""Operator tooling (benchmarks, gates, reports).
+
+A package so bench.py and the tests can import the reusable entry
+points (``tools.rados_bench.run_mux_bench``, ``tools.perf_gate``)
+without path hacks; each script remains directly runnable too.
+"""

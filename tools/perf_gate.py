@@ -81,6 +81,15 @@ METRIC_PATHS = {
         ("recovery", "chain", "newcomer_ingress_per_byte"), False),
     "recovery.chain.speedup_vs_centralized": (
         ("recovery", "chain", "speedup_vs_centralized"), True),
+    # async messenger (ISSUE 14): 10k logical closed-loop clients over
+    # few connections — clean-capacity goodput and p99, plus goodput
+    # while the overload arm sheds by class.  `clients` is held to an
+    # absolute floor (METRIC_LIMITS): the concurrency claim itself.
+    "serving.async.ops_s": (("serving", "async", "ops_s"), True),
+    "serving.async.p99_ms": (("serving", "async", "p99_ms"), False),
+    "serving.async.clients": (("serving", "async", "clients"), True),
+    "serving.async.overload.ops_s": (
+        ("serving", "async", "overload", "ops_s"), True),
 }
 
 # absolute bounds checked on the NEW artifact alone — no reference
@@ -98,6 +107,9 @@ METRIC_LIMITS = {
     "recovery.chain.coordinator_ingress_per_byte": (0.5, "max"),
     "recovery.chain.wire_per_byte": (4.6, "max"),
     "recovery.chain.speedup_vs_centralized": (0.95, "min"),
+    # the ISSUE 14 acceptance floor: the async bench must actually run
+    # >= 10k concurrent closed-loop sessions, every artifact, no ref
+    "serving.async.clients": (10000, "min"),
 }
 
 # fraction of regression tolerated per metric before the gate fails;
@@ -122,7 +134,12 @@ METRIC_THRESHOLDS = {"efficiency.pct_of_peak": 0.30,
                      "slo.budget_remaining": 0.30,
                      # a ratio of two wall-clock arms: gate cliffs only
                      # (the absolute floor in METRIC_LIMITS still holds)
-                     "recovery.chain.speedup_vs_centralized": 0.30}
+                     "recovery.chain.speedup_vs_centralized": 0.30,
+                     # socket wall-clock at 10k concurrency on a shared
+                     # host: gate cliffs, not scheduler jitter
+                     "serving.async.ops_s": 0.30,
+                     "serving.async.p99_ms": 0.50,
+                     "serving.async.overload.ops_s": 0.30}
 
 _BLOCK_DEVICE = {
     "core.mib_s": ("device",),
@@ -142,6 +159,10 @@ _BLOCK_DEVICE = {
     "recovery.chain.coordinator_ingress_per_byte": ("recovery", "device"),
     "recovery.chain.newcomer_ingress_per_byte": ("recovery", "device"),
     "recovery.chain.speedup_vs_centralized": ("recovery", "device"),
+    "serving.async.ops_s": ("serving", "device"),
+    "serving.async.p99_ms": ("serving", "device"),
+    "serving.async.clients": ("serving", "device"),
+    "serving.async.overload.ops_s": ("serving", "device"),
 }
 
 

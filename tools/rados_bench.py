@@ -74,12 +74,173 @@ def human(result: dict, out) -> None:
     w(f"Mean batch size:    {result['mean_batch_size']}\n")
 
 
+def _pct(sorted_vals, p):
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1, int(p / 100.0 * len(sorted_vals)))
+    return sorted_vals[i]
+
+
+def run_mux_bench(n_clients: int = 10000, ops_per_client: int = 2,
+                  n_conns: int = 8, payload_bytes: int = 64,
+                  queue_max: int | None = None,
+                  op_threads: int | None = None,
+                  timeout_s: float = 120.0) -> dict:
+    """Closed-loop mux bench: ``n_clients`` logical sessions multiplexed
+    over ``n_conns`` TCP connections to an async ClusterServer, each
+    running ``ops_per_client`` ping RPCs closed-loop (next op submits
+    when the previous completes).  A shed (EBUSY) refusal RETRIES the op
+    — goodput counts only completed work — so with ``queue_max`` set low
+    this measures goodput + shed-rate UNDER OVERLOAD, and with it high
+    it measures clean concurrency capacity.  Returns goodput (ops/s),
+    latency percentiles, shed-rate, and transport stats.
+    """
+    import os
+    import tempfile
+    import threading
+    import time
+
+    from ceph_tpu.cluster import MiniCluster
+    from ceph_tpu.msg import MuxClient
+    from ceph_tpu.net import KEYRING, ClusterServer
+
+    with tempfile.TemporaryDirectory() as td:
+        cluster = MiniCluster(n_osds=3, osds_per_host=3, chunk_size=512,
+                              data_dir=td)
+        conf = cluster.cct.conf
+        saved = {}
+        overrides = {}
+        if queue_max is not None:
+            overrides["ms_async_dispatch_queue_max"] = queue_max
+        if op_threads is not None:
+            overrides["ms_async_op_threads"] = op_threads
+        for k, v in overrides.items():
+            saved[k] = conf.get(k)
+            conf.set(k, v)
+        server = ClusterServer(cluster)
+        mux = None
+        try:
+            server.start()
+            mux = MuxClient("127.0.0.1", server.port,
+                            os.path.join(td, KEYRING), n_conns=n_conns)
+            mux.connect()
+            payload = b"\xab" * payload_bytes
+            total = n_clients * ops_per_client
+            lock = threading.Lock()
+            state = {"done": 0, "failed": 0, "shed_retries": 0}
+            lats: list[float] = []
+            finished = threading.Event()
+
+            def mk_cb(sess, left):
+                def cb(call):
+                    import errno as _errno
+                    r = call.result
+                    shed = (not isinstance(r, BaseException)
+                            and not r.ok and r.errno == _errno.EBUSY)
+                    with lock:
+                        if shed:
+                            state["shed_retries"] += 1
+                        elif isinstance(r, BaseException) or not r.ok:
+                            state["failed"] += 1
+                            state["done"] += 1
+                        else:
+                            lats.append(
+                                time.monotonic() - call.t_submit)
+                            state["done"] += 1
+                        fin = state["done"] >= total
+                    if fin:
+                        finished.set()
+                        return
+                    if shed:        # refused: retry the SAME op
+                        sess.call_async("ping", {"payload": payload},
+                                        cb=mk_cb(sess, left))
+                    elif left > 1:  # completed: next op in the loop
+                        sess.call_async("ping", {"payload": payload},
+                                        cb=mk_cb(sess, left - 1))
+                return cb
+
+            t0 = time.perf_counter()
+            for _ in range(n_clients):
+                s = mux.session()
+                s.call_async("ping", {"payload": payload},
+                             cb=mk_cb(s, ops_per_client))
+            ok = finished.wait(timeout_s)
+            elapsed = time.perf_counter() - t0
+            lats.sort()
+            st = mux.stats()
+            shed_snap = (server._transport.shed.snapshot()
+                         if server._transport is not None else {})
+            completed = state["done"] - state["failed"]
+            arrivals = completed + state["shed_retries"]
+            return {
+                "mode": "mux",
+                "clients": n_clients,
+                "connections": st["connections"],
+                "ops_per_client": ops_per_client,
+                "completed": completed,
+                "failed": state["failed"],
+                "finished_in_time": bool(ok),
+                "elapsed_s": round(elapsed, 4),
+                "ops_s": round(completed / elapsed, 1) if elapsed else 0.0,
+                "p50_ms": round(_pct(lats, 50) * 1e3, 3),
+                "p95_ms": round(_pct(lats, 95) * 1e3, 3),
+                "p99_ms": round(_pct(lats, 99) * 1e3, 3),
+                "shed_retries": state["shed_retries"],
+                "shed_rate": round(
+                    state["shed_retries"] / arrivals, 4) if arrivals
+                else 0.0,
+                "server_shed": shed_snap,
+                "mux_stats": st,
+                "threads": threading.active_count(),
+            }
+        finally:
+            if mux is not None:
+                mux.close()
+            server.stop()
+            cluster.shutdown()
+            for k, v in saved.items():
+                conf.set(k, v)
+
+
+def run_mux_overload_pair(n_clients: int = 10000,
+                          ops_per_client: int = 2,
+                          n_conns: int = 8,
+                          overload_queue_max: int = 64) -> dict:
+    """The bench.py ``serving.async`` block: one clean-capacity run
+    (queue limit ABOVE the client count: nothing sheds) and one
+    overload run (tiny dispatch queue, one worker: the shed ladder must
+    refuse work while goodput continues)."""
+    capacity = run_mux_bench(n_clients, ops_per_client, n_conns,
+                             queue_max=max(2 * n_clients, 2048))
+    overload = run_mux_bench(min(n_clients, 2000), ops_per_client,
+                             n_conns, queue_max=overload_queue_max,
+                             op_threads=1)
+    return {
+        "clients": capacity["clients"],
+        "ops_s": capacity["ops_s"],
+        "p99_ms": capacity["p99_ms"],
+        "p50_ms": capacity["p50_ms"],
+        "threads": capacity["threads"],
+        "capacity": capacity,
+        "overload": {
+            "clients": overload["clients"],
+            "ops_s": overload["ops_s"],
+            "p99_ms": overload["p99_ms"],
+            "shed_rate": overload["shed_rate"],
+            "shed_retries": overload["shed_retries"],
+            "server_shed": overload["server_shed"],
+            "completed": overload["completed"],
+        },
+    }
+
+
 def main(argv=None) -> int:
     from ceph_tpu.utils.platform import honour_jax_platforms_env
     honour_jax_platforms_env()
     ap = argparse.ArgumentParser(
         prog="rados_bench", description=__doc__.splitlines()[0])
-    ap.add_argument("--mode", choices=["closed", "open"], default="closed")
+    ap.add_argument("--mode", choices=["closed", "open", "mux"],
+                    default="closed")
     ap.add_argument("--ops", type=int, default=512,
                     help="closed loop: total ops to complete")
     ap.add_argument("--concurrency", type=int, default=64,
@@ -106,8 +267,39 @@ def main(argv=None) -> int:
                     help="run batched AND unbatched, report the speedup")
     ap.add_argument("--warmup", type=int, default=64,
                     help="warmup ops per engine (compiles size buckets)")
+    ap.add_argument("--clients", type=int, default=10000,
+                    help="mux mode: logical closed-loop sessions")
+    ap.add_argument("--ops-per-client", type=int, default=2,
+                    help="mux mode: RPCs each session completes")
+    ap.add_argument("--conns", type=int, default=8,
+                    help="mux mode: TCP connections carrying all sessions")
+    ap.add_argument("--overload-queue-max", type=int, default=64,
+                    help="mux mode: dispatch-queue limit for the overload "
+                         "arm (tiny = heavy shedding)")
     ap.add_argument("--json", action="store_true", dest="as_json")
     args = ap.parse_args(argv)
+
+    if args.mode == "mux":
+        result = run_mux_overload_pair(
+            n_clients=args.clients, ops_per_client=args.ops_per_client,
+            n_conns=args.conns,
+            overload_queue_max=args.overload_queue_max)
+        if args.as_json:
+            print(json.dumps(result))
+        else:
+            w = sys.stdout.write
+            w(f"mux capacity:  {result['clients']} clients over "
+              f"{args.conns} conns  {result['ops_s']:.0f} ops/s  "
+              f"p50 {result['p50_ms']:.3f} ms  "
+              f"p99 {result['p99_ms']:.3f} ms  "
+              f"threads {result['threads']}\n")
+            ov = result["overload"]
+            w(f"mux overload:  {ov['clients']} clients  "
+              f"{ov['ops_s']:.0f} ops/s goodput  "
+              f"p99 {ov['p99_ms']:.3f} ms  "
+              f"shed-rate {ov['shed_rate']:.2%} "
+              f"({ov['shed_retries']} refusals)\n")
+        return 0
 
     from ceph_tpu.common import parse_size
     from ceph_tpu.exec import ServingEngine
