@@ -39,6 +39,7 @@ import sys
 import threading
 import time
 from contextlib import contextmanager
+from pathlib import Path
 
 import numpy as np
 
@@ -305,6 +306,7 @@ _PIPELINE: dict | None = None    # the async-pipeline comparison block
 _EFFICIENCY: dict | None = None  # the roofline device-efficiency block
 _RESILIENCE: dict | None = None  # goodput under faults + breaker fallback
 _SLO: dict | None = None         # critical-path attribution + budget block
+_LINT: dict | None = None        # ceph-lint static-analysis summary block
 
 
 def _pipeline_pass(sinfo, ec, batches, degraded, depth: int,
@@ -887,6 +889,25 @@ def efficiency_section(platform: str | None) -> dict:
         return {"device": "none", "error": repr(e)[:200]}
 
 
+def lint_section() -> dict:
+    """ceph-lint over the tree with the committed baseline applied
+    (ISSUE 15): carried in the artifact so the perf-gate history tracks
+    the finding trajectory — ``lint.new`` must stay 0, and a growing
+    ``lint.baselined`` count shows debt accumulating even while the
+    gate is green."""
+    try:
+        from tools.ceph_lint import lint_summary
+        block = lint_summary(Path(__file__).resolve().parent
+                             / ".ceph_lint_baseline.json")
+        print(f"# lint: {block['new']} new, {block['baselined']} "
+              f"baselined, {block['rules_run']} rules",
+              file=sys.stderr)
+        return block
+    except Exception as e:                 # never fail the artifact
+        print(f"# lint section failed: {e!r}", file=sys.stderr)
+        return {"error": repr(e)[:200]}
+
+
 def emit(value, vs_baseline, extra):
     """Print the one driver JSON line — at most once per process (the
     watchdog thread and the main path can race to it)."""
@@ -918,6 +939,8 @@ def emit(value, vs_baseline, extra):
         line.setdefault("resilience", _RESILIENCE)
     if _SLO is not None:
         line.setdefault("slo", _SLO)
+    if _LINT is not None:
+        line.setdefault("lint", _LINT)
     # always carried, even on the watchdog/fallback paths: the per-phase
     # breakdown and the per-attempt probe record accumulated so far.  A
     # phase still OPEN when the watchdog fires is exactly the one that
@@ -1114,7 +1137,11 @@ def main() -> int:
     # serving comparison (coalesced vs op-at-a-time) on whatever device
     # is up — its own subsystem, measured before the device codec pass so
     # a tunnel death mid-codec still leaves the serving block in the line
-    global _SERVING, _RECOVERY, _PIPELINE, _EFFICIENCY, _RESILIENCE, _SLO
+    global _SERVING, _RECOVERY, _PIPELINE, _EFFICIENCY, _RESILIENCE, \
+        _SLO, _LINT
+    # static-analysis trajectory first: pure AST work, no device needed,
+    # so even a probe/tunnel death right after still carries the block
+    _LINT = lint_section()
     _SERVING = serving_section(platform)
     # repair-throughput comparison (batched waves vs per-object) on the
     # same device — like serving, measured before the codec pass so a

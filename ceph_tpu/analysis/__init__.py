@@ -1,0 +1,37 @@
+"""ceph-lint: one static-analysis engine for the whole tree.
+
+Every guard test used to carry its own ``ast`` walker; none of them
+could see across module boundaries.  This package replaces the ten
+parallel walkers with ONE engine (reference analog: the checks Ceph
+ships as ``src/common/lockdep.cc`` + the mutex-debug layer, done ahead
+of time instead of at runtime):
+
+- :mod:`.engine`   — the project index (AST for every file + a
+  cross-module symbol/call index) and the declarative rule registry;
+- :mod:`.lockmodel` — the shared lock/acquisition walker (who holds
+  what, where) both deep analyses build on;
+- :mod:`.rules_locks`   — lock-order deadlock detection + callbacks/
+  sends invoked under a held lock;
+- :mod:`.rules_threads` — thread-context classification + cross-thread
+  unlocked-mutation detection;
+- :mod:`.rules_jax`     — JAX dispatch-purity (host syncs reachable
+  under jit, recompile-prone signatures, donated-buffer reuse);
+- :mod:`.rules_guards`  — the ten migrated legacy guards (host-sync,
+  bounded queues/retries, blocking sockets, span owner/phase, profiler
+  confinement, bare clocks, counter help, percentile redefinitions,
+  wire-sizer registry).
+
+Entry points: ``tools/ceph_lint.py`` (CLI with ``--baseline``) and
+``tests/test_ceph_lint.py`` (tier-1).  Import stays jax-free; rules
+that need runtime registries import them lazily inside their check.
+"""
+from .engine import (Finding, ProjectIndex, Rule, all_rules,  # noqa: F401
+                     default_index, get_rule, load_baseline,
+                     run_rule_on_sources, run_rules, split_by_baseline,
+                     write_baseline)
+
+# registering the rule modules populates the registry as a side effect
+from . import rules_guards  # noqa: F401,E402
+from . import rules_jax  # noqa: F401,E402
+from . import rules_locks  # noqa: F401,E402
+from . import rules_threads  # noqa: F401,E402

@@ -1,0 +1,351 @@
+"""JAX dispatch-purity: keep the jitted hot path silently fast.
+
+Three hazards, all invisible at runtime until they cost you:
+
+- ``jit-host-sync`` — a host synchronisation (``device_get``,
+  ``block_until_ready``, ``.item()`` / ``.tolist()``, ``np.asarray``)
+  reachable from inside a jitted function body.  Inside a trace these
+  either fail late or silently force a transfer per call.
+- ``jit-nonstatic-shape`` — a non-static jit parameter used where a
+  shape/length is expected (``jnp.zeros(n)``, ``range(n)``,
+  ``.reshape(n, -1)``): every distinct value recompiles.
+- ``jit-traced-control-flow`` — a non-static parameter steering
+  Python ``if``/``while`` inside a jitted body; works only while the
+  caller passes Python scalars, and then recompiles per value.
+- ``jit-donated-reuse`` — an argument passed in a ``donate_argnums``
+  position is read again after the call without being rebound; the
+  buffer was handed to XLA and may alias the output.
+
+Jit detection understands ``@jax.jit``, ``@traced_jit`` (the local
+wrapper forwards ``static_argnames``/``donate_argnums`` through), and
+``@functools.partial(jax.jit, ...)``.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from .engine import Finding, FunctionInfo, ProjectIndex, rule
+
+_JIT_NAMES = {"jit", "traced_jit"}
+_HOST_SYNC_ATTRS = {"device_get", "block_until_ready", "item",
+                    "tolist", "copy_to_host_async"}
+_NP_SYNC_FNS = {"asarray", "array", "float64", "float32"}
+_SHAPE_CTORS = {"zeros", "ones", "full", "empty", "arange", "linspace",
+                "eye", "tile", "broadcast_to", "reshape", "repeat"}
+_MAX_DEPTH = 3
+
+
+@dataclass
+class JitInfo:
+    fi: FunctionInfo
+    static_names: set[str] = field(default_factory=set)
+    static_nums: set[int] = field(default_factory=set)
+    donate_nums: set[int] = field(default_factory=set)
+
+    def param_names(self) -> list[str]:
+        a = self.fi.node.args
+        return [p.arg for p in
+                list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)]
+
+    def static_params(self) -> set[str]:
+        names = self.param_names()
+        out = set(self.static_names)
+        for i in sorted(self.static_nums):
+            if i < len(names):
+                out.add(names[i])
+        return out
+
+
+def _str_items(node: ast.expr) -> set[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        out: set[str] = set()
+        for e in node.elts:
+            out |= _str_items(e)
+        return out
+    return set()
+
+
+def _int_items(node: ast.expr) -> set[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        out: set[int] = set()
+        for e in node.elts:
+            out |= _int_items(e)
+        return out
+    return set()
+
+
+def _is_jit_ref(expr: ast.expr) -> bool:
+    if isinstance(expr, ast.Name):
+        return expr.id in _JIT_NAMES
+    if isinstance(expr, ast.Attribute):
+        return expr.attr in _JIT_NAMES
+    return False
+
+
+def _jit_info(fi: FunctionInfo) -> JitInfo | None:
+    for deco in fi.node.decorator_list:
+        target: ast.Call | None = None
+        if _is_jit_ref(deco):
+            return JitInfo(fi)
+        if isinstance(deco, ast.Call):
+            if _is_jit_ref(deco.func):
+                target = deco
+            elif isinstance(deco.func, (ast.Name, ast.Attribute)):
+                # functools.partial(jax.jit, ...)
+                fname = deco.func.id if isinstance(deco.func, ast.Name) \
+                    else deco.func.attr
+                if fname == "partial" and deco.args and \
+                        _is_jit_ref(deco.args[0]):
+                    target = deco
+        if target is None:
+            continue
+        info = JitInfo(fi)
+        for kw in target.keywords:
+            if kw.arg == "static_argnames":
+                info.static_names |= _str_items(kw.value)
+            elif kw.arg == "static_argnums":
+                info.static_nums |= _int_items(kw.value)
+            elif kw.arg == "donate_argnums":
+                info.donate_nums |= _int_items(kw.value)
+        return info
+    return None
+
+
+def jitted_functions(index: ProjectIndex) -> list[JitInfo]:
+    out = []
+    for mod in index.iter_modules(("ceph_tpu",)):
+        for fi in mod.functions.values():
+            info = _jit_info(fi)
+            if info is not None:
+                out.append(info)
+    return out
+
+
+def _np_alias(index: ProjectIndex, rel: str) -> set[str]:
+    mod = index.modules[rel]
+    return {alias for alias, dotted in mod.import_aliases.items()
+            if dotted.split(".")[0] == "numpy"}
+
+
+def _host_sync_sites(index: ProjectIndex,
+                     fi: FunctionInfo) -> list[tuple[int, str]]:
+    np_names = _np_alias(index, fi.rel)
+    sites: list[tuple[int, str]] = []
+    for node in ast.walk(fi.node):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            if f.attr in _HOST_SYNC_ATTRS:
+                sites.append((node.lineno, f.attr))
+            elif f.attr in _NP_SYNC_FNS and \
+                    isinstance(f.value, ast.Name) and \
+                    f.value.id in np_names:
+                sites.append((node.lineno, f"np.{f.attr}"))
+    return sites
+
+
+@rule("jit-host-sync", severity="error", scope=("ceph_tpu",),
+      description="a host synchronisation (device_get / "
+                  "block_until_ready / .item() / np.asarray) is "
+                  "reachable inside a jitted function")
+def check_jit_host_sync(index: ProjectIndex) -> list[Finding]:
+    out: list[Finding] = []
+    for info in jitted_functions(index):
+        seen: set[str] = {info.fi.ref}
+        frontier = [(info.fi, 0)]
+        while frontier:
+            fi, depth = frontier.pop()
+            for line, what in _host_sync_sites(index, fi):
+                via = "" if fi.ref == info.fi.ref else \
+                    f" via {fi.qualname}"
+                out.append(Finding(
+                    "jit-host-sync", info.fi.rel,
+                    line if fi.ref == info.fi.ref
+                    else info.fi.node.lineno, "error",
+                    f"host sync {what}() reachable inside jitted "
+                    f"{info.fi.qualname}{via}"))
+            if depth >= _MAX_DEPTH:
+                continue
+            for node in ast.walk(fi.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                for callee in index.resolve_call(fi, node):
+                    # traced_jit.py is the dispatch boundary itself:
+                    # its syncs run at call time, outside the trace
+                    if callee.ref not in seen and \
+                            callee.rel.startswith("ceph_tpu") and \
+                            not callee.rel.endswith("traced_jit.py"):
+                        seen.add(callee.ref)
+                        frontier.append((callee, depth + 1))
+    return out
+
+
+def _param_names_in(expr: ast.expr, params: set[str]) -> set[str]:
+    return {n.id for n in ast.walk(expr)
+            if isinstance(n, ast.Name) and n.id in params}
+
+
+@rule("jit-nonstatic-shape", severity="warning", scope=("ceph_tpu",),
+      description="a non-static jit parameter feeds a shape/length "
+                  "(jnp.zeros(n), range(n), reshape) — every distinct "
+                  "value triggers a silent recompile")
+def check_jit_nonstatic_shape(index: ProjectIndex) -> list[Finding]:
+    out: list[Finding] = []
+    for info in jitted_functions(index):
+        traced = set(info.param_names()) - info.static_params() - {"self"}
+        if not traced:
+            continue
+        for node in ast.walk(info.fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            fname = f.attr if isinstance(f, ast.Attribute) else \
+                f.id if isinstance(f, ast.Name) else None
+            if fname == "range" or fname in _SHAPE_CTORS:
+                shape_args = node.args[:2] if fname != "reshape" \
+                    else node.args
+                hits: set[str] = set()
+                for a in shape_args:
+                    hits |= _param_names_in(a, traced)
+                for h in sorted(hits):
+                    out.append(Finding(
+                        "jit-nonstatic-shape", info.fi.rel,
+                        node.lineno, "warning",
+                        f"non-static parameter {h!r} used as a "
+                        f"shape/length in {fname}() inside jitted "
+                        f"{info.fi.qualname}"))
+    return out
+
+
+@rule("jit-traced-control-flow", severity="warning", scope=("ceph_tpu",),
+      description="a non-static jit parameter steers Python if/while "
+                  "inside a jitted body (works only with Python "
+                  "scalars, then recompiles per value)")
+def check_jit_traced_control_flow(index: ProjectIndex) -> list[Finding]:
+    out: list[Finding] = []
+    for info in jitted_functions(index):
+        traced = set(info.param_names()) - info.static_params() - {"self"}
+        if not traced:
+            continue
+        for node in ast.walk(info.fi.node):
+            if not isinstance(node, (ast.If, ast.While)):
+                continue
+            for h in sorted(_param_names_in(node.test, traced)):
+                out.append(Finding(
+                    "jit-traced-control-flow", info.fi.rel,
+                    node.lineno, "warning",
+                    f"non-static parameter {h!r} steers Python "
+                    f"control flow inside jitted {info.fi.qualname}"))
+    return out
+
+
+@rule("jit-donated-reuse", severity="error", scope=("ceph_tpu",),
+      description="an argument passed in a donate_argnums position "
+                  "is read after the call without being rebound — "
+                  "the buffer belongs to XLA now")
+def check_jit_donated_reuse(index: ProjectIndex) -> list[Finding]:
+    donating = {info.fi.name: info for info in jitted_functions(index)
+                if info.donate_nums}
+    if not donating:
+        return []
+    out: list[Finding] = []
+    for mod in index.iter_modules(("ceph_tpu",)):
+        for fi in mod.functions.values():
+            for node in ast.walk(fi.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                fname = node.func.id \
+                    if isinstance(node.func, ast.Name) else \
+                    node.func.attr \
+                    if isinstance(node.func, ast.Attribute) else None
+                info = donating.get(fname or "")
+                if info is None:
+                    continue
+                resolved = index.resolve_call(fi, node)
+                if not any(c.ref == info.fi.ref for c in resolved):
+                    continue
+                out.extend(_donated_reuse_at(fi, node, info))
+    return out
+
+
+def _blocks_of(stmt: ast.stmt) -> list[list[ast.stmt]]:
+    out: list[list[ast.stmt]] = []
+    for field in ("body", "orelse", "finalbody"):
+        blk = getattr(stmt, field, None)
+        if blk:
+            out.append(blk)
+    for h in getattr(stmt, "handlers", []) or []:
+        out.append(h.body)
+    return out
+
+
+def _following_stmts(fn_node: ast.AST, call: ast.Call) -> list[ast.stmt]:
+    """Statements that execute AFTER the one containing ``call`` —
+    its later siblings at every nesting level, so a read in the other
+    arm of an if/else does not count.  (Loop back-edges are a known
+    hole: a donated call late in a loop body with a reuse early in
+    the next iteration is missed.)"""
+    out: list[ast.stmt] = []
+
+    def rec(body: list[ast.stmt]) -> str | None:
+        """None = call not in this block; else 'open'/'terminated' —
+        whether the path containing the call falls through this block
+        (``return _f(x, donated=...)`` terminates it: later siblings
+        are unreachable on the call's path)."""
+        for i, stmt in enumerate(body):
+            if not any(n is call for n in ast.walk(stmt)):
+                continue
+            terminated = isinstance(stmt, (ast.Return, ast.Raise))
+            for blk in _blocks_of(stmt):
+                r = rec(blk)
+                if r is not None:
+                    terminated = terminated or r == "terminated"
+                    break
+            if not terminated:
+                rest = body[i + 1:]
+                out.extend(rest)
+                terminated = any(isinstance(s, (ast.Return, ast.Raise))
+                                 for s in rest)
+            return "terminated" if terminated else "open"
+        return None
+
+    rec(fn_node.body)
+    return out
+
+
+def _donated_reuse_at(fi: FunctionInfo, call: ast.Call,
+                      info: JitInfo) -> list[Finding]:
+    donated: set[str] = set()
+    for i in info.donate_nums:
+        if i < len(call.args) and isinstance(call.args[i], ast.Name):
+            donated.add(call.args[i].id)
+    if not donated:
+        return []
+    # names the call's result rebinds are fresh again: x = f(x)
+    rebound: set[str] = set()
+    for node in ast.walk(fi.node):
+        if isinstance(node, ast.Assign) and node.value is call:
+            for t in node.targets:
+                for n in ast.walk(t):
+                    if isinstance(n, ast.Name):
+                        rebound.add(n.id)
+    live = donated - rebound
+    if not live:
+        return []
+    out = []
+    for stmt in _following_stmts(fi.node, call):
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Name) and \
+                    isinstance(node.ctx, ast.Load) and node.id in live:
+                out.append(Finding(
+                    "jit-donated-reuse", fi.rel, node.lineno, "error",
+                    f"donated buffer {node.id!r} read after the call "
+                    f"to {info.fi.name}() in {fi.qualname}"))
+                live.discard(node.id)
+    return out

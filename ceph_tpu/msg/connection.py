@@ -194,8 +194,11 @@ class AsyncConnection:
                 return
             nbytes = sizes[i] if i < len(sizes) else \
                 sum(len(s) for s in segs) + wire_accounting.MSG_OVERHEAD
-            self.stats["rx_msgs"] += 1
-            self.stats["rx_bytes"] += nbytes
+            # tx bumps run under _wlock on sender threads; take it here
+            # too so the read-modify-write pairs can't lose updates
+            with self._wlock:
+                self.stats["rx_msgs"] += 1
+                self.stats["rx_bytes"] += nbytes
             if self.acct is not None:
                 self.acct.account_rx(type(msg).__name__, nbytes,
                                      ctx=getattr(msg, "trace", None))

@@ -284,7 +284,14 @@ class AsyncServerTransport:
     def _on_closed(self, conn: AsyncConnection, exc) -> None:
         with self._conns_lock:
             self._conns.discard(conn)
-        self._release_auth(conn)
+        # auth promotion mutates reactor-affine state (_auth_holder /
+        # _auth_fifo, next-waiter handshake sends): off-loop closes
+        # (stop(), client-thread aborts) trampoline like register()
+        # does instead of racing the in-flight _auth_step
+        if self.reactor.in_reactor() or not self.reactor.running:
+            self._release_auth(conn)
+        else:
+            self.reactor.call_soon(lambda: self._release_auth(conn))
         if conn.auth.timer is not None:
             conn.auth.timer.cancel()
         self.core._conn_closed(conn)

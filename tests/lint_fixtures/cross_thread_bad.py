@@ -1,0 +1,18 @@
+"""Fixture: a known cross-thread unlocked mutation.  ``Worker.count``
+is written by the spawned worker thread (``_loop``) and by public
+callers (``bump``), and neither write holds ``_lock``."""
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def _loop(self):
+        for _ in range(100):
+            self.count += 1
+
+    def bump(self):
+        self.count += 1

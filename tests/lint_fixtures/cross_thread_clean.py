@@ -1,0 +1,19 @@
+"""Clean twin of ``cross_thread_bad``: both writers take ``_lock``
+around the mutation, so the contexts share a common lock."""
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def _loop(self):
+        for _ in range(100):
+            with self._lock:
+                self.count += 1
+
+    def bump(self):
+        with self._lock:
+            self.count += 1

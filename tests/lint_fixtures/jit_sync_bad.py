@@ -1,0 +1,32 @@
+"""Fixture: host syncs reachable under jit — one directly in the
+jitted body, one through a helper call; plus a donated buffer read
+after the dispatch."""
+import functools
+
+import jax
+
+
+@jax.jit
+def direct_sync(x):
+    y = x + 1
+    host = jax.device_get(y)
+    return y, host
+
+
+def _helper(y):
+    return y.block_until_ready()
+
+
+@jax.jit
+def transitive_sync(x):
+    return _helper(x * 2)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def consume(buf):
+    return buf * 2
+
+
+def reuse_after_donation(buf):
+    out = consume(buf)
+    return out, buf

@@ -1,0 +1,25 @@
+"""Clean twin of ``jit_sync_bad``: pure jitted bodies, the sync lives
+outside the trace, and the donated name is rebound by the call."""
+import functools
+
+import jax
+
+
+@jax.jit
+def pure(x):
+    return x + 1
+
+
+def sync_outside(x):
+    y = pure(x)
+    return jax.device_get(y)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def consume(buf):
+    return buf * 2
+
+
+def rebind_after_donation(buf):
+    buf = consume(buf)
+    return buf

@@ -1,0 +1,36 @@
+"""Fixture: a seeded A->B / B->A lock-order cycle.
+
+``Alpha.cross`` takes Alpha._lock then (via the beta attribute's
+typed method) Beta._lock; ``Beta.cross`` takes Beta._lock then (via
+the unique-name fallback on ``ping``) Alpha._lock.  The lock graph
+has the 2-cycle the detector must find.
+"""
+import threading
+
+
+class Beta:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.alpha = None
+
+    def poke(self):
+        with self._lock:
+            return 1
+
+    def cross(self):
+        with self._lock:
+            self.alpha.ping()
+
+
+class Alpha:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.beta = Beta()
+
+    def ping(self):
+        with self._lock:
+            return 2
+
+    def cross(self):
+        with self._lock:
+            self.beta.poke()

@@ -1,0 +1,27 @@
+"""Clean twin of ``lock_cycle_bad``: both cross-class paths take the
+locks in the same order (Alpha._lock before Beta._lock), so the lock
+graph is acyclic."""
+import threading
+
+
+class Beta:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def poke(self):
+        with self._lock:
+            return 1
+
+
+class Alpha:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.beta = Beta()
+
+    def cross(self):
+        with self._lock:
+            self.beta.poke()
+
+    def also_cross(self):
+        with self._lock:
+            self.beta.poke()

@@ -1,164 +1,58 @@
 """Guard: every retry/reconnect loop in ``net.py``, ``client/`` and
 ``failure/`` carries a bounded attempt count or deadline.
 
-Sibling of ``test_no_unbounded_queue.py``: the self-healing layer
-(ISSUE 9) retries by design — reconnect-with-backoff, resend-on-reset,
-half-open probes — and an UNBOUNDED retry loop smuggled into it turns a
-dead server into a live-locked client spinning forever.  The discipline:
-retry loops are ``for`` loops over a bounded schedule
-(``range(attempts)``, ``ExponentialBackoff.delays()``), never bare
-``while True`` spins that swallow connection errors.
-
-What the scan flags (by AST, so multiline code and aliases are caught):
-a ``while`` loop with a CONSTANT-TRUE test whose body contains a
-``try``/``except`` handler that CATCHES a retryable exception
-(ConnectionError / OSError / TimeoutError / socket.timeout / Exception)
-and SWALLOWS it (no ``raise``/``return`` in the handler — the
-retry-and-go-around shape), while nothing in the loop references a
-bounded-budget name (attempt/deadline/retries/tries/remaining/max/
-budget).  Event loops (reader threads, accept loops) pass: they either
-have a real loop condition or let failures propagate out of the loop.
+Thin wrapper over the ``bounded-retry`` rule in
+:mod:`ceph_tpu.analysis.rules_guards` (ISSUE 15); semantics unchanged:
+a constant-true ``while`` that swallows a retryable exception with no
+bounded-budget name in sight is the live-lock shape.
 """
-import ast
-import re
-from pathlib import Path
-
-ROOT = Path(__file__).resolve().parent.parent
-SCAN = [ROOT / "ceph_tpu" / "net.py",
-        *sorted((ROOT / "ceph_tpu" / "client").rglob("*.py")),
-        *sorted((ROOT / "ceph_tpu" / "failure").rglob("*.py"))]
-
-_RETRYABLE = {"ConnectionError", "OSError", "TimeoutError",
-              "ConnectionResetError", "BrokenPipeError", "timeout",
-              "Exception", "BaseException", "IOError", "error"}
-
-_BOUND_NAME = re.compile(
-    r"attempt|deadline|retries|tries|remaining|max|budget|stop",
-    re.IGNORECASE)
-
-
-def _const_true(test: ast.expr) -> bool:
-    return isinstance(test, ast.Constant) and bool(test.value)
-
-
-def _handler_names(handler: ast.ExceptHandler) -> set[str]:
-    t = handler.type
-    if t is None:
-        return {"BaseException"}
-    parts = t.elts if isinstance(t, ast.Tuple) else [t]
-    out = set()
-    for p in parts:
-        if isinstance(p, ast.Name):
-            out.add(p.id)
-        elif isinstance(p, ast.Attribute):
-            out.add(p.attr)
-    return out
-
-
-def _walk_same_scope(node):
-    """ast.walk, but WITHOUT descending into nested function/class
-    definitions: an except handler inside a callback defined in the loop
-    body is that callback's control flow, not the loop's go-around."""
-    stack = list(ast.iter_child_nodes(node))
-    while stack:
-        sub = stack.pop()
-        if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
-                            ast.Lambda, ast.ClassDef)):
-            continue
-        yield sub
-        stack.extend(ast.iter_child_nodes(sub))
-
-
-def _swallows_retryable(node: ast.While) -> bool:
-    """True when the loop body contains an except handler that catches a
-    retryable exception and neither raises nor returns (the go-around)."""
-    for sub in _walk_same_scope(node):
-        if not isinstance(sub, ast.Try):
-            continue
-        for h in sub.handlers:
-            if not (_handler_names(h) & _RETRYABLE):
-                continue
-            if not any(isinstance(n, (ast.Raise, ast.Return))
-                       for body in h.body for n in ast.walk(body)):
-                return True
-    return False
-
-
-def _has_bound_reference(node: ast.While) -> bool:
-    for sub in ast.walk(node):
-        if isinstance(sub, ast.Name) and _BOUND_NAME.search(sub.id):
-            return True
-        if isinstance(sub, ast.Attribute) and \
-                _BOUND_NAME.search(sub.attr):
-            return True
-    return False
-
-
-def _scan(path: Path, rel: str) -> list[str]:
-    tree = ast.parse(path.read_text(), filename=str(path))
-    offenders = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.While):
-            continue
-        if not _const_true(node.test):
-            continue
-        if _swallows_retryable(node) and not _has_bound_reference(node):
-            offenders.append(
-                f"{rel}:{node.lineno}: unbounded 'while True' retry "
-                f"loop swallowing connection errors — bound it with an "
-                f"attempt count or deadline "
-                f"(failure/backoff.ExponentialBackoff)")
-    return offenders
+import ceph_tpu.analysis as A
 
 
 def test_scanned_files_exist():
-    assert SCAN and all(p.exists() for p in SCAN), \
-        "scan targets vanished — update or remove this guard"
-    assert any("failure" in str(p) for p in SCAN), \
+    idx = A.default_index()
+    assert idx.iter_modules(("ceph_tpu/net.py",))
+    assert idx.iter_modules(("ceph_tpu/failure",)), \
         "failure/ package missing from the scan set"
 
 
 def test_every_retry_loop_is_bounded():
-    offenders = []
-    for path in SCAN:
-        offenders.extend(_scan(path, path.relative_to(ROOT).as_posix()))
+    offenders = [f.render() for f in A.run_rules(
+        A.default_index(), ("bounded-retry",))]
     assert not offenders, (
         "unbounded retry loops in the self-healing layer:\n"
         + "\n".join(offenders))
 
 
-def test_guard_catches_the_documented_shapes(tmp_path):
-    """The guard must flag the classic unbounded-retry shape and pass
-    the bounded and event-loop shapes."""
-    bad = tmp_path / "bad.py"
-    bad.write_text(
-        "import time\n"
-        "def forever(sock):\n"
-        "    while True:\n"
-        "        try:\n"
-        "            sock.connect()\n"
-        "            break\n"
-        "        except ConnectionError:\n"
-        "            time.sleep(1)\n")
-    assert len(_scan(bad, "bad.py")) == 1
-    ok = tmp_path / "ok.py"
-    ok.write_text(
-        "def bounded(sock, max_attempts):\n"
-        "    for attempt in range(max_attempts):\n"
-        "        try:\n"
-        "            return sock.connect()\n"
-        "        except ConnectionError:\n"
-        "            pass\n"
-        "    raise ConnectionError\n"
-        "def reader(ch):\n"
-        "    while True:\n"
-        "        msg = ch.recv()\n"     # failures propagate out: fine
-        "        handle(msg)\n"
-        "def deadline_loop(clock, deadline):\n"
-        "    while True:\n"
-        "        try:\n"
-        "            return poll()\n"
-        "        except TimeoutError:\n"
-        "            if clock() >= deadline:\n"
-        "                raise\n")
-    assert _scan(ok, "ok.py") == []
+def test_guard_catches_the_documented_shapes():
+    """Flag the classic unbounded-retry shape; pass the bounded and
+    event-loop shapes."""
+    bad = ("import time\n"
+           "def forever(sock):\n"
+           "    while True:\n"
+           "        try:\n"
+           "            sock.connect()\n"
+           "            break\n"
+           "        except ConnectionError:\n"
+           "            time.sleep(1)\n")
+    assert len(A.run_rule_on_sources("bounded-retry",
+                                     {"bad.py": bad})) == 1
+    ok = ("def bounded(sock, max_attempts):\n"
+          "    for attempt in range(max_attempts):\n"
+          "        try:\n"
+          "            return sock.connect()\n"
+          "        except ConnectionError:\n"
+          "            pass\n"
+          "    raise ConnectionError\n"
+          "def reader(ch):\n"
+          "    while True:\n"
+          "        msg = ch.recv()\n"     # failures propagate out: fine
+          "        handle(msg)\n"
+          "def deadline_loop(clock, deadline):\n"
+          "    while True:\n"
+          "        try:\n"
+          "            return poll()\n"
+          "        except TimeoutError:\n"
+          "            if clock() >= deadline:\n"
+          "                raise\n")
+    assert A.run_rule_on_sources("bounded-retry", {"ok.py": ok}) == []

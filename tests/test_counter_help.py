@@ -1,94 +1,40 @@
 """Guard: every perf counter ships a non-empty description.
 
-The prometheus exporter renders each counter's description as its
-``# HELP`` line; an empty description exports as a HELP line that just
-repeats the metric name — useless at 3am.  This guard walks every
-``PerfCountersBuilder`` adder call in the tree by AST (the
-``test_no_bare_time.py`` / ``test_no_unbounded_queue.py`` pattern:
-discipline as a test) and fails on a missing or empty description.
-
-Checked adders: ``add_u64``, ``add_u64_counter``, ``add_u64_avg``,
-``add_time_avg`` (description = 2nd positional or ``description=``) and
-``add_histogram`` (3rd positional, after the bucket bounds).  A
-non-constant description expression is accepted — the guard cannot
-evaluate it, and a dynamic description is at least A description.
+Thin wrapper over the ``counter-help`` rule in
+:mod:`ceph_tpu.analysis.rules_guards` (ISSUE 15); semantics unchanged —
+the prometheus exporter renders each counter's description as its
+``# HELP`` line, so every ``PerfCountersBuilder`` adder call
+(``add_u64``, ``add_u64_counter``, ``add_u64_avg``, ``add_time_avg``,
+``add_histogram``) needs a non-empty description, positional or
+keyword; a non-constant description expression is accepted.
 """
-import ast
-from pathlib import Path
-
-ROOT = Path(__file__).resolve().parent.parent
-SCAN_DIR = ROOT / "ceph_tpu"
-
-# adder -> index of the description positional (after self)
-_ADDERS = {"add_u64": 1, "add_u64_counter": 1, "add_u64_avg": 1,
-           "add_time_avg": 1, "add_histogram": 2}
-
-
-def _description_ok(node: ast.Call, pos_index: int) -> bool:
-    for kw in node.keywords:
-        if kw.arg == "description":
-            return not (isinstance(kw.value, ast.Constant)
-                        and not kw.value.value)
-    if len(node.args) > pos_index:
-        arg = node.args[pos_index]
-        return not (isinstance(arg, ast.Constant) and not arg.value)
-    return False                      # description omitted entirely
-
-
-def _scan(path: Path) -> list[str]:
-    tree = ast.parse(path.read_text(), filename=str(path))
-    offenders = []
-    rel = path.relative_to(ROOT).as_posix()
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call) or \
-                not isinstance(node.func, ast.Attribute):
-            continue
-        pos = _ADDERS.get(node.func.attr)
-        if pos is not None and not _description_ok(node, pos):
-            offenders.append(
-                f"{rel}:{node.lineno}: {node.func.attr}(...) without a "
-                f"description (prometheus # HELP quality)")
-    return offenders
+import ceph_tpu.analysis as A
+from ceph_tpu.analysis.rules_guards import count_counter_adders
 
 
 def test_scan_finds_counter_builders():
     """The guard must actually be scanning something (if the builder API
-    is renamed, update _ADDERS rather than silently guarding nothing)."""
-    hits = 0
-    for path in sorted(SCAN_DIR.rglob("*.py")):
-        tree = ast.parse(path.read_text())
-        for node in ast.walk(tree):
-            if isinstance(node, ast.Call) and \
-                    isinstance(node.func, ast.Attribute) and \
-                    node.func.attr in _ADDERS:
-                hits += 1
+    is renamed, update the rule rather than silently guarding nothing)."""
+    hits = count_counter_adders(A.default_index())
     assert hits >= 20, f"only {hits} adder calls found — guard is stale"
 
 
 def test_every_counter_has_help_text():
-    offenders = []
-    for path in sorted(SCAN_DIR.rglob("*.py")):
-        offenders.extend(_scan(path))
+    offenders = [f.render() for f in A.run_rules(
+        A.default_index(), ("counter-help",))]
     assert not offenders, (
         "perf counters without descriptions — prometheus # HELP renders "
         "these as the bare metric name:\n" + "\n".join(offenders))
 
 
-def test_guard_rejects_empty_descriptions(tmp_path):
-    """The guard catches all three shapes it documents."""
-    bad = tmp_path / "bad.py"
-    bad.write_text(
-        "b = PerfCountersBuilder('x')\n"
-        "b.add_u64_counter('no_desc')\n"
-        "b.add_u64('empty', '')\n"
-        "b.add_histogram('h', [1, 2])\n"
-        "b.add_time_avg('ok', 'described')\n"
-        "b.add_histogram('h2', [1], 'described')\n"
-        "b.add_u64('kw', description='described')\n")
-    tree = ast.parse(bad.read_text())
-    found = [n for n in ast.walk(tree)
-             if isinstance(n, ast.Call)
-             and isinstance(n.func, ast.Attribute)
-             and _ADDERS.get(n.func.attr) is not None
-             and not _description_ok(n, _ADDERS[n.func.attr])]
+def test_guard_rejects_empty_descriptions():
+    """The rule catches all three shapes it documents."""
+    bad = ("b = PerfCountersBuilder('x')\n"
+           "b.add_u64_counter('no_desc')\n"
+           "b.add_u64('empty', '')\n"
+           "b.add_histogram('h', [1, 2])\n"
+           "b.add_time_avg('ok', 'described')\n"
+           "b.add_histogram('h2', [1], 'described')\n"
+           "b.add_u64('kw', description='described')\n")
+    found = A.run_rule_on_sources("counter-help", {"bad.py": bad})
     assert len(found) == 3

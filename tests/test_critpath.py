@@ -3,7 +3,6 @@ traces (the ISSUE-10 tentpole's correctness core), the span->phase
 registry, the bounded ledger, and the unified nearest-rank percentile
 (+ its AST guard: bench p99 and trace p99 can never drift apart again).
 """
-import ast
 import importlib.util
 import time
 from pathlib import Path
@@ -253,28 +252,11 @@ class TestUnifiedPercentile:
     def test_ast_guard_no_local_percentile_redefinitions(self):
         """No file but common/percentile.py may define a function named
         percentile/percentile_us/nearest_rank — the drift that made
-        ts_report's copy silently diverge to floor-index."""
-        banned = {"percentile", "percentile_us", "nearest_rank"}
-        offenders = []
-        for sub in ("ceph_tpu", "tools"):
-            for path in sorted((ROOT / sub).rglob("*.py")):
-                rel = path.relative_to(ROOT).as_posix()
-                if rel == "ceph_tpu/common/percentile.py":
-                    continue
-                tree = ast.parse(path.read_text())
-                for node in ast.walk(tree):
-                    if not (isinstance(node, (ast.FunctionDef,
-                                              ast.AsyncFunctionDef))
-                            and node.name in banned):
-                        continue
-                    # a thin delegating wrapper (trace_report keeps its
-                    # public percentile_us name) is fine — it must CALL
-                    # the shared helper, not re-derive the rank
-                    if "nearest_rank" in ast.dump(node) or \
-                            "_pctl" in ast.dump(node):
-                        continue
-                    offenders.append(f"{rel}:{node.lineno}: "
-                                     f"def {node.name}")
+        ts_report's copy silently diverge to floor-index.  Thin wrapper
+        over the ``percentile-redef`` rule (ISSUE 15)."""
+        import ceph_tpu.analysis as A
+        offenders = [f.render() for f in A.run_rules(
+            A.default_index(), ("percentile-redef",))]
         assert not offenders, (
             "local percentile redefinitions (use "
             "ceph_tpu/common/percentile.py):\n" + "\n".join(offenders))

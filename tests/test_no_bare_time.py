@@ -1,39 +1,20 @@
 """Guard: hot paths time through the tracer/perf API, not ad-hoc clocks.
 
-``ceph_tpu/ops/`` and ``ceph_tpu/backend/`` are the encode/decode hot
-paths; timing added there must go through ``trace_span``,
-``PerfCounters.time``/``tinc`` or ``traced_jit`` so it lands in the
-observability surfaces (`trace dump`, `perf dump`, prometheus) instead of
-rotting as a local print.  A bare ``time.time()`` / ``perf_counter()``
-call site is allowed only on the explicit allowlist below (the timing
-wrappers themselves).
+Thin wrapper over the ``bare-clock`` rule in
+:mod:`ceph_tpu.analysis.rules_guards` (ISSUE 15); semantics unchanged —
+timing added to ``ceph_tpu/ops/`` or ``ceph_tpu/backend/`` must go
+through ``trace_span``, ``PerfCounters.time``/``tinc`` or
+``traced_jit`` so it lands in the observability surfaces, and a bare
+``time.time()`` / ``perf_counter()`` site is allowed only on the
+explicit allowlist (the timing wrappers themselves).
 """
-import re
-from pathlib import Path
-
-ROOT = Path(__file__).resolve().parent.parent
-SCAN_DIRS = ("ceph_tpu/ops", "ceph_tpu/backend")
-
-# path -> why the bare clock is legitimate there
-ALLOWLIST = {
-    "ceph_tpu/ops/traced_jit.py":
-        "IS the timing wrapper (AOT fallback books compile wall time)",
-}
-
-_BARE_TIME = re.compile(r"time\.time\(\)|perf_counter\(\)")
+import ceph_tpu.analysis as A
+from ceph_tpu.analysis.rules_guards import CLOCK_ALLOWLIST
 
 
 def test_no_bare_timing_in_hot_paths():
-    offenders = []
-    for sub in SCAN_DIRS:
-        for path in sorted((ROOT / sub).rglob("*.py")):
-            rel = path.relative_to(ROOT).as_posix()
-            if rel in ALLOWLIST:
-                continue
-            for lineno, line in enumerate(
-                    path.read_text().splitlines(), start=1):
-                if _BARE_TIME.search(line):
-                    offenders.append(f"{rel}:{lineno}: {line.strip()}")
+    offenders = [f.render() for f in A.run_rules(
+        A.default_index(), ("bare-clock",))]
     assert not offenders, (
         "bare timing calls in hot paths — route them through "
         "trace_span/PerfCounters/traced_jit (or extend the allowlist "
@@ -41,5 +22,17 @@ def test_no_bare_timing_in_hot_paths():
 
 
 def test_allowlist_entries_still_exist():
-    for rel in ALLOWLIST:
-        assert (ROOT / rel).exists(), f"stale allowlist entry: {rel}"
+    idx = A.default_index()
+    for rel in CLOCK_ALLOWLIST:
+        assert idx.iter_modules((rel,)), f"stale allowlist entry: {rel}"
+
+
+def test_guard_catches_a_bare_clock():
+    bad = ("import time\n"
+           "from time import perf_counter\n"
+           "def f():\n"
+           "    t0 = time.time()\n"
+           "    t1 = perf_counter()\n"
+           "    return t1 - t0\n")
+    found = A.run_rule_on_sources("bare-clock", {"bad.py": bad})
+    assert len(found) == 2

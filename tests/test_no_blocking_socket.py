@@ -1,106 +1,34 @@
 """Guard: ``ceph_tpu/msg/`` stays readiness-driven (ISSUE 14).
 
-The async messenger's whole premise is that sockets are only touched
-when the reactor says they're ready, and that thread count never scales
-with connections.  Both properties are structural, so both are pinned
-by AST (the ``test_wire_guard.py`` pattern — discipline as a test):
-
-- blocking socket verbs (``recv``/``recv_into``/``sendall``/``accept``)
-  may appear ONLY inside readiness callbacks (``on_*`` methods), where
-  the fd is non-blocking and the call returns immediately;
-- ``threading.Thread`` may be constructed ONLY at the three fixed
-  spawn sites (the reactor loop, the sized dispatch pool, the single
-  mux sender) — never per connection, never per request.
-
-The blocking dial + cephx client handshake deliberately live OUTSIDE
-this package (``net.dial_and_handshake``), so the guard needs no
-escape hatch for them.
+Thin wrapper over the ``blocking-socket`` and ``thread-spawn-site``
+rules in :mod:`ceph_tpu.analysis.rules_guards` (ISSUE 15); semantics
+unchanged — blocking verbs only inside ``on_*`` readiness callbacks,
+``threading.Thread`` only at the three fixed spawn sites.
 """
-import ast
-from pathlib import Path
-
-MSG_DIR = Path(__file__).resolve().parent.parent / "ceph_tpu" / "msg"
-
-BLOCKING_SOCKET_VERBS = {"recv", "recv_into", "sendall", "accept"}
-
-# (file, enclosing "Class.function") — the ONLY places a thread may be
-# born in the async messenger: one reactor loop, the fixed dispatch
-# pool, the single mux sender.  Anything else is the thread-per-
-# connection pattern this subsystem exists to remove.
-THREAD_SPAWN_ALLOWLIST = {
-    ("reactor.py", "Reactor.start"),
-    ("server.py", "Dispatcher.start"),
-    ("client.py", "MuxClient.__init__"),
-}
-
-
-class _Scan(ast.NodeVisitor):
-    def __init__(self):
-        self.stack = []                     # class/function name frames
-        self.socket_calls = []              # (qualname, verb, lineno)
-        self.thread_spawns = []             # (qualname, lineno)
-
-    def _qual(self):
-        return ".".join(self.stack) or "<module>"
-
-    def visit_ClassDef(self, node):
-        self.stack.append(node.name)
-        self.generic_visit(node)
-        self.stack.pop()
-
-    def _visit_fn(self, node):
-        self.stack.append(node.name)
-        self.generic_visit(node)
-        self.stack.pop()
-
-    visit_FunctionDef = _visit_fn
-    visit_AsyncFunctionDef = _visit_fn
-
-    def visit_Call(self, node):
-        f = node.func
-        if isinstance(f, ast.Attribute):
-            if f.attr in BLOCKING_SOCKET_VERBS:
-                self.socket_calls.append(
-                    (self._qual(), f.attr, node.lineno))
-            if f.attr == "Thread" and isinstance(f.value, ast.Name) \
-                    and f.value.id == "threading":
-                self.thread_spawns.append((self._qual(), node.lineno))
-        elif isinstance(f, ast.Name) and f.id == "Thread":
-            self.thread_spawns.append((self._qual(), node.lineno))
-        self.generic_visit(node)
-
-
-def _scan(path: Path) -> _Scan:
-    s = _Scan()
-    s.visit(ast.parse(path.read_text(), filename=str(path)))
-    return s
-
-
-def _enclosing_function(qualname: str) -> str:
-    return qualname.split(".")[-1]
+import ceph_tpu.analysis as A
+from ceph_tpu.analysis.rules_guards import (THREAD_SPAWN_ALLOWLIST,
+                                            blocking_socket_sites,
+                                            msg_thread_spawn_sites)
 
 
 def test_scan_sees_the_real_sources():
-    """The guard must be scanning something real: the known readiness
+    """The rules must be scanning something real: the known readiness
     callbacks and the three thread sites exist where claimed."""
-    files = sorted(p.name for p in MSG_DIR.glob("*.py"))
+    idx = A.default_index()
+    files = {m.rel.rsplit("/", 1)[-1]
+             for m in idx.iter_modules(("ceph_tpu/msg",))}
     for required in ("connection.py", "reactor.py", "server.py",
                      "client.py"):
         assert required in files, f"{required} moved — update the guard"
-    conn = _scan(MSG_DIR / "connection.py")
-    assert any(v == "recv" and q.endswith("on_readable")
-               for q, v, _ in conn.socket_calls), \
+    sites = blocking_socket_sites(idx)
+    assert ("connection.py", "AsyncConnection.on_readable",
+            "recv") in sites, \
         "connection.py lost its on_readable recv — guard is stale"
 
 
 def test_blocking_socket_verbs_only_in_readiness_callbacks():
-    offenders = []
-    for path in sorted(MSG_DIR.glob("*.py")):
-        for qual, verb, line in _scan(path).socket_calls:
-            fn = _enclosing_function(qual)
-            if not fn.startswith("on_"):
-                offenders.append(
-                    f"{path.name}:{line} {qual} calls .{verb}()")
+    offenders = [f.render() for f in A.run_rules(
+        A.default_index(), ("blocking-socket",))]
     assert not offenders, (
         "blocking socket verbs outside reactor readiness callbacks "
         "(move the I/O into an on_* handler, or do the blocking work "
@@ -108,17 +36,13 @@ def test_blocking_socket_verbs_only_in_readiness_callbacks():
 
 
 def test_no_per_connection_thread_spawns():
-    spawns = {}
-    for path in sorted(MSG_DIR.glob("*.py")):
-        for qual, line in _scan(path).thread_spawns:
-            spawns[(path.name, qual)] = line
-    rogue = {k: v for k, v in spawns.items()
-             if k not in THREAD_SPAWN_ALLOWLIST}
-    assert not rogue, (
+    offenders = [f.render() for f in A.run_rules(
+        A.default_index(), ("thread-spawn-site",))]
+    assert not offenders, (
         "threading.Thread outside the fixed spawn sites (the async "
         "messenger must never spawn per connection/request):\n"
-        + "\n".join(f"{f}:{line} in {q}" for (f, q), line in
-                    rogue.items()))
+        + "\n".join(offenders))
     # and the allowlist itself stays honest: every listed site exists
-    missing = THREAD_SPAWN_ALLOWLIST - set(spawns)
+    spawns = msg_thread_spawn_sites(A.default_index())
+    missing = THREAD_SPAWN_ALLOWLIST - spawns
     assert not missing, f"allowlisted spawn sites vanished: {missing}"

@@ -1,101 +1,26 @@
 """Guard: serving/recovery hot paths never sync with the device.
 
-The codec pipeline's whole point is that ``exec/`` and ``recovery/``
-stay on the HOST side of the boundary: they pack batches and hand them
-to ``ceph_tpu/ops/pipeline.py``, and the ``jax.device_get`` /
-``block_until_ready`` happens only inside that module's completion
-boundary.  A per-op ``device_get`` (or a decode-matrix ``jnp.asarray``
-re-upload) in these layers silently re-serialises host packing against
-device compute — the exact transfer stall ISSUE-5 removed.
-
-AST-walked (the ``test_no_bare_time.py`` pattern, upgraded from regex so
-comments/docstrings can mention the names):
-
-- no ``import jax`` / ``import jax.numpy`` / ``from jax import ...`` —
-  these layers have no business talking to the device runtime at all;
-- no call to an attribute or name ``device_get``, ``block_until_ready``,
-  or ``asarray`` on a ``jnp``/``jax.numpy`` alias (the upload-side sync).
-
-``np``/host numpy stays allowed — packing IS their job.
+Thin wrapper over the ``no-host-sync`` rule in
+:mod:`ceph_tpu.analysis.rules_guards` (ISSUE 15 moved the walker into
+the shared engine); semantics unchanged — ``exec/`` and ``recovery/``
+must not import jax or call ``device_get`` / ``block_until_ready`` /
+``jnp.asarray``; the completion boundary lives in ops/pipeline.py.
 """
-import ast
-from pathlib import Path
-
-ROOT = Path(__file__).resolve().parent.parent
-SCAN_DIRS = ("ceph_tpu/exec", "ceph_tpu/recovery")
-
-# path -> why a device-runtime touch is legitimate there (none today: the
-# completion boundary lives in ceph_tpu/ops/pipeline.py, outside the scan)
-ALLOWLIST: dict[str, str] = {}
-
-_FORBIDDEN_CALLS = {"device_get", "block_until_ready"}
-_JAX_MODULES = ("jax",)
-
-
-class _Visitor(ast.NodeVisitor):
-    def __init__(self):
-        self.offenders: list[tuple[int, str]] = []
-        self._jnp_aliases: set[str] = set()
-
-    def visit_Import(self, node: ast.Import) -> None:
-        for alias in node.names:
-            root = alias.name.split(".")[0]
-            if root in _JAX_MODULES:
-                self.offenders.append(
-                    (node.lineno, f"import {alias.name}"))
-            if alias.name in ("jax.numpy",):
-                self._jnp_aliases.add(alias.asname or "jax")
-        self.generic_visit(node)
-
-    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
-        root = (node.module or "").split(".")[0]
-        if root in _JAX_MODULES:
-            self.offenders.append(
-                (node.lineno, f"from {node.module} import ..."))
-        self.generic_visit(node)
-
-    def visit_Call(self, node: ast.Call) -> None:
-        fn = node.func
-        name = None
-        if isinstance(fn, ast.Attribute):
-            name = fn.attr
-            if name == "asarray" and isinstance(fn.value, ast.Name) \
-                    and fn.value.id in ("jnp", *self._jnp_aliases):
-                self.offenders.append(
-                    (node.lineno, f"{fn.value.id}.asarray(...)"))
-        elif isinstance(fn, ast.Name):
-            name = fn.id
-        if name in _FORBIDDEN_CALLS:
-            self.offenders.append((node.lineno, f"{name}(...)"))
-        self.generic_visit(node)
+import ceph_tpu.analysis as A
 
 
 def test_no_device_sync_in_serving_or_recovery():
-    offenders = []
-    for sub in SCAN_DIRS:
-        for path in sorted((ROOT / sub).rglob("*.py")):
-            rel = path.relative_to(ROOT).as_posix()
-            if rel in ALLOWLIST:
-                continue
-            tree = ast.parse(path.read_text(), filename=rel)
-            v = _Visitor()
-            v.visit(tree)
-            offenders.extend(f"{rel}:{lineno}: {what}"
-                             for lineno, what in v.offenders)
+    offenders = [f.render() for f in A.run_rules(
+        A.default_index(), ("no-host-sync",))]
     assert not offenders, (
         "device-runtime touches in serving/recovery hot paths — route "
-        "them through ops/pipeline.py's completion boundary (or extend "
-        "the allowlist with a justification):\n" + "\n".join(offenders))
+        "them through ops/pipeline.py's completion boundary:\n"
+        + "\n".join(offenders))
 
 
-def test_allowlist_entries_still_exist():
-    for rel in ALLOWLIST:
-        assert (ROOT / rel).exists(), f"stale allowlist entry: {rel}"
-
-
-def test_guard_catches_a_violation(tmp_path):
-    """The guard itself must keep working: a synthetic offender trips on
-    every rule it claims to enforce."""
+def test_guard_catches_a_violation():
+    """The rule itself must keep working: a synthetic offender trips on
+    every shape it claims to enforce."""
     bad = ("import jax\n"
            "import jax.numpy as jnp\n"
            "from jax import block_until_ready\n"
@@ -103,10 +28,10 @@ def test_guard_catches_a_violation(tmp_path):
            "    y = jnp.asarray(x)\n"
            "    jax.device_get(y)\n"
            "    return y.block_until_ready()\n")
-    v = _Visitor()
-    v.visit(ast.parse(bad))
-    kinds = {what for _ln, what in v.offenders}
+    kinds = {f.message for f in A.run_rule_on_sources(
+        "no-host-sync", {"bad.py": bad})}
     assert "import jax" in kinds
+    assert "import jax.numpy" in kinds
     assert "from jax import ..." in kinds
     assert "jnp.asarray(...)" in kinds
     assert "device_get(...)" in kinds

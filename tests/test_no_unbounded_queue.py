@@ -1,122 +1,40 @@
 """Guard: every queue constructed in ``ceph_tpu/exec/`` and
 ``ceph_tpu/recovery/`` is bounded.
 
-The serving subsystem exists to put BOUNDS between demand and the device
-(ISSUE 2's backpressure contract: once a throttle limit is hit,
-submission blocks or fails fast and queue depth/bytes stay bounded), and
-the recovery subsystem exists to put bounds between damage and repair
-bandwidth (ISSUE 4: reservations, wave sizes, byte-rate caps).  An
-unbounded ``deque()``/``Queue()`` smuggled into either silently voids
-that contract under overload — this guard fails the build instead
-(mirrors the ``tests/test_no_bare_time.py`` pattern: discipline as a
-test).  The recovery package's lists are bounded by construction (one
-reservation per distinct PG); the guard keeps stdlib queue types out.
-
-Checked constructors (by AST, so multiline calls and aliases through
-``collections.deque``/``queue.Queue`` are caught):
-
-- ``deque`` must pass ``maxlen=`` (positionally or by keyword), non-None;
-- ``queue.Queue``/``LifoQueue``/``PriorityQueue`` must pass a nonzero
-  ``maxsize``;
-- ``queue.SimpleQueue`` is banned outright (it cannot be bounded).
-
-Unbounded queues remain legitimate ELSEWHERE (e.g. the mClock queues in
-``osd/mclock.py``, whose bound is the daemon/engine throttle in front of
-them) — the scan is scoped to ``ceph_tpu/exec/`` where construction
-implies ownership of the bound.
+Thin wrapper over the ``unbounded-queue`` rule in
+:mod:`ceph_tpu.analysis.rules_guards` (ISSUE 15); semantics unchanged:
+``deque`` needs ``maxlen``, ``Queue``/``LifoQueue``/``PriorityQueue``
+need a nonzero ``maxsize``, ``SimpleQueue`` is banned outright.
 """
-import ast
 from pathlib import Path
 
+import ceph_tpu.analysis as A
+
 ROOT = Path(__file__).resolve().parent.parent
-SCAN_DIRS = (ROOT / "ceph_tpu" / "exec",
-             ROOT / "ceph_tpu" / "recovery")
-
-_QUEUE_CTORS = {"Queue", "LifoQueue", "PriorityQueue"}
-
-
-def _callee_name(node: ast.Call) -> str | None:
-    fn = node.func
-    if isinstance(fn, ast.Name):
-        return fn.id
-    if isinstance(fn, ast.Attribute):
-        return fn.attr
-    return None
-
-
-def _has_bound(node: ast.Call, kw_name: str, pos_index: int) -> bool:
-    for kw in node.keywords:
-        if kw.arg == kw_name:
-            return not (isinstance(kw.value, ast.Constant)
-                        and kw.value.value in (None, 0))
-    if len(node.args) > pos_index:
-        arg = node.args[pos_index]
-        return not (isinstance(arg, ast.Constant)
-                    and arg.value in (None, 0))
-    return False
-
-
-def _scan(path: Path) -> list[str]:
-    tree = ast.parse(path.read_text(), filename=str(path))
-    offenders = []
-    rel = path.relative_to(ROOT).as_posix()
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        name = _callee_name(node)
-        if name == "SimpleQueue":
-            offenders.append(f"{rel}:{node.lineno}: SimpleQueue cannot "
-                             f"be bounded — use Queue(maxsize=...)")
-        elif name == "deque" and not _has_bound(node, "maxlen", 1):
-            offenders.append(f"{rel}:{node.lineno}: deque without an "
-                             f"explicit maxlen bound")
-        elif name in _QUEUE_CTORS and not _has_bound(node, "maxsize", 0):
-            offenders.append(f"{rel}:{node.lineno}: {name} without an "
-                             f"explicit nonzero maxsize bound")
-    return offenders
 
 
 def test_scanned_packages_exist():
-    for scan_dir in SCAN_DIRS:
-        files = sorted(scan_dir.rglob("*.py"))
-        assert files, (f"{scan_dir.name}/ vanished — update or remove "
-                       f"this guard")
+    idx = A.default_index()
+    for sub in ("ceph_tpu/exec", "ceph_tpu/recovery"):
+        assert idx.iter_modules((sub,)), (
+            f"{sub}/ vanished — update or remove this guard")
 
 
 def test_every_queue_in_scanned_packages_is_bounded():
-    offenders = []
-    for scan_dir in SCAN_DIRS:
-        for path in sorted(scan_dir.rglob("*.py")):
-            offenders.extend(_scan(path))
+    offenders = [f.render() for f in A.run_rules(
+        A.default_index(), ("unbounded-queue",))]
     assert not offenders, (
         "unbounded queues in a bounded subsystem — pass an explicit "
         "bound (the backpressure contract):\n" + "\n".join(offenders))
 
 
-def test_guard_rejects_unbounded(tmp_path):
-    """The guard itself must catch the three shapes it documents."""
-    bad = tmp_path / "bad.py"
-    bad.write_text("from collections import deque\nimport queue\n"
-                   "a = deque()\n"
-                   "b = queue.Queue()\n"
-                   "c = queue.SimpleQueue()\n"
-                   "ok = deque(maxlen=8)\n"
-                   "ok2 = queue.Queue(maxsize=8)\n")
-    found = _scan_path_outside_root(bad)
+def test_guard_rejects_unbounded():
+    """The rule catches the three shapes it documents."""
+    bad = ("from collections import deque\nimport queue\n"
+           "a = deque()\n"
+           "b = queue.Queue()\n"
+           "c = queue.SimpleQueue()\n"
+           "ok = deque(maxlen=8)\n"
+           "ok2 = queue.Queue(maxsize=8)\n")
+    found = A.run_rule_on_sources("unbounded-queue", {"bad.py": bad})
     assert len(found) == 3
-
-
-def _scan_path_outside_root(path: Path) -> list[str]:
-    tree = ast.parse(path.read_text(), filename=str(path))
-    offenders = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        name = _callee_name(node)
-        if name == "SimpleQueue":
-            offenders.append(f"{path.name}:{node.lineno}")
-        elif name == "deque" and not _has_bound(node, "maxlen", 1):
-            offenders.append(f"{path.name}:{node.lineno}")
-        elif name in _QUEUE_CTORS and not _has_bound(node, "maxsize", 0):
-            offenders.append(f"{path.name}:{node.lineno}")
-    return offenders

@@ -1,89 +1,19 @@
 """Guard: every ``jax.profiler`` use lives in common/profiler_capture.py.
 
-Profiling is process-global and expensive: a stray ``start_trace`` in a
-hot path (or a helper that "just profiles this one section") would tax
-every dispatch and fight the managed capture windows for the single
-process-wide profiler session.  This guard keeps the whole surface —
-``import jax.profiler``, ``from jax import profiler``, attribute access
-``jax.profiler``, and direct ``start_trace``/``stop_trace`` calls —
-inside the one module built to bound it (the ``test_no_host_sync.py``
-AST pattern, so comments and docstrings may mention the names).
+Thin wrapper over the ``profiler-confinement`` rule in
+:mod:`ceph_tpu.analysis.rules_guards` (ISSUE 15); semantics unchanged —
+profiling is process-global and expensive, so the whole surface
+(``import jax.profiler``, ``from jax import profiler``, attribute
+access ``jax.profiler``, and direct ``start_trace``/``stop_trace``
+calls) stays inside the one module built to bound it.
 """
-import ast
-from pathlib import Path
-
-ROOT = Path(__file__).resolve().parent.parent
-# the whole production tree: package, tools, and the bench driver
-SCAN = ("ceph_tpu", "tools", "bench.py")
-
-# path -> why the profiler touch is legitimate there
-ALLOWLIST = {
-    "ceph_tpu/common/profiler_capture.py":
-        "IS the capture-window manager (the only sanctioned owner of "
-        "the process-global profiler session)",
-}
-
-_FORBIDDEN_CALLS = {"start_trace", "stop_trace"}
-
-
-class _Visitor(ast.NodeVisitor):
-    def __init__(self):
-        self.offenders: list[tuple[int, str]] = []
-
-    def visit_Import(self, node: ast.Import) -> None:
-        for alias in node.names:
-            if alias.name == "jax.profiler" or \
-                    alias.name.startswith("jax.profiler."):
-                self.offenders.append(
-                    (node.lineno, f"import {alias.name}"))
-        self.generic_visit(node)
-
-    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
-        mod = node.module or ""
-        if mod == "jax.profiler" or mod.startswith("jax.profiler."):
-            self.offenders.append(
-                (node.lineno, f"from {mod} import ..."))
-        elif mod == "jax" and any(a.name == "profiler"
-                                  for a in node.names):
-            self.offenders.append(
-                (node.lineno, "from jax import profiler"))
-        self.generic_visit(node)
-
-    def visit_Attribute(self, node: ast.Attribute) -> None:
-        if node.attr == "profiler" and \
-                isinstance(node.value, ast.Name) and \
-                node.value.id == "jax":
-            self.offenders.append((node.lineno, "jax.profiler"))
-        self.generic_visit(node)
-
-    def visit_Call(self, node: ast.Call) -> None:
-        fn = node.func
-        name = fn.attr if isinstance(fn, ast.Attribute) else \
-            fn.id if isinstance(fn, ast.Name) else None
-        if name in _FORBIDDEN_CALLS:
-            self.offenders.append((node.lineno, f"{name}(...)"))
-        self.generic_visit(node)
-
-
-def _scan_paths():
-    for entry in SCAN:
-        p = ROOT / entry
-        if p.is_file():
-            yield p
-        else:
-            yield from sorted(p.rglob("*.py"))
+import ceph_tpu.analysis as A
+from ceph_tpu.analysis.rules_guards import PROFILER_ALLOWLIST
 
 
 def test_profiler_use_confined_to_capture_module():
-    offenders = []
-    for path in _scan_paths():
-        rel = path.relative_to(ROOT).as_posix()
-        if rel in ALLOWLIST:
-            continue
-        v = _Visitor()
-        v.visit(ast.parse(path.read_text(), filename=rel))
-        offenders.extend(f"{rel}:{lineno}: {what}"
-                         for lineno, what in v.offenders)
+    offenders = [f.render() for f in A.run_rules(
+        A.default_index(), ("profiler-confinement",))]
     assert not offenders, (
         "jax.profiler touches outside common/profiler_capture.py — "
         "route captures through ProfilerCapture's managed windows (or "
@@ -92,8 +22,9 @@ def test_profiler_use_confined_to_capture_module():
 
 
 def test_allowlist_entries_still_exist():
-    for rel in ALLOWLIST:
-        assert (ROOT / rel).exists(), f"stale allowlist entry: {rel}"
+    idx = A.default_index()
+    for rel in PROFILER_ALLOWLIST:
+        assert idx.iter_modules((rel,)), f"stale allowlist entry: {rel}"
 
 
 def test_guard_catches_a_violation():
@@ -103,9 +34,8 @@ def test_guard_catches_a_violation():
            "def f():\n"
            "    jax.profiler.start_trace('/tmp/x')\n"
            "    profiler.stop_trace()\n")
-    v = _Visitor()
-    v.visit(ast.parse(bad))
-    kinds = {what for _ln, what in v.offenders}
+    kinds = {f.message for f in A.run_rule_on_sources(
+        "profiler-confinement", {"bad.py": bad})}
     assert "import jax.profiler" in kinds
     assert "from jax import profiler" in kinds
     assert "from jax.profiler import ..." in kinds

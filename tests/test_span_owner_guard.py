@@ -1,60 +1,38 @@
 """Guard: spans in the serving/recovery layers must carry an owner class.
 
-Sibling of ``test_no_bare_time.py``: device-time attribution
-(common/device_attribution) only works if the work crossing the chip is
-TAGGED.  ``ceph_tpu/exec/`` and ``ceph_tpu/recovery/`` are the layers
-that dispatch on behalf of someone else (serving batches, repair waves),
-so every span opened there must say WHOSE work it is — an ``owner=``
-keyword with a canonical owner class — or the attribution ledger and the
-``device top`` command silently misfile the time as client work.
+Thin wrapper over the ``span-owner`` rule in
+:mod:`ceph_tpu.analysis.rules_guards` (ISSUE 15); semantics unchanged:
+every span opened in ``exec/`` or ``recovery/`` needs an ``owner=``
+from the canonical OWNER_CLASSES or device-time attribution misfiles
+the work as client time.
 """
-import ast
-from pathlib import Path
-
-from ceph_tpu.common.device_attribution import OWNER_CLASSES
-
-ROOT = Path(__file__).resolve().parent.parent
-SCAN_DIRS = ("ceph_tpu/exec", "ceph_tpu/recovery")
-
-_SPAN_CALLS = {"trace_span", "span"}     # trace_span(...) / tracer.span(...)
-
-
-def _span_call_name(call: ast.Call) -> str | None:
-    fn = call.func
-    if isinstance(fn, ast.Name) and fn.id in _SPAN_CALLS:
-        return fn.id
-    if isinstance(fn, ast.Attribute) and fn.attr in _SPAN_CALLS:
-        return fn.attr
-    return None
+import ceph_tpu.analysis as A
 
 
 def test_spans_in_exec_and_recovery_carry_owner_class():
-    offenders = []
-    for sub in SCAN_DIRS:
-        for path in sorted((ROOT / sub).rglob("*.py")):
-            rel = path.relative_to(ROOT).as_posix()
-            tree = ast.parse(path.read_text())
-            for node in ast.walk(tree):
-                if not isinstance(node, ast.Call) or \
-                        _span_call_name(node) is None:
-                    continue
-                owner = next((kw.value for kw in node.keywords
-                              if kw.arg == "owner"), None)
-                if owner is None:
-                    offenders.append(
-                        f"{rel}:{node.lineno}: span without owner= "
-                        f"(attribution would misfile this as client "
-                        f"work)")
-                elif isinstance(owner, ast.Constant) and \
-                        owner.value not in OWNER_CLASSES:
-                    offenders.append(
-                        f"{rel}:{node.lineno}: owner={owner.value!r} is "
-                        f"not a canonical owner class {OWNER_CLASSES}")
+    offenders = [f.render() for f in A.run_rules(
+        A.default_index(), ("span-owner",))]
     assert not offenders, (
         "spans in exec/ and recovery/ must carry an owner class so "
-        "device-time attribution can file them:\n" + "\n".join(offenders))
+        "device-time attribution can file them:\n"
+        + "\n".join(offenders))
 
 
 def test_scan_dirs_still_exist():
-    for sub in SCAN_DIRS:
-        assert (ROOT / sub).is_dir(), f"stale scan dir: {sub}"
+    idx = A.default_index()
+    for sub in ("ceph_tpu/exec", "ceph_tpu/recovery"):
+        assert idx.iter_modules((sub,)), f"stale scan dir: {sub}"
+
+
+def test_guard_catches_missing_and_bogus_owner():
+    bad = ("def f(tr):\n"
+           "    with tr.span('x'):\n"
+           "        pass\n"
+           "    with tr.span('y', owner='not-a-class'):\n"
+           "        pass\n"
+           "    with tr.span('z', owner='scrub'):\n"
+           "        pass\n")
+    found = A.run_rule_on_sources("span-owner", {"bad.py": bad})
+    assert len(found) == 2
+    assert any("without owner=" in f.message for f in found)
+    assert any("not-a-class" in f.message for f in found)

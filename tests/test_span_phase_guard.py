@@ -1,74 +1,44 @@
 """Guard: spans in the serving/recovery/pipeline layers must map to a
 DECLARED critical-path phase.
 
-Sibling of ``test_span_owner_guard.py``: the latency-objective layer
-(common/critpath.py) decomposes every completed op's trace into the
-canonical phase taxonomy, and an undeclared span silently files its
-self-time under ``other`` — the attribution table then under-reports
-exactly the new code path someone just added.  Every span opened (or
-``tracer.complete()``-stamped) in ``ceph_tpu/exec/``,
-``ceph_tpu/recovery/`` and ``ceph_tpu/ops/pipeline.py`` must either be
-declared in the registry (``critpath.SPAN_PHASES`` / the prefix rules)
-or carry an explicit constant ``phase=`` keyword.
+Thin wrapper over the ``span-phase`` rule in
+:mod:`ceph_tpu.analysis.rules_guards` (ISSUE 15); semantics unchanged —
+an undeclared span silently files its self-time under ``other`` in the
+latency decomposition, so every span opened (or
+``tracer.complete()``-stamped) in ``exec/``, ``recovery/`` and
+``ops/pipeline.py`` must be declared in ``critpath.SPAN_PHASES`` or
+carry an explicit constant ``phase=``.
 """
-import ast
-from pathlib import Path
-
-from ceph_tpu.common.critpath import PHASES, is_declared
-
-ROOT = Path(__file__).resolve().parent.parent
-SCAN = ("ceph_tpu/exec", "ceph_tpu/recovery", "ceph_tpu/ops/pipeline.py")
-
-_SPAN_CALLS = {"trace_span", "span", "complete"}
-
-
-def _span_name(call: ast.Call) -> str | None:
-    fn = call.func
-    name = fn.id if isinstance(fn, ast.Name) else \
-        fn.attr if isinstance(fn, ast.Attribute) else None
-    if name not in _SPAN_CALLS or not call.args:
-        return None
-    first = call.args[0]
-    return first.value if isinstance(first, ast.Constant) and \
-        isinstance(first.value, str) else None
-
-
-def _paths():
-    for sub in SCAN:
-        p = ROOT / sub
-        yield from (sorted(p.rglob("*.py")) if p.is_dir() else [p])
+import ceph_tpu.analysis as A
+from ceph_tpu.common.critpath import is_declared
 
 
 def test_spans_in_serving_recovery_pipeline_declare_a_phase():
-    offenders = []
-    for path in _paths():
-        rel = path.relative_to(ROOT).as_posix()
-        tree = ast.parse(path.read_text())
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.Call):
-                continue
-            name = _span_name(node)
-            if name is None:
-                continue
-            phase_kw = next((kw.value for kw in node.keywords
-                             if kw.arg == "phase"), None)
-            if isinstance(phase_kw, ast.Constant) and \
-                    phase_kw.value in PHASES:
-                continue                      # explicit declaration
-            if is_declared(name):
-                continue
-            offenders.append(
-                f"{rel}:{node.lineno}: span {name!r} maps to no "
-                f"declared critical-path phase — add it to "
-                f"critpath.SPAN_PHASES or pass phase=<one of {PHASES}>")
+    offenders = [f.render() for f in A.run_rules(
+        A.default_index(), ("span-phase",))]
     assert not offenders, (
         "undeclared span phases (attribution would file these under "
         "'other'):\n" + "\n".join(offenders))
 
 
 def test_scan_targets_still_exist():
-    for sub in SCAN:
-        assert (ROOT / sub).exists(), f"stale scan target: {sub}"
+    idx = A.default_index()
+    for sub in ("ceph_tpu/exec", "ceph_tpu/recovery",
+                "ceph_tpu/ops/pipeline.py"):
+        assert idx.iter_modules((sub,)), f"stale scan target: {sub}"
+
+
+def test_guard_catches_an_undeclared_span():
+    bad = ("def f(tr):\n"
+           "    with tr.span('totally.new.span'):\n"
+           "        pass\n"
+           "    with tr.span('ec.encode'):\n"       # declared: fine
+           "        pass\n"
+           "    with tr.span('x.y', phase='device'):\n"  # explicit: fine
+           "        pass\n")
+    found = A.run_rule_on_sources("span-phase", {"bad.py": bad})
+    assert len(found) == 1
+    assert "totally.new.span" in found[0].message
 
 
 def test_registry_covers_the_process_wide_span_inventory():

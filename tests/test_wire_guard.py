@@ -1,67 +1,30 @@
 """Guard: every message class on the wire registers wire accounting.
 
-``common/wire_accounting.py`` charges every sent message's bytes to a
-per-type counter and a per-op-class rollup; the byte count for the
-non-framed in-process bus comes from the per-type sizer registry.  A
-message class added to ``backend/messages.py`` or ``net.py`` WITHOUT a
-registered sizer would still be counted (pickle fallback + an
-``unsized_msgs`` bump) but with an estimate nobody reviewed — so this
-guard walks both modules by AST (the ``test_counter_help.py`` pattern:
-discipline as a test), collects every dataclass that can ride the
-PGChannel/RPC wire, and fails unless each one appears in the live sizer
-registry.  No unmetered message types.
+Thin wrapper over the ``wire-sizer`` rule in
+:mod:`ceph_tpu.analysis.rules_guards` (ISSUE 15); semantics unchanged —
+every dataclass in the message modules that can ride the PGChannel/RPC
+wire must appear in the live sizer registry, or its bytes get charged
+by an unreviewed pickle estimate.  The runtime registry and sizer
+spot-checks below stay as direct tests: they exercise live behaviour
+the AST rule cannot see.
 """
-import ast
-from pathlib import Path
-
-ROOT = Path(__file__).resolve().parent.parent
-
-# message-shaped dataclasses that never ride a channel: local config /
-# transport-internal wrappers (the _-prefixed ones are excluded by name)
-NOT_WIRE_MESSAGES = {"FaultConfig"}
-
-MESSAGE_MODULES = ("ceph_tpu/backend/messages.py", "ceph_tpu/net.py",
-                   "ceph_tpu/msg/proto.py")
-
-
-def _dataclass_names(path: Path) -> set[str]:
-    tree = ast.parse(path.read_text(), filename=str(path))
-    names = set()
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.ClassDef):
-            continue
-        for dec in node.decorator_list:
-            target = dec.func if isinstance(dec, ast.Call) else dec
-            if isinstance(target, ast.Name) and target.id == "dataclass" \
-                    or isinstance(target, ast.Attribute) and \
-                    target.attr == "dataclass":
-                names.add(node.name)
-    return names
+import ceph_tpu.analysis as A
+from ceph_tpu.analysis.rules_guards import MESSAGE_MODULES, _dataclass_names
 
 
 def test_ast_finds_message_dataclasses():
-    """The guard must be scanning something real (if the message modules
+    """The rule must be scanning something real (if the message modules
     move, update MESSAGE_MODULES rather than silently guarding air)."""
+    idx = A.default_index()
     total = set()
-    for rel in MESSAGE_MODULES:
-        total |= _dataclass_names(ROOT / rel)
+    for mod in idx.iter_modules(MESSAGE_MODULES):
+        total |= _dataclass_names(mod)
     assert len(total) >= 20, f"only {len(total)} dataclasses found"
 
 
 def test_every_wire_message_registers_a_sizer():
-    # importing the modules runs their register_wire_sizes() blocks
-    import ceph_tpu.backend.messages  # noqa: F401
-    import ceph_tpu.msg.proto  # noqa: F401
-    import ceph_tpu.net  # noqa: F401
-    from ceph_tpu.common.wire_accounting import registered_wire_types
-    registered = registered_wire_types()
-    offenders = []
-    for rel in MESSAGE_MODULES:
-        for name in sorted(_dataclass_names(ROOT / rel)):
-            if name.startswith("_") or name in NOT_WIRE_MESSAGES:
-                continue
-            if name not in registered:
-                offenders.append(f"{rel}: {name}")
+    offenders = [f.render() for f in A.run_rules(
+        A.default_index(), ("wire-sizer",))]
     assert not offenders, (
         "message classes without a wire-accounting sizer (register them "
         "in register_wire_sizes next to the definition):\n"

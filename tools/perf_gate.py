@@ -90,6 +90,12 @@ METRIC_PATHS = {
     "serving.async.clients": (("serving", "async", "clients"), True),
     "serving.async.overload.ops_s": (
         ("serving", "async", "overload", "ops_s"), True),
+    # static analysis (ISSUE 15): the ceph-lint trajectory. `new` is
+    # held to an absolute zero (METRIC_LIMITS) — any non-baselined
+    # finding fails the round; `baselined` is diffed against the
+    # reference so suppressed debt can't quietly snowball.
+    "lint.new": (("lint", "new"), False),
+    "lint.baselined": (("lint", "baselined"), False),
 }
 
 # absolute bounds checked on the NEW artifact alone — no reference
@@ -110,6 +116,10 @@ METRIC_LIMITS = {
     # the ISSUE 14 acceptance floor: the async bench must actually run
     # >= 10k concurrent closed-loop sessions, every artifact, no ref
     "serving.async.clients": (10000, "min"),
+    # ceph-lint must run clean against the committed baseline in every
+    # artifact — a new finding is a bug (or a missing justification),
+    # never acceptable drift
+    "lint.new": (0, "max"),
 }
 
 # fraction of regression tolerated per metric before the gate fails;
@@ -139,7 +149,12 @@ METRIC_THRESHOLDS = {"efficiency.pct_of_peak": 0.30,
                      # host: gate cliffs, not scheduler jitter
                      "serving.async.ops_s": 0.30,
                      "serving.async.p99_ms": 0.50,
-                     "serving.async.overload.ops_s": 0.30}
+                     "serving.async.overload.ops_s": 0.30,
+                     # a small integer count: one justified baseline
+                     # entry is ~6% at today's size, so diff loosely and
+                     # let review argue each justification — the gate
+                     # only stops a silent suppression avalanche
+                     "lint.baselined": 0.50}
 
 _BLOCK_DEVICE = {
     "core.mib_s": ("device",),
@@ -163,6 +178,10 @@ _BLOCK_DEVICE = {
     "serving.async.p99_ms": ("serving", "device"),
     "serving.async.clients": ("serving", "device"),
     "serving.async.overload.ops_s": ("serving", "device"),
+    # lint is host-side AST work; the block carries no device marker, so
+    # these fall back to the artifact's overall platform
+    "lint.new": ("lint", "device"),
+    "lint.baselined": ("lint", "device"),
 }
 
 
