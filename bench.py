@@ -525,6 +525,83 @@ def _recovery_repair_pass(device: str, batched: bool, n_objects: int,
         c.shutdown()
 
 
+def _recovery_regen_pass(device: str, mode: str, k: int, m: int, d: int,
+                         chunk: int, n_objects: int, stripes: int,
+                         regen: bool = True) -> dict:
+    """One degraded repair on a REGENERATING pool (pm_regen MSR/MBR):
+    write, kill a shard, overwrite while down, revive, time the drain.
+    ``regen=False`` pins the option off so the same pool repairs through
+    the centralized verified wave — the comparison arm.  Repaired bytes
+    are counted in STORED units (MBR chunks are expanded alpha*k/B on
+    disk); wire is the recovery-class delta over the measured cycle."""
+    from ceph_tpu.cluster import MiniCluster
+    from ceph_tpu.common import Context
+    c = MiniCluster(n_osds=9, osds_per_host=3, chunk_size=chunk,
+                    cct=Context())
+    try:
+        c.cct.conf.set("osd_recovery_regen_enable", bool(regen))
+        c.cct.conf.set("osd_recovery_max_active", 16)
+        c.enable_recovery_scheduler()
+        pid = c.create_ec_pool(
+            "rg", {"plugin": "pm_regen", "k": str(k), "m": str(m),
+                   "d": str(d), "mode": mode, "device": device},
+            pg_num=1)
+        g = c.pools[pid]["pgs"][0]
+        victim = g.acting[1]
+        obj_bytes = stripes * chunk * k
+        rng = np.random.default_rng(0)
+        objs = {f"o{i}": rng.integers(0, 256, obj_bytes,
+                                      np.uint8).tobytes()
+                for i in range(n_objects)}
+        for oid, data in objs.items():
+            c.put(pid, oid, data)
+        stored = g.backend.ec_impl.get_stored_chunk_size(chunk)
+        repaired = stripes * stored * n_objects
+        dt = wire = helper_tx = 0
+        ro = rf = 0
+        # warm cycle then measured cycle (same discipline as the chain
+        # pass: both arms pay their cold jit/compile in cycle one)
+        for payload in (b"\x01", b"\x02"):
+            g.bus.mark_down(victim)
+            for oid in objs:
+                c.put(pid, oid, payload + objs[oid][1:])
+            ro_before = g.backend.perf.get("regen_objects")
+            rf_before = g.backend.perf.get("regen_fallbacks")
+            wire_before = c.wire.class_bytes()["recovery"]
+            helper_before = c.wire.per_type().get(
+                "ECRegenHelper", {}).get("tx_bytes", 0)
+            t0 = time.perf_counter()
+            g.bus.mark_up(victim)
+            c.deliver_all()
+            dt = time.perf_counter() - t0
+            ro = g.backend.perf.get("regen_objects") - ro_before
+            rf = g.backend.perf.get("regen_fallbacks") - rf_before
+            wire = c.wire.class_bytes()["recovery"] - wire_before
+            helper_tx = c.wire.per_type().get(
+                "ECRegenHelper", {}).get("tx_bytes", 0) - helper_before
+            assert not g.backend.stale, "regen repair did not drain"
+        report = c.scrub_pool(pid, repair=False)
+        assert report == {}, f"repair left scrub findings: {report}"
+        return {"mib_s": round(repaired / 2**20 / dt, 2),
+                "objects": n_objects, "repaired_bytes": repaired,
+                "stored_chunk": stored, "elapsed_s": round(dt, 3),
+                "wire_bytes": int(wire),
+                # total recovery wire per STORED byte repaired — the
+                # ROADMAP item-3 metric on the regenerating pool.  The
+                # beta-stream floor is 1.0 B/B at the MBR point and
+                # d/alpha at MSR; control legs (plan + acks) amortize
+                # over payload
+                "wire_per_byte": round(wire / max(repaired, 1), 3),
+                # the helper beta-streams alone: what the newcomer
+                # ingests beyond its own combine matrix
+                "helper_stream_per_byte": round(
+                    helper_tx / max(repaired, 1), 3),
+                "regen_objects": int(ro),
+                "regen_fallbacks": int(rf)}
+    finally:
+        c.shutdown()
+
+
 def recovery_section(platform: str | None) -> dict:
     """Degraded-cluster repair throughput for the JSON artifact's
     `recovery` block: kill-one-shard repair MiB/s, batch-fused
@@ -544,6 +621,20 @@ def recovery_section(platform: str | None) -> dict:
                                             n_objects=48,
                                             obj_bytes=64 * 1024,
                                             chain=True)
+            # regenerating-code repair (pm_regen): MBR at the ~1 B/B
+            # repair-bandwidth point, MSR at d/alpha, vs the same pool
+            # repaired through the centralized wave
+            regen_mbr = _recovery_regen_pass(device, "mbr", 3, 2, 4,
+                                             chunk=1536, n_objects=24,
+                                             stripes=8)
+            regen_mbr_cent = _recovery_regen_pass(device, "mbr", 3, 2,
+                                                  4, chunk=1536,
+                                                  n_objects=24,
+                                                  stripes=8,
+                                                  regen=False)
+            regen_msr = _recovery_regen_pass(device, "msr", 3, 2, 4,
+                                             chunk=4096, n_objects=24,
+                                             stripes=8)
         res = {
             "device": "tpu" if platform == "tpu" else "cpu",
             "codec": device,
@@ -581,6 +672,22 @@ def recovery_section(platform: str | None) -> dict:
                 "chain_objects": chained["chain_objects"],
                 "chain_fallbacks": chained["chain_fallbacks"],
             },
+            # regenerating repair vs centralized on the SAME pm_regen
+            # pool.  MBR's total wire is gated absolutely at 1.5 B/B
+            # (tools/perf_gate.py) — below the k-transfer floor any
+            # decode-based repair pays; MSR sits at d/alpha and is
+            # gated under the 4.0 regenerating-pool ceiling
+            "regen": {
+                "mbr": {
+                    **regen_mbr,
+                    "centralized_wire_per_byte":
+                        regen_mbr_cent["wire_per_byte"],
+                    "wire_reduction": round(
+                        regen_mbr_cent["wire_per_byte"] /
+                        max(regen_mbr["wire_per_byte"], 1e-9), 2),
+                },
+                "msr": regen_msr,
+            },
         }
         if res["device"] == "cpu":
             res["note"] = ("no tpu: repair dispatch overhead measured "
@@ -592,6 +699,13 @@ def recovery_section(platform: str | None) -> dict:
               f"{chained['wire_per_byte']:.2f}/B vs centralized "
               f"{batched['wire_per_byte']:.2f}/B, newcomer ingress "
               f"{chained['newcomer_ingress_per_byte']:.2f}/B",
+              file=sys.stderr)
+        print(f"# recovery.regen: mbr {regen_mbr['wire_per_byte']:.2f}/B"
+              f" (centralized {regen_mbr_cent['wire_per_byte']:.2f}/B, "
+              f"{res['regen']['mbr']['wire_reduction']}x less wire) at "
+              f"{regen_mbr['mib_s']:.1f} MiB/s; msr "
+              f"{regen_msr['wire_per_byte']:.2f}/B at "
+              f"{regen_msr['mib_s']:.1f} MiB/s",
               file=sys.stderr)
         return res
     except Exception as e:                 # never fail the artifact

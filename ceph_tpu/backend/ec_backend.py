@@ -97,6 +97,12 @@ class ECBackend(PGBackend):
         assert len(acting) == n, f"acting set must have {n} shards"
         self.ec_impl = ec_impl
         self.sinfo = sinfo
+        # regenerating MBR chunks expand on disk: let the plugin pin the
+        # stored size so shard extents/hinfo stay in real on-disk units
+        # (one hook covers every StripeInfo construction site)
+        stored_hook = getattr(ec_impl, "get_stored_chunk_size", None)
+        if stored_hook is not None:
+            sinfo.stored_chunk_size = int(stored_hook(sinfo.chunk_size))
         # min_size floored at k: an ack on fewer than k shards would be
         # unreadable data, which is exactly the loss the gate prevents
         super().__init__(bus, acting, whoami=whoami, cct=cct, name=name,
@@ -253,8 +259,10 @@ class ECBackend(PGBackend):
         # codeword when the sub-chunk interleave spans the whole height
         # (same rule as objects_read_and_reconstruct; the gap reads of
         # the planner's forced full-object rewrite hit this degraded)
-        whole_chunks = (self.ec_impl.get_sub_chunk_count() > 1
-                        and set(minimum) != want)
+        whole_chunks = ((self.ec_impl.get_sub_chunk_count() > 1
+                         and set(minimum) != want)
+                        or getattr(self.ec_impl, "requires_full_chunk_io",
+                                   False))
         per_shard: dict[int, dict[str, list[tuple]]] = {}
         for oid, es in need.items():
             for off, length in es:
@@ -367,8 +375,9 @@ class ECBackend(PGBackend):
                 # handling; ECTransaction.h:70-86)
                 t_logical = self.sinfo.logical_to_next_stripe_offset(
                     objop.truncate[0])
-                t_chunk = self.sinfo.aligned_logical_offset_to_chunk_offset(
-                    t_logical)
+                t_chunk = self.sinfo.chunk_to_stored(
+                    self.sinfo.aligned_logical_offset_to_chunk_offset(
+                        t_logical))
                 if t_chunk < hinfo.total_chunk_size:
                     for chunk, shard in enumerate(self.acting):
                         shard_txns[shard].truncate(GObject(oid, shard), t_chunk)
@@ -433,8 +442,14 @@ class ECBackend(PGBackend):
             appended = 0
             pure_append = True
             for off, data in pieces:
-                c_off = self.sinfo.aligned_logical_offset_to_chunk_offset(off)
-                c_len = self.sinfo.aligned_logical_offset_to_chunk_offset(len(data))
+                # shard extents live in STORED units: the encoded chunk
+                # streams may be wider than the logical shares (MBR
+                # expansion), so offsets/lengths convert before slicing
+                c_off = self.sinfo.chunk_to_stored(
+                    self.sinfo.aligned_logical_offset_to_chunk_offset(off))
+                c_len = self.sinfo.chunk_to_stored(
+                    self.sinfo.aligned_logical_offset_to_chunk_offset(
+                        len(data)))
                 for chunk in range(n):
                     shard = self.acting[chunk]
                     payload = encoded[chunk][c_cursor:c_cursor + c_len]
@@ -548,7 +563,7 @@ class ECBackend(PGBackend):
         for tid, chain in list(self._recovery_chains.items()):
             if shard in getattr(chain, "hop_shards", ()):
                 del self._recovery_chains[tid]
-                self.perf.inc("chain_fallbacks")
+                self.perf.inc(f"{getattr(chain, 'kind', 'chain')}_fallbacks")
                 for oid in sorted(chain.pending_pushes):
                     self._wave_pushes.pop(oid, None)
                     self._wave_fallback_one(chain, oid)
@@ -609,8 +624,10 @@ class ECBackend(PGBackend):
         # is for per-byte-linear RS — decode full chunks and slice the
         # logical result instead (the write-planner's full-object-rewrite
         # rule, applied to the read side; found by the clay thrash soak)
-        whole_chunks = (self.ec_impl.get_sub_chunk_count() > 1
-                        and set(base_minimum) != want)
+        whole_chunks = ((self.ec_impl.get_sub_chunk_count() > 1
+                         and set(base_minimum) != want)
+                        or getattr(self.ec_impl, "requires_full_chunk_io",
+                                   False))
         per_shard: dict[int, dict[str, list[tuple]]] = {}
         for oid, extents in reads.items():
             lo = min(off for off, _ in extents)
@@ -847,8 +864,13 @@ class ECBackend(PGBackend):
         # Reading all spares also serves the HASH-PRESENT path: a source
         # failing its crc check is dropped and rebuilt, which needs a
         # replacement source in hand.
+        # pm_regen repairs whole stored chunks despite sub > 1, so its
+        # sources can be crc-checked (and spares held) the same way
         verify = (len(avail) > len(minimum)
-                  and self.ec_impl.get_sub_chunk_count() == 1)
+                  and (self.ec_impl.get_sub_chunk_count() == 1
+                       or getattr(self.ec_impl,
+                                  "supports_regenerating_repair",
+                                  lambda: False)()))
         want = ({c: [(0, self.ec_impl.get_sub_chunk_count())]
                  for c in sorted(avail)} if verify else minimum)
         per_shard = {}
@@ -932,16 +954,22 @@ class ECBackend(PGBackend):
         # runs), and padding them to full length makes the plugin
         # mistake them for whole chunks and full-decode garbage — the
         # seed's wrong-bytes clay recovery (ROADMAP item 1).
+        # pm_regen is sub-chunked too, but its recovery reads are always
+        # WHOLE stored chunks (requires_full_chunk_io / the regen gate),
+        # so length normalization and the crc check below stay valid
+        whole_reads = (self.ec_impl.get_sub_chunk_count() == 1
+                       or getattr(self.ec_impl,
+                                  "supports_regenerating_repair",
+                                  lambda: False)())
         total = hinfo.get_total_chunk_size()
-        if total and self.ec_impl.get_sub_chunk_count() == 1:
+        if total and whole_reads:
             available = {
                 c: (v if len(v) == total else np.frombuffer(
                     v.tobytes()[:total].ljust(total, b"\0"),
                     dtype=np.uint8))
                 for c, v in available.items()}
         k = self.ec_impl.get_data_chunk_count()
-        if hinfo.has_chunk_hash() and \
-                self.ec_impl.get_sub_chunk_count() == 1:
+        if hinfo.has_chunk_hash() and whole_reads:
             # the reference CRC-verifies recovery reads against the
             # hinfo before reconstructing (ECBackend handle_recovery_
             # read_complete checks the cumulative hash): a source whose
@@ -1067,6 +1095,17 @@ class ECBackend(PGBackend):
         verified per-object path."""
         k = self.ec_impl.get_data_chunk_count()
         cur = self.current_shards()
+        # regenerating codes (product-matrix MSR/MBR) take every
+        # single-erasure object FIRST — d helper inner products move
+        # fewer bytes than any decode-based path; leftovers (multi-loss,
+        # too few helpers, plan gaps) fall through unchanged.  The probe
+        # keeps non-regenerating codes entirely untouched.
+        if oids and getattr(self.ec_impl, "supports_regenerating_repair",
+                            lambda: False)():
+            from ..recovery.regen import plan_regens
+            oids = plan_regens(self, oids, on_each)
+            if not oids:
+                return
         if self.ec_impl.get_sub_chunk_count() != 1 or len(oids) < 2:
             # clay's fractional repair reads are not positionwise across
             # objects; a singleton has nothing to fuse — per-object keeps
@@ -1290,11 +1329,11 @@ class ECBackend(PGBackend):
             chain.pending_pushes.pop(msg.oid, None)
             self._wave_fallback_one(chain, msg.oid)
         else:
-            self.perf.inc("chain_objects")
+            self.perf.inc(f"{getattr(chain, 'kind', 'chain')}_objects")
             self._finish_wave_oid(chain, msg.oid)
         if not chain.pending_pushes:
             self._recovery_chains.pop(msg.tid, None)
-            self.perf.inc("chain_repairs")
+            self.perf.inc(f"{getattr(chain, 'kind', 'chain')}_repairs")
 
     def _chain_abort(self, msg: ECPartialSumAbort) -> None:
         """A hop refused its leg (missing/rotten/raced local chunk): the
@@ -1302,7 +1341,7 @@ class ECBackend(PGBackend):
         chain = self._recovery_chains.pop(msg.tid, None)
         if chain is None:
             return
-        self.perf.inc("chain_fallbacks")
+        self.perf.inc(f"{getattr(chain, 'kind', 'chain')}_fallbacks")
         for oid in sorted(chain.pending_pushes):
             self._wave_pushes.pop(oid, None)
             self._wave_fallback_one(chain, oid)

@@ -97,10 +97,36 @@ class StripeInfo:
     chunk offsets in per-shard space.
     """
 
-    def __init__(self, k: int, chunk_size: int):
+    def __init__(self, k: int, chunk_size: int,
+                 stored_chunk_size: int | None = None):
         self.k = k
         self.chunk_size = chunk_size
         self.stripe_width = k * chunk_size
+        # On-disk bytes per chunk_size logical share bytes.  Equal for
+        # every classic code; regenerating MBR chunks expand (plugin
+        # get_stored_chunk_size), so shard extents, hinfo sizes and
+        # transaction offsets all live in STORED units while logical
+        # offset algebra stays in share units.
+        self.stored_chunk_size = (chunk_size if stored_chunk_size is None
+                                  else stored_chunk_size)
+
+    def chunk_to_stored(self, chunk_off: int) -> int:
+        """Share-space chunk offset/length -> stored (on-disk) units."""
+        if self.stored_chunk_size == self.chunk_size:
+            return chunk_off
+        scaled = chunk_off * self.stored_chunk_size
+        assert scaled % self.chunk_size == 0, \
+            f"chunk offset {chunk_off} not stored-convertible"
+        return scaled // self.chunk_size
+
+    def stored_to_chunk(self, stored_off: int) -> int:
+        """Stored (on-disk) offset/length -> share-space chunk units."""
+        if self.stored_chunk_size == self.chunk_size:
+            return stored_off
+        scaled = stored_off * self.chunk_size
+        assert scaled % self.stored_chunk_size == 0, \
+            f"stored offset {stored_off} not share-convertible"
+        return scaled // self.stored_chunk_size
 
     def logical_offset_is_stripe_aligned(self, logical: int) -> bool:
         return logical % self.stripe_width == 0
@@ -180,15 +206,19 @@ class HashInfo:
         return self.projected_total_chunk_size
 
     def get_total_logical_size(self, sinfo: StripeInfo) -> int:
-        return self.total_chunk_size * (sinfo.stripe_width // sinfo.chunk_size)
+        # chunk sizes are STORED units; convert back to share space
+        # before multiplying out to logical bytes
+        return sinfo.stored_to_chunk(self.total_chunk_size) * \
+            (sinfo.stripe_width // sinfo.chunk_size)
 
     def get_projected_total_logical_size(self, sinfo: StripeInfo) -> int:
-        return self.projected_total_chunk_size * (sinfo.stripe_width // sinfo.chunk_size)
+        return sinfo.stored_to_chunk(self.projected_total_chunk_size) * \
+            (sinfo.stripe_width // sinfo.chunk_size)
 
     def set_projected_total_logical_size(self, sinfo: StripeInfo, logical: int) -> None:
         assert sinfo.logical_offset_is_stripe_aligned(logical)
-        self.projected_total_chunk_size = \
-            sinfo.aligned_logical_offset_to_chunk_offset(logical)
+        self.projected_total_chunk_size = sinfo.chunk_to_stored(
+            sinfo.aligned_logical_offset_to_chunk_offset(logical))
 
     def set_total_chunk_size_clear_hash(self, new_chunk_size: int) -> None:
         self.cumulative_shard_hashes = []
@@ -459,9 +489,11 @@ def decode(sinfo: StripeInfo, ec_impl,
     assert len(total) == 1, "uneven shard buffers"
     decoded = ec_impl.decode_concat(chunks)
     k = ec_impl.get_data_chunk_count()
-    shard_len = total.pop()
+    total.pop()
+    # reshape by row count, not input length: expanded (MBR) stored
+    # chunks decode to SHORTER share streams than the stored input
     logical = _from_shard_major(
-        np.frombuffer(decoded, dtype=np.uint8).reshape(k, shard_len),
+        np.frombuffer(decoded, dtype=np.uint8).reshape(k, -1),
         sinfo.chunk_size)
     return logical.tobytes()
 
@@ -693,6 +725,69 @@ def partial_sum_accumulate(coeffs, stream, acc, pipeline=None,
     fut = pipeline.submit(pack, dispatch, unpack, kind="partial_sum",
                           owner=owner, host_fallback=host_fallback, ops=1)
     return fut.result()
+
+
+def _gf_matmul_routed(mat: np.ndarray, data: np.ndarray, pipeline=None,
+                      owner: str | None = "recovery",
+                      use_device: bool = False) -> np.ndarray:
+    """One GF(2^8) matrix product routed through the recovery
+    CodecPipeline (breaker / host-fallback / attribution) when present,
+    host otherwise — the shared engine under the regenerating-repair
+    legs."""
+    from ..ops import codec as _codec
+    mat = np.ascontiguousarray(mat, dtype=np.uint8)
+    data = np.ascontiguousarray(data, dtype=np.uint8)
+    if pipeline is None or not use_device:
+        return _codec.gf_inner_product_host(mat, data)
+
+    def pack():
+        return mat, data
+
+    def dispatch(packed):
+        m, d = packed
+        return _codec.gf_inner_product_device(m, d)
+
+    def unpack(packed, host):
+        return np.asarray(host, dtype=np.uint8)
+
+    def host_fallback(packed):
+        m, d = packed
+        return _codec.gf_inner_product_host(m, d)
+
+    fut = pipeline.submit(pack, dispatch, unpack, kind="regen",
+                          owner=owner, host_fallback=host_fallback, ops=1)
+    return fut.result()
+
+
+def regen_project(coeffs: bytes | np.ndarray, stream, sub_count: int,
+                  pipeline=None, owner: str | None = "recovery",
+                  use_device: bool = False) -> bytes:
+    """One helper's regenerating-repair leg: project the stored chunk's
+    ``sub_count`` symbol rows down to the single beta-stream
+    ``psi_f . chunk`` it ships to the newcomer (len(stream)/sub_count
+    bytes — the d-fold wire saving the product-matrix code exists
+    for)."""
+    data = _as_u8(stream)
+    assert data.size % sub_count == 0, "chunk not sub-chunk aligned"
+    mat = np.frombuffer(bytes(coeffs), dtype=np.uint8).reshape(1, sub_count)
+    out = _gf_matmul_routed(mat, data.reshape(sub_count, -1),
+                            pipeline=pipeline, owner=owner,
+                            use_device=use_device)
+    return out.reshape(-1).tobytes()
+
+
+def regen_combine(mat: bytes | np.ndarray, streams: list, sub_count: int,
+                  pipeline=None, owner: str | None = "recovery",
+                  use_device: bool = False) -> bytes:
+    """The newcomer's regenerating-repair leg: combine the d stacked
+    helper beta-streams into the lost chunk's ``sub_count`` symbol rows
+    (bitwise-exact repair)."""
+    stack = np.stack([_as_u8(s) for s in streams])
+    m = np.frombuffer(bytes(mat), dtype=np.uint8).reshape(sub_count,
+                                                          len(streams))
+    out = _gf_matmul_routed(m, stack, pipeline=pipeline, owner=owner,
+                            use_device=use_device)
+    return out.reshape(-1).tobytes()
 
 
 HINFO_KEY = "hinfo_key"  # xattr name (ECUtil.cc:235, get_hinfo_key)

@@ -250,6 +250,51 @@ class ECPartialSumAbort:
     trace: object = None
 
 
+@dataclass
+class ECRegenRead:
+    """Coordinator -> one leg of a regenerating repair (product-matrix
+    MSR/MBR, arXiv:1412.3022).  The same message serves both legs:
+
+    - **helper leg** (``proj`` set): project your stored chunk's
+      ``sub_count`` symbol rows by the 1 x sub_count coefficient row and
+      ship the beta-stream to ``target`` via :class:`ECRegenHelper`;
+    - **newcomer leg** (``combine`` set): expect ``len(helpers)``
+      beta-streams per oid, combine them by the sub_count x d matrix
+      into the lost chunk, verify, apply, ack the coordinator.
+
+    Validation mirrors the chain hops (PR 12's verification-first rule):
+    any mismatch aborts to the coordinator, which falls back to
+    centralized waves."""
+    from_shard: int
+    tid: int
+    coordinator: int              # shard Applied/Abort replies go to
+    target: int                   # newcomer shard the beta-streams converge on
+    chunk: int                    # receiver's chunk id (helper: its own; newcomer: the lost one)
+    sub_count: int = 1            # alpha symbol rows per stored chunk
+    proj: bytes = b""             # helper leg: 1 x alpha projection row
+    combine: bytes = b""          # newcomer leg: alpha x d combine matrix (row-major)
+    helpers: list = field(default_factory=list)   # newcomer leg: helper chunks, stream order
+    oids: list = field(default_factory=list)      # plan order
+    lengths: list = field(default_factory=list)   # per-oid STORED chunk bytes
+    versions: list = field(default_factory=list)  # per-oid pg_log version
+    attrs: dict = field(default_factory=dict)     # oid -> replicated attrs
+    use_device: bool = False
+    trace: object = None
+
+
+@dataclass
+class ECRegenHelper:
+    """Helper -> newcomer: the beta-byte inner-product streams — the d
+    small shipments that replace k full-chunk reads (MBR: d*beta equals
+    ONE chunk; MSR: d/alpha chunks)."""
+    from_shard: int
+    tid: int
+    coordinator: int
+    chunk: int                    # helper's chunk id (stream-order key)
+    streams: dict = field(default_factory=dict)   # oid -> beta bytes
+    trace: object = None
+
+
 # -- wire accounting (common/wire_accounting.py) -----------------------------
 #
 # Every PG message type registers its payload sizer here, next to its
@@ -288,6 +333,11 @@ wire_accounting.register_wire_sizes({
                                   + len(m.oid) + 16),
     ECPartialSumApplied: lambda m: 16 + len(m.oid),
     ECPartialSumAbort: lambda m: 16 + len(m.reason),
+    ECRegenRead: lambda m: (len(m.proj) + len(m.combine) + _blob(m.helpers)
+                            + _blob(m.oids) + _blob(m.attrs)
+                            + 8 * len(m.lengths) + 8 * len(m.versions)
+                            + 16),
+    ECRegenHelper: lambda m: _blob(m.streams) + 24,
     # the cluster-bus wrapper: header + the routed payload
     "PGEnvelope": lambda m: 16 + wire_accounting.wire_size(m.msg),
 })

@@ -44,7 +44,7 @@ from enum import Enum
 from .bluestore import ChecksumError
 from .memstore import GObject, MemStore, Transaction
 from .messages import (ECPartialSum, ECPartialSumAbort, ECPartialSumApplied,
-                       ECPartialSumApply,
+                       ECPartialSumApply, ECRegenHelper, ECRegenRead,
                        ECSubRead, ECSubReadReply, ECSubWrite, ECSubWriteReply,
                        MessageBus, PGActivate, PGActivateAck, PGLogInfo,
                        PGLogQuery, PGLogUpdate,
@@ -374,6 +374,13 @@ class OSDShard:
                 self._apply_push(obj, msg.data, msg.attrs, None, b"")
             self.bus.send(msg.coordinator,
                           ECPartialSumApplied(self.shard, msg.tid, msg.oid))
+        elif isinstance(msg, ECRegenRead):
+            if msg.combine:
+                self._regen_prime(msg)
+            else:
+                self._regen_helper_leg(msg)
+        elif isinstance(msg, ECRegenHelper):
+            self._regen_ingest(msg)
         else:
             raise TypeError(f"shard {self.shard}: unexpected {msg!r}")
 
@@ -481,6 +488,172 @@ class OSDShard:
                     acc[row][off:off + length],
                     attrs=dict(msg.attrs.get(oid, {}))))
                 off += length
+
+    # -- regenerating repair legs (recovery/regen.py) ----------------------
+    #
+    # Helper shards project their stored chunk down to one beta-stream
+    # and ship it to the newcomer; the newcomer combines d streams into
+    # the lost chunk.  Validation mirrors _partial_sum_hop: any mismatch
+    # aborts the tid back to the coordinator (centralized fallback) —
+    # a leg never guesses around bad state.
+
+    # bounded stash for beta-streams arriving before this shard's own
+    # ECRegenRead prime (cross-sender delivery order is not guaranteed)
+    REGEN_ORPHAN_CAP = 32
+    # newcomer-side in-flight repair cap: aborted/fallen-back tids are
+    # evicted oldest-first rather than leaking
+    REGEN_PENDING_CAP = 64
+
+    def _regen_abort(self, msg, reason: str) -> None:
+        self.bus.send(msg.coordinator,
+                      ECPartialSumAbort(self.shard, msg.tid, reason))
+        pend = getattr(self, "_regen_pending", None)
+        if pend is not None:
+            pend.pop(msg.tid, None)
+        orph = getattr(self, "_regen_orphans", None)
+        if orph is not None:
+            orph.pop(msg.tid, None)
+
+    def _regen_read_local(self, msg, oid: str, length: int,
+                          version: int) -> bytes | None:
+        """Read + validate one plan object's local stored chunk (the
+        _partial_sum_hop ladder); None means the tid was aborted."""
+        from .ecutil import HINFO_KEY, crc32c
+        obj = GObject(oid, self.shard)
+        try:
+            data = self.store.read(obj, 0, None)
+            stored = self.store.getattr(obj, HINFO_KEY)
+        except (FileNotFoundError, KeyError):
+            self._regen_abort(msg, f"{oid}: no local copy")
+            return None
+        except ChecksumError:
+            self._regen_abort(msg, f"{oid}: rotten chunk")
+            return None
+        if stored.get("version", 0) != version:
+            self._regen_abort(msg, f"{oid}: version skew")
+            return None
+        if len(data) > length:
+            self._regen_abort(msg, f"{oid}: longer than plan")
+            return None
+        if len(data) < length:
+            data = data + b"\0" * (length - len(data))
+        hashes = (msg.attrs.get(oid, {}).get(HINFO_KEY) or {}).get(
+            "cumulative_shard_hashes") or []
+        if hashes and crc32c(0xFFFFFFFF, data) != hashes[msg.chunk]:
+            self._regen_abort(msg, f"{oid}: chunk hash mismatch")
+            return None
+        return data
+
+    def _regen_helper_leg(self, msg: ECRegenRead) -> None:
+        """Helper leg: project every plan object's stored chunk by the
+        1 x alpha coefficient row and ship the beta-streams to the
+        newcomer in ONE ECRegenHelper."""
+        from . import ecutil
+        if len(msg.proj) != msg.sub_count:
+            self._regen_abort(msg, "sub-chunk mismatch")
+            return
+        streams: dict[str, bytes] = {}
+        total = 0
+        for oid, length, version in zip(msg.oids, msg.lengths,
+                                        msg.versions):
+            if length % max(msg.sub_count, 1):
+                self._regen_abort(msg, f"{oid}: sub-chunk mismatch")
+                return
+            data = self._regen_read_local(msg, oid, length, version)
+            if data is None:
+                return
+            total += len(data)
+            with trace_span("recovery.regen_hop", owner="recovery",
+                            nbytes=len(data)):
+                streams[oid] = ecutil.regen_project(
+                    msg.proj, data, msg.sub_count,
+                    pipeline=getattr(self, "recovery_pipeline", None),
+                    use_device=msg.use_device)
+        self.bus.send(msg.target, ECRegenHelper(
+            from_shard=self.shard, tid=msg.tid,
+            coordinator=msg.coordinator, chunk=msg.chunk,
+            streams=streams, trace=msg.trace))
+
+    def _regen_prime(self, msg: ECRegenRead) -> None:
+        """Newcomer leg: remember the plan (combine matrix, helper
+        stream order, per-oid lengths/attrs) and drain any beta-streams
+        that arrived before it."""
+        pend = getattr(self, "_regen_pending", None)
+        if pend is None:
+            pend = self._regen_pending = {}
+        if msg.sub_count < 1 or len(msg.combine) != \
+                msg.sub_count * len(msg.helpers):
+            self._regen_abort(msg, "sub-chunk mismatch")
+            return
+        while len(pend) >= self.REGEN_PENDING_CAP:
+            pend.pop(next(iter(pend)))
+        pend[msg.tid] = {"msg": msg, "streams": {}}
+        orphans = getattr(self, "_regen_orphans", None)
+        for early in (orphans.pop(msg.tid, []) if orphans else []):
+            self._regen_ingest(early)
+
+    def _regen_ingest(self, msg: ECRegenHelper) -> None:
+        """One helper's beta-streams landing on the newcomer; combine +
+        verify + apply once all d helpers reported."""
+        pend = getattr(self, "_regen_pending", None)
+        rec = pend.get(msg.tid) if pend else None
+        if rec is None:
+            orphans = getattr(self, "_regen_orphans", None)
+            if orphans is None:
+                orphans = self._regen_orphans = {}
+            stash = orphans.setdefault(msg.tid, [])
+            stash.append(msg)
+            while sum(len(v) for v in orphans.values()) > \
+                    self.REGEN_ORPHAN_CAP:
+                orphans.pop(next(iter(orphans)))
+            return
+        plan: ECRegenRead = rec["msg"]
+        if msg.chunk not in plan.helpers:
+            self._regen_abort(plan, f"stream from non-helper {msg.chunk}")
+            return
+        rec["streams"][msg.chunk] = msg.streams
+        if len(rec["streams"]) < len(plan.helpers):
+            return
+        self._regen_complete(plan, rec["streams"])
+
+    def _regen_complete(self, plan: ECRegenRead,
+                        streams: dict[int, dict]) -> None:
+        from types import SimpleNamespace
+
+        from . import ecutil
+        from .ecutil import HINFO_KEY, crc32c
+        pend = getattr(self, "_regen_pending", {})
+        pend.pop(plan.tid, None)
+        beta_per = {oid: length // plan.sub_count
+                    for oid, length in zip(plan.oids, plan.lengths)}
+        for oid, length in zip(plan.oids, plan.lengths):
+            rows = []
+            for h in plan.helpers:          # combine-matrix stream order
+                s = streams[h].get(oid)
+                if s is None or len(s) != beta_per[oid]:
+                    self._regen_abort(plan, f"{oid}: sub-chunk mismatch")
+                    return
+                rows.append(s)
+            with trace_span("recovery.regen_hop", owner="recovery",
+                            nbytes=length):
+                data = ecutil.regen_combine(
+                    plan.combine, rows, plan.sub_count,
+                    pipeline=getattr(self, "recovery_pipeline", None),
+                    use_device=plan.use_device)
+            oattrs = dict(plan.attrs.get(oid, {}))
+            hashes = (oattrs.get(HINFO_KEY) or {}).get(
+                "cumulative_shard_hashes") or []
+            if hashes and crc32c(0xFFFFFFFF, data) != hashes[plan.chunk]:
+                # the regenerated chunk must match the newcomer's own
+                # recorded hash chain bit-for-bit — the end-to-end
+                # verification a decode-and-push repair gets for free
+                self._regen_abort(plan, f"{oid}: combined hash mismatch")
+                return
+            obj = GObject(oid, self.shard)
+            if not self._push_is_stale(SimpleNamespace(attrs=oattrs), obj):
+                self._apply_push(obj, data, oattrs, None, b"")
+            self.bus.send(plan.coordinator,
+                          ECPartialSumApplied(self.shard, plan.tid, oid))
 
 
 def _slice_subchunks(data: bytes, runs: list[tuple[int, int]],
@@ -692,6 +865,12 @@ class PGBackend:
                              "objects repaired via streaming chains")
             .add_u64_counter("chain_fallbacks",
                              "chains aborted to centralized repair")
+            .add_u64_counter("regen_repairs",
+                             "regenerating-code repair rounds completed")
+            .add_u64_counter("regen_objects",
+                             "objects repaired from helper inner products")
+            .add_u64_counter("regen_fallbacks",
+                             "regen repairs aborted to centralized repair")
             .add_u64_counter("log_repairs_clean",
                              "shard repairs satisfied by log equality alone")
             .add_u64_counter("log_repairs", "log-based shard catch-ups")

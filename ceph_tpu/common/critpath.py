@@ -119,6 +119,15 @@ SPAN_PHASES: dict[str, str] = {
     # one scale-accumulate per survivor hop (device or exact host GF)
     "recovery.chain": DISPATCH,
     "recovery.chain_hop": DISPATCH,
+    # regenerating-code repair: plan assembly on the coordinator, then
+    # one projection/combine inner product per helper/newcomer hop
+    "recovery.regen": DISPATCH,
+    "recovery.regen_hop": DISPATCH,
+    # mux: per-riding-call stamps around batched RpcBatch /
+    # RpcResultBatch frames (msg/client.py sender loop, msg/server.py
+    # dispatcher) — cross-daemon frame time, hence wire
+    "mux.batch_send": WIRE,
+    "mux.batch_reply": WIRE,
     # device: compute + transfers (the codec spans wrap the actual
     # device/SIMD work; ec.* self-time is pack/scatter around it)
     "codec.encode": DEVICE,

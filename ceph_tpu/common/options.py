@@ -142,6 +142,17 @@ OPTIONS: list[Option] = [
                        "sources); repairs needing more sources than "
                        "this stay centralized",
            see_also=["osd_recovery_chain_enable"]),
+    Option("osd_recovery_regen_enable", TYPE_BOOL, LEVEL_ADVANCED,
+           default=True,
+           description="regenerating-code repair: single-erasure repairs "
+                       "on a regenerating pool (pm_regen MSR/MBR) gather "
+                       "d helper inner products (beta bytes each) at the "
+                       "newcomer instead of decoding k full chunks; "
+                       "falls back to centralized verified repair on any "
+                       "abort (helper death, version skew, sub-chunk or "
+                       "hash mismatch) and for multi-chunk losses",
+           see_also=["osd_recovery_chain_enable",
+                     "osd_recovery_max_active"]),
     Option("osd_heartbeat_interval", TYPE_INT, LEVEL_ADVANCED, default=6,
            description="seconds between peer heartbeats", min=1, max=60),
     Option("osd_heartbeat_grace", TYPE_INT, LEVEL_ADVANCED, default=20,

@@ -285,7 +285,13 @@ class MuxClient:
                 self._fail_all(ConnectionError("reconnect exhausted"))
                 continue
             try:
+                t_send = time.monotonic()
+                wall = time.time()
                 conn.send(msg)
+                if len(calls) > 1:
+                    self._stamp_batch(live, wall,
+                                      time.monotonic() - t_send,
+                                      len(calls))
                 with self._cond:
                     self.batches_sent += 1
                     self.calls_sent += len(calls)
@@ -298,6 +304,19 @@ class MuxClient:
                             c.queued = True
                             self._out.append(c)
                     self._cond.notify()
+
+    @staticmethod
+    def _stamp_batch(live, wall: float, dur: float, n: int) -> None:
+        """Wire-phase spans for calls riding a batched RpcBatch frame:
+        each riding call's trace gets one ``mux.batch_send`` child
+        covering the coalesced serialize+enqueue, so critical-path
+        attribution sees frame time the per-call rpc spans cannot."""
+        from ..common.tracer import default_tracer
+        tr = default_tracer()
+        for c in live:
+            if getattr(c.trace, "trace_id", None):
+                tr.complete("mux.batch_send", wall, dur, cat="mux",
+                            ctx=c.trace, batched_calls=n)
 
     def _conn_for_send(self) -> AsyncConnection | None:
         with self._cond:

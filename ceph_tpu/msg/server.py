@@ -168,6 +168,20 @@ class Dispatcher:
             return RpcResultBatch([one(c) for c in msg.calls])
         return one(msg)
 
+    @staticmethod
+    def _stamp_batch_reply(calls, wall: float, dur: float) -> None:
+        """Wire-phase spans for replies riding a batched RpcResultBatch
+        frame: each riding call's trace gets one ``mux.batch_reply``
+        child covering the coalesced reply serialize+enqueue (the send
+        the per-method ``rpc.*`` server spans end before)."""
+        from ..common.tracer import default_tracer
+        tr = default_tracer()
+        for c in calls:
+            ctx = getattr(c, "trace", None)
+            if getattr(ctx, "trace_id", None):
+                tr.complete("mux.batch_reply", wall, dur, cat="mux",
+                            ctx=ctx, batched_calls=len(calls))
+
     def _worker(self) -> None:
         from .. import net
         from .proto import RpcResultBatch
@@ -193,7 +207,12 @@ class Dispatcher:
             else:
                 reply = self.core._dispatch(conn, msg)
             try:
+                t0 = time.monotonic()
+                wall = time.time()
                 conn.send(reply)
+                if hasattr(msg, "calls"):
+                    self._stamp_batch_reply(msg.calls, wall,
+                                            time.monotonic() - t0)
             except (ConnectionError, OSError):
                 # link died (or an injected fault) before the reply got
                 # out: results are cached under their reqids — the

@@ -99,6 +99,31 @@ def scale_accumulate_host(mat: np.ndarray, data: np.ndarray,
     return out
 
 
+@functools.partial(jax.jit, static_argnames=("variant",))
+def _gf_inner_product(mat, data, variant):
+    """Regenerating-repair inner product: ``mat @ data`` over GF(2^8) in
+    one fused dispatch.  ``mat`` is a helper's projection row (1 x alpha)
+    or the newcomer's combine matrix (alpha x d); ``data`` is the stored
+    chunk's symbol rows (alpha x N) or the stacked helper beta-streams
+    (d x N).  Shapes are static per (rows, N), so every helper in a wave
+    shares one compilation."""
+    return rs_kernels.gf_apply(mat, data, variant)
+
+
+def gf_inner_product_device(mat, data, variant: str = "auto"):
+    """Device GF matrix-vector product for the product-matrix repair legs
+    (helper projection and newcomer combine) -> jax.Array [rows, N]."""
+    return _gf_inner_product(jnp.asarray(mat), jnp.asarray(data), variant)
+
+
+def gf_inner_product_host(mat: np.ndarray, data: np.ndarray) -> np.ndarray:
+    """Exact host sibling of :func:`gf_inner_product_device` (breaker
+    fallback and the no-pipeline path)."""
+    return gfref.apply_matrix_fast(
+        np.ascontiguousarray(mat, dtype=np.uint8),
+        np.ascontiguousarray(data, dtype=np.uint8))
+
+
 class RSCodec:
     """Systematic RS(k, m) over GF(2^8), poly 0x11D.
 

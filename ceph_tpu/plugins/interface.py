@@ -67,6 +67,23 @@ class ErasureCodeInterface(abc.ABC):
                                     available: Mapping[int, int]) -> set:
         """Like minimum_to_decode but with per-chunk retrieval costs (:326)."""
 
+    def supports_regenerating_repair(self) -> bool:
+        """True when the code repairs a single lost chunk from d helper
+        inner products (beta bytes each) instead of a k-chunk decode —
+        the capability probe recovery/regen.py plans against."""
+        return False
+
+    def minimum_to_repair(self, shard: int, d: int,
+                          costs: Mapping[int, int]) -> "set | list":
+        """Helper set for repairing ``shard`` given per-chunk retrieval
+        ``costs``.  Default: the cheapest decode set — non-regenerating
+        codes repair by decoding, so helper selection degenerates to
+        :meth:`minimum_to_decode_with_cost`.  Regenerating plugins
+        override to return exactly ``d`` ranked helpers (and that rank
+        order is the stream order their combine matrix expects)."""
+        avail = {c: v for c, v in costs.items() if c != shard}
+        return self.minimum_to_decode_with_cost({shard}, avail)
+
     @abc.abstractmethod
     def encode(self, want_to_encode: set, data: bytes) -> dict[int, np.ndarray]:
         """Split+pad ``data`` into k chunks, compute m parity chunks, return

@@ -1,0 +1,356 @@
+"""Regenerating-code repair (plugins/plugin_pm_regen.py +
+recovery/regen.py + the ECRegenRead/ECRegenHelper hop path): bitwise
+equivalence against centralized repair across MBR/MSR geometries,
+forced fallbacks (insufficient helpers, helper death mid-inner-product,
+rotten helper chunks), wire accounting, and the capability surface."""
+import numpy as np
+import pytest
+
+from ceph_tpu.cluster import MiniCluster
+from ceph_tpu.common import Context
+
+# every geometry names its own chunk size: MBR needs (k*c) % B == 0 on
+# top of the 128-lane alignment (B = k*d - k*(k-1)/2), MSR only lanes
+GEOMETRIES = [
+    # (mode, k, m, d, chunk)
+    ("mbr", 3, 2, 4, 384),       # B=9,  alpha=4, stored 512/chunk 384
+    ("mbr", 4, 2, 5, 896),       # B=14, alpha=5, stored 1280/chunk 896
+    ("msr", 2, 2, 2, 128),       # alpha=1: the degenerate MSR point
+    ("msr", 3, 2, 4, 128),       # alpha=2, d=2k-2
+]
+
+
+def _cluster(k, m, d, mode, chunk, enable=True, conf=None):
+    c = MiniCluster(n_osds=9, osds_per_host=3, chunk_size=chunk,
+                    cct=Context())
+    c.cct.conf.set("osd_recovery_regen_enable", enable)
+    for key, value in (conf or {}).items():
+        c.cct.conf.set(key, value)
+    c.enable_recovery_scheduler()
+    prof = {"plugin": "pm_regen", "k": str(k), "m": str(m), "d": str(d),
+            "mode": mode, "device": "numpy"}
+    pid = c.create_ec_pool("p", prof, pg_num=1)
+    g = next(iter(c.pools[pid]["pgs"].values()))
+    return c, pid, g
+
+
+def _write_degrade_revive(c, pid, g, k, chunk, n_objects, victims=1,
+                          seed=3):
+    """Write, kill ``victims`` shards, overwrite everything they miss,
+    revive, drain.  Returns the expected object contents."""
+    rng = np.random.default_rng(seed)
+    obj_bytes = 3 * chunk * k
+    data = {f"o{i}": rng.integers(0, 256, obj_bytes, np.uint8).tobytes()
+            for i in range(n_objects)}
+    for oid, d in data.items():
+        c.put(pid, oid, d)
+    vs = [g.acting[i + 1] for i in range(victims)]
+    for v in vs:
+        g.bus.mark_down(v)
+    for oid in list(data):
+        data[oid] = rng.integers(0, 256, obj_bytes, np.uint8).tobytes()
+        c.put(pid, oid, data[oid])
+    for v in vs:
+        g.bus.mark_up(v)
+    c.deliver_all()
+    return data
+
+
+def _perf(g):
+    return {x: g.backend.perf.get(x) for x in
+            ("recoveries", "recovery_failures", "regen_repairs",
+             "regen_objects", "regen_fallbacks")}
+
+
+def _shard_state(g, oids):
+    """Every shard's stored bytes + hinfo digest dict, for bitwise
+    comparison between repair arms."""
+    from ceph_tpu.backend.ecutil import HINFO_KEY
+    from ceph_tpu.backend.memstore import GObject
+    from ceph_tpu.backend.pg_backend import shard_store
+    out = {}
+    for oid in sorted(oids):
+        for s in g.acting:
+            st = shard_store(g.backend.bus, s)
+            obj = GObject(oid, s)
+            out[(oid, s)] = (st.read(obj, 0, None),
+                             st.getattr(obj, HINFO_KEY))
+    return out
+
+
+def _run_arm(mode, k, m, d, chunk, enable, n_objects=6):
+    c, pid, g = _cluster(k, m, d, mode, chunk, enable=enable)
+    try:
+        data = _write_degrade_revive(c, pid, g, k, chunk, n_objects)
+        assert not g.backend.stale
+        perf = _perf(g)
+        for oid, want in data.items():
+            assert c.get(pid, oid, len(want)) == want
+        assert c.scrub_pool(pid, repair=False) == {}
+        state = _shard_state(g, data)
+    finally:
+        c.shutdown()
+    return perf, state
+
+
+class TestRegenBitwiseEquivalence:
+    @pytest.mark.parametrize("mode,k,m,d,chunk", GEOMETRIES)
+    def test_regen_matches_centralized(self, mode, k, m, d, chunk):
+        """Regenerating repair must land byte-identical shard contents
+        AND hinfo digests vs the centralized verified wave — MBR
+        (non-systematic, expanded stored chunks) and MSR (systematic,
+        d = 2k-2) alike."""
+        regen_perf, regen_state = _run_arm(mode, k, m, d, chunk, True)
+        cent_perf, cent_state = _run_arm(mode, k, m, d, chunk, False)
+        assert regen_perf["regen_objects"] == 6
+        assert regen_perf["regen_fallbacks"] == 0
+        assert regen_perf["recovery_failures"] == 0
+        assert cent_perf["regen_objects"] == 0
+        assert regen_state == cent_state
+
+    def test_two_sequential_victims_each_regen(self):
+        """Two dead shards repair shard-at-a-time; whichever batch
+        arrives with a single missing chunk and d current helpers
+        regens, the overlap rides the verified per-object path — no
+        failures, clean scrub either way."""
+        c, pid, g = _cluster(3, 3, 4, "mbr", 384)
+        try:
+            data = _write_degrade_revive(c, pid, g, 3, 384, 4,
+                                         victims=2)
+            assert not g.backend.stale
+            perf = _perf(g)
+            assert perf["recovery_failures"] == 0
+            assert perf["regen_objects"] >= 4
+            assert perf["regen_fallbacks"] == 0
+            assert perf["recoveries"] == 8          # 4 oids x 2 shards
+            for oid, want in data.items():
+                assert c.get(pid, oid, len(want)) == want
+            assert c.scrub_pool(pid, repair=False) == {}
+        finally:
+            c.shutdown()
+
+
+class TestRegenFallbacks:
+    def test_insufficient_helpers_stays_centralized(self):
+        """Fewer than d current helpers: the planner must leave the
+        batch to the verified wave (never a short regen), and repair
+        still completes once the helper returns."""
+        c, pid, g = _cluster(3, 2, 4, "mbr", 384)
+        try:
+            rng = np.random.default_rng(3)
+            obj_bytes = 3 * 384 * 3
+            data = {f"o{i}": rng.integers(0, 256, obj_bytes,
+                                          np.uint8).tobytes()
+                    for i in range(4)}
+            for oid, d in data.items():
+                c.put(pid, oid, d)
+            victim = g.acting[1]
+            g.bus.mark_down(victim)
+            for oid in list(data):
+                data[oid] = rng.integers(0, 256, obj_bytes,
+                                         np.uint8).tobytes()
+                c.put(pid, oid, data[oid])
+            helper = g.acting[2]
+            g.bus.mark_down(helper)      # 3 current < d=4
+            g.bus.mark_up(victim)
+            c.deliver_all()
+            perf = _perf(g)
+            assert perf["regen_objects"] == 0
+            assert perf["recovery_failures"] == 0
+            g.bus.mark_up(helper)
+            c.deliver_all()
+            assert not g.backend.stale
+            for oid, want in data.items():
+                assert c.get(pid, oid, len(want)) == want
+            assert c.scrub_pool(pid, repair=False) == {}
+        finally:
+            c.shutdown()
+
+    def test_disabled_option_never_plans(self):
+        perf, _state = _run_arm("mbr", 3, 2, 4, 384, False, n_objects=4)
+        assert perf["regen_repairs"] == 0
+        assert perf["regen_objects"] == 0
+        assert perf["recovery_failures"] == 0
+        assert perf["recoveries"] == 4
+
+    def test_helper_death_mid_inner_product_falls_back(self):
+        """Kill a helper the moment its projection leg arrives: no
+        stream, no abort — only the bus down event.  The coordinator's
+        down listener pops the repair (the helper is in hop_shards) and
+        every object re-drives through the verified path — zero
+        acked-write loss, fault stamped in the campaign log."""
+        from ceph_tpu.failure import FaultInjector, FaultPlan
+        c, pid, g = _cluster(3, 2, 4, "mbr", 384)
+        inj = FaultInjector(FaultPlan(seed=11))
+        try:
+            killed = []
+            for s in g.acting[1:]:
+                h = g.bus.handlers.get(s)
+                shard_obj = getattr(h, "local_shard", h)
+                orig = shard_obj._regen_helper_leg
+
+                def hook(msg, _o=orig, _s=shard_obj):
+                    if not killed:
+                        killed.append(_s.shard)
+                        inj.record("regen", "helper_blackhole",
+                                   target=_s.shard)
+                        g.bus.mark_down(_s.shard)
+                    else:
+                        _o(msg)
+                shard_obj._regen_helper_leg = hook
+            data = _write_degrade_revive(c, pid, g, 3, 384, 4)
+            assert len(killed) == 1
+            g.bus.mark_up(killed[0])
+            c.deliver_all()
+            assert not g.backend.stale
+            perf = _perf(g)
+            assert perf["regen_fallbacks"] >= 1
+            assert perf["recovery_failures"] == 0
+            for oid, want in data.items():          # zero acked loss
+                assert c.get(pid, oid, len(want)) == want
+            assert c.scrub_pool(pid, repair=False) == {}
+            assert inj.summary()["planes"]["regen"][
+                "helper_blackhole"] == 1
+        finally:
+            c.shutdown()
+
+    def test_rotten_helper_chunk_aborts_and_heals(self):
+        """Corrupt a surviving chunk without touching its hinfo: the
+        helper leg's crc-vs-plan-hinfo check must abort the regen
+        (never launder rot into an inner product), and the centralized
+        fallback both routes around AND rebuilds the rotten source —
+        a verifying scrub comes back clean."""
+        from ceph_tpu.backend.memstore import GObject, Transaction
+        from ceph_tpu.backend.pg_backend import shard_store
+        c, pid, g = _cluster(3, 2, 4, "mbr", 384)
+        try:
+            rng = np.random.default_rng(5)
+            obj_bytes = 3 * 384 * 3
+            victim = g.acting[1]
+            g.bus.mark_down(victim)
+            data = {f"o{i}": rng.integers(0, 256, obj_bytes,
+                                          np.uint8).tobytes()
+                    for i in range(4)}
+            for oid, d in data.items():
+                c.put(pid, oid, d)
+            # with one chunk lost and d = n-1 = 4, EVERY survivor is a
+            # helper: any rotten survivor lands in the plan
+            s = g.acting[2]
+            st = shard_store(g.bus, s)
+            obj = GObject("o0", s)
+            rot = bytes(b ^ 0xFF for b in st.read(obj, 0, None))
+            st.queue_transaction(Transaction().write(obj, 0, rot))
+            g.bus.mark_up(victim)
+            c.deliver_all()
+            assert not g.backend.stale
+            perf = _perf(g)
+            assert perf["regen_fallbacks"] >= 1
+            assert perf["recovery_failures"] == 0
+            assert not g.backend.inconsistent_objects
+            for oid, want in data.items():
+                assert c.get(pid, oid, len(want)) == want
+            assert c.scrub_pool(pid, repair=False) == {}
+        finally:
+            c.shutdown()
+
+
+class TestRegenWire:
+    def test_regen_legs_account_to_recovery_class(self):
+        """Every regen leg is charged ONCE, to the recovery op class;
+        the helper beta-streams stay near the d*beta floor and the
+        class partition invariant survives the new types."""
+        c, pid, g = _cluster(3, 2, 4, "mbr", 1536)
+        try:
+            before_cls = c.wire.class_bytes()["recovery"]
+            data = _write_degrade_revive(c, pid, g, 3, 1536, 6)
+            assert _perf(g)["regen_objects"] == 6
+            per_type = c.wire.per_type()
+            assert per_type["ECRegenRead"]["tx_msgs"] >= 5   # 1+d legs
+            assert per_type["ECRegenHelper"]["tx_bytes"] > 0
+            regen_bytes = sum(per_type[t]["tx_bytes"] for t in
+                              ("ECRegenRead", "ECRegenHelper"))
+            delta = c.wire.class_bytes()["recovery"] - before_cls
+            assert delta >= regen_bytes
+            # MBR repairs at ~1.0 B/B: stored chunk is alpha*N bytes,
+            # each of d helpers ships N; total wire must stay under the
+            # centralized floor of k stored chunks per loss
+            ec = g.backend.ec_impl
+            stored = ec.get_stored_chunk_size(1536)
+            repaired = 3 * stored * len(data)
+            assert delta / repaired < 1.5
+            totals = c.wire.totals()
+            assert sum(c.wire.class_bytes().values()) == \
+                totals["tx_bytes"] + totals["rx_bytes"]
+        finally:
+            c.shutdown()
+
+    def test_helper_sizer_is_payload_proportional(self):
+        from ceph_tpu.backend.messages import ECRegenHelper, ECRegenRead
+        from ceph_tpu.common.wire_accounting import wire_size
+        small = wire_size(ECRegenHelper(0, 1, 0, 2,
+                                        streams={"o": b"x" * 64}))
+        big = wire_size(ECRegenHelper(0, 1, 0, 2,
+                                      streams={"o": b"x" * 4096}))
+        assert big - small >= 4096 - 64
+        prime = wire_size(ECRegenRead(0, 1, 0, 1, 2, sub_count=4,
+                                      combine=b"c" * 16,
+                                      helpers=[0, 2, 3, 4],
+                                      oids=["o"], lengths=[512],
+                                      versions=[1]))
+        assert prime > 16
+
+
+class TestCapabilitySurface:
+    def test_non_regenerating_plugins_default_off(self):
+        """jax_rs (and anything else not overriding the capability)
+        reports no regenerating repair, and minimum_to_repair delegates
+        to the cost-aware decode minimum."""
+        from ceph_tpu.plugins.registry import ErasureCodePluginRegistry
+        ec = ErasureCodePluginRegistry.instance().factory(
+            "jax_rs", "", {"k": "4", "m": "2", "device": "numpy"})
+        assert ec.supports_regenerating_repair() is False
+        costs = {0: 1, 1: 1, 2: 1, 3: 1, 4: 3, 5: 3}
+        got = ec.minimum_to_repair(0, 4, costs)
+        assert got == ec.minimum_to_decode_with_cost(
+            {0}, {c: v for c, v in costs.items() if c != 0})
+
+    def test_pm_regen_selects_d_cheapest_helpers(self):
+        from ceph_tpu.plugins.registry import ErasureCodePluginRegistry
+        ec = ErasureCodePluginRegistry.instance().factory(
+            "pm_regen", "", {"k": "3", "m": "2", "d": "4",
+                             "mode": "mbr", "device": "numpy"})
+        assert ec.supports_regenerating_repair() is True
+        costs = {1: 1, 2: 3, 3: 1, 4: 1}
+        helpers = ec.minimum_to_repair(0, 4, costs)
+        assert sorted(helpers) == [1, 2, 3, 4]
+        # with a spare survivor, the expensive one is left out
+        costs = {1: 1, 2: 3, 3: 1, 4: 1}
+        ec5 = ErasureCodePluginRegistry.instance().factory(
+            "pm_regen", "", {"k": "2", "m": "2", "d": "2",
+                             "mode": "msr", "device": "numpy"})
+        helpers = ec5.minimum_to_repair(0, 2, costs)
+        assert len(helpers) == 2 and 2 not in helpers
+        with pytest.raises(IOError):
+            ec.minimum_to_repair(0, 4, {1: 1, 2: 1, 3: 1})
+
+    def test_regen_spans_are_phase_declared(self):
+        from ceph_tpu.common import critpath
+        for name in ("recovery.regen", "recovery.regen_hop",
+                     "mux.batch_send", "mux.batch_reply"):
+            assert critpath.is_declared(name), name
+        assert critpath.phase_for("mux.batch_send") == critpath.WIRE
+        assert critpath.phase_for("recovery.regen") == critpath.DISPATCH
+
+
+def test_regen_module_is_queue_guard_scanned():
+    """Satellite guard coverage: the unbounded-queue AST scan must walk
+    recovery/regen.py (it rglobs ceph_tpu/recovery)."""
+    import pathlib
+    import test_no_unbounded_queue as guard
+    scanned = {p.name for p in guard._scan_files()} \
+        if hasattr(guard, "_scan_files") else None
+    if scanned is None:
+        root = pathlib.Path(guard.__file__).resolve().parent.parent
+        assert (root / "ceph_tpu" / "recovery" / "regen.py").exists()
+    else:
+        assert "regen.py" in scanned

@@ -81,6 +81,18 @@ METRIC_PATHS = {
         ("recovery", "chain", "newcomer_ingress_per_byte"), False),
     "recovery.chain.speedup_vs_centralized": (
         ("recovery", "chain", "speedup_vs_centralized"), True),
+    # regenerating-code repair (ISSUE 17): total recovery wire per
+    # stored byte repaired on a pm_regen pool, diffed like the rest AND
+    # capped absolutely (METRIC_LIMITS) — MBR claims ~1.0 B/B, under
+    # every decode-based repair's k-transfer floor; MSR claims d/alpha
+    "recovery.regen.mbr.mib_s": (
+        ("recovery", "regen", "mbr", "mib_s"), True),
+    "recovery.regen.mbr.wire_per_byte": (
+        ("recovery", "regen", "mbr", "wire_per_byte"), False),
+    "recovery.regen.mbr.wire_reduction": (
+        ("recovery", "regen", "mbr", "wire_reduction"), True),
+    "recovery.regen.msr.wire_per_byte": (
+        ("recovery", "regen", "msr", "wire_per_byte"), False),
     # async messenger (ISSUE 14): 10k logical closed-loop clients over
     # few connections — clean-capacity goodput and p99, plus goodput
     # while the overload arm sheds by class.  `clients` is held to an
@@ -110,6 +122,11 @@ METRIC_PATHS = {
 # jitter between the two back-to-back passes).
 METRIC_LIMITS = {
     "recovery.chain.newcomer_ingress_per_byte": (1.5, "max"),
+    # the ISSUE 17 criteria: MBR total wire at or under 1.5x the stored
+    # bytes repaired (the ~1 B/B claim with control-leg headroom), and
+    # any regenerating pool under the 4.0 ceiling
+    "recovery.regen.mbr.wire_per_byte": (1.5, "max"),
+    "recovery.regen.msr.wire_per_byte": (4.0, "max"),
     "recovery.chain.coordinator_ingress_per_byte": (0.5, "max"),
     "recovery.chain.wire_per_byte": (4.6, "max"),
     "recovery.chain.speedup_vs_centralized": (0.95, "min"),
@@ -145,6 +162,11 @@ METRIC_THRESHOLDS = {"efficiency.pct_of_peak": 0.30,
                      # a ratio of two wall-clock arms: gate cliffs only
                      # (the absolute floor in METRIC_LIMITS still holds)
                      "recovery.chain.speedup_vs_centralized": 0.30,
+                     # wall-clock repair throughput and an arm ratio on
+                     # a possibly-shared host: gate cliffs only (the
+                     # wire caps above carry the real claims)
+                     "recovery.regen.mbr.mib_s": 0.30,
+                     "recovery.regen.mbr.wire_reduction": 0.30,
                      # socket wall-clock at 10k concurrency on a shared
                      # host: gate cliffs, not scheduler jitter
                      "serving.async.ops_s": 0.30,
@@ -174,6 +196,10 @@ _BLOCK_DEVICE = {
     "recovery.chain.coordinator_ingress_per_byte": ("recovery", "device"),
     "recovery.chain.newcomer_ingress_per_byte": ("recovery", "device"),
     "recovery.chain.speedup_vs_centralized": ("recovery", "device"),
+    "recovery.regen.mbr.mib_s": ("recovery", "device"),
+    "recovery.regen.mbr.wire_per_byte": ("recovery", "device"),
+    "recovery.regen.mbr.wire_reduction": ("recovery", "device"),
+    "recovery.regen.msr.wire_per_byte": ("recovery", "device"),
     "serving.async.ops_s": ("serving", "device"),
     "serving.async.p99_ms": ("serving", "device"),
     "serving.async.clients": ("serving", "device"),
