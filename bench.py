@@ -301,6 +301,7 @@ def cpu_baseline(data, k, m, erasures):
 _emit_lock = threading.Lock()
 _emitted = False
 _SERVING: dict | None = None     # the serving-engine comparison block
+_OBSERVABILITY: dict | None = None  # instruments on/off overhead block
 _RECOVERY: dict | None = None    # the repair-throughput comparison block
 _PIPELINE: dict | None = None    # the async-pipeline comparison block
 _EFFICIENCY: dict | None = None  # the roofline device-efficiency block
@@ -799,6 +800,44 @@ def serving_section(platform: str | None) -> dict:
         return {"device": "none", "error": repr(e)[:200]}
 
 
+def observability_section(platform: str | None) -> dict:
+    """The instrumentation-tax block (`observability`): the serving.async
+    mux workload with full instruments vs the ``instruments_enabled``
+    kill-switch — reporting both arms' goodput/p99 and the overhead
+    percentage the perf gate caps absolutely (ISSUE 18).  The A/B runs
+    as paired on/off CPU-time segments against ONE warmed server
+    (tools.rados_bench.run_mux_overhead_bench), overhead = median of the
+    per-round paired deltas: wall-clock goodput on a shared host swings
+    2x run-to-run from scheduler noise and per-process setup, and that
+    noise must not masquerade as instrument tax."""
+    try:
+        from ceph_tpu.common.tracer import default_tracer
+        from tools.rados_bench import run_mux_overhead_bench
+        with phase("observability"):
+            ab = run_mux_overhead_bench()
+        on = ab["instruments_on"]
+        off = ab["instruments_off"]
+        res = {
+            "device": "tpu" if platform == "tpu" else "cpu",
+            "sample_rate": default_tracer().sample_rate,
+            "overhead_pct": ab["overhead_pct"],
+            "rounds": ab["rounds"],
+            "deltas_pct": ab["deltas_pct"],
+            "instruments_on": dict(on),
+            "instruments_off": dict(off),
+            "p99_delta_ms": round(on["p99_ms"] - off["p99_ms"], 3),
+        }
+        print(f"# observability: instruments on {on['cpu_us_per_op']:.1f} "
+              f"us/op CPU ({on['ops_s']:.0f} ops/s) vs off "
+              f"{off['cpu_us_per_op']:.1f} us/op ({off['ops_s']:.0f} ops/s)"
+              f" -> {res['overhead_pct']:.1f}% overhead at sample_rate "
+              f"{res['sample_rate']}", file=sys.stderr)
+        return res
+    except Exception as e:                 # never fail the artifact
+        print(f"# observability bench failed: {e!r}", file=sys.stderr)
+        return {"device": "none", "error": repr(e)[:200]}
+
+
 def _resilience_cluster_pass(device: str, faulted: bool,
                              n_objects: int = 24) -> dict:
     """One put+verify-get pass over a MiniCluster — clean, or under a
@@ -1043,6 +1082,8 @@ def emit(value, vs_baseline, extra):
     line.update(extra)
     if _SERVING is not None:
         line.setdefault("serving", _SERVING)
+    if _OBSERVABILITY is not None:
+        line.setdefault("observability", _OBSERVABILITY)
     if _RECOVERY is not None:
         line.setdefault("recovery", _RECOVERY)
     if _PIPELINE is not None:
@@ -1251,12 +1292,15 @@ def main() -> int:
     # serving comparison (coalesced vs op-at-a-time) on whatever device
     # is up — its own subsystem, measured before the device codec pass so
     # a tunnel death mid-codec still leaves the serving block in the line
-    global _SERVING, _RECOVERY, _PIPELINE, _EFFICIENCY, _RESILIENCE, \
-        _SLO, _LINT
+    global _SERVING, _OBSERVABILITY, _RECOVERY, _PIPELINE, _EFFICIENCY, \
+        _RESILIENCE, _SLO, _LINT
     # static-analysis trajectory first: pure AST work, no device needed,
     # so even a probe/tunnel death right after still carries the block
     _LINT = lint_section()
     _SERVING = serving_section(platform)
+    # instrumentation tax (instruments on vs off over the same mux
+    # workload) right after the serving block it compares against
+    _OBSERVABILITY = observability_section(platform)
     # repair-throughput comparison (batched waves vs per-object) on the
     # same device — like serving, measured before the codec pass so a
     # tunnel death mid-codec still leaves the block in the line

@@ -34,4 +34,5 @@ from .engine import (Finding, ProjectIndex, Rule, all_rules,  # noqa: F401
 from . import rules_guards  # noqa: F401,E402
 from . import rules_jax  # noqa: F401,E402
 from . import rules_locks  # noqa: F401,E402
+from . import rules_observability  # noqa: F401,E402
 from . import rules_threads  # noqa: F401,E402

@@ -22,6 +22,14 @@ class Context:
         self.log = Log(self.conf)
         self.perf = PerfCountersCollection()
         self.admin_socket = AdminSocket()
+        # observability fast path (ISSUE 18): adopt the kill-switch and
+        # the tracer's sampling/slow-promotion knobs from this conf and
+        # follow live updates.  Both targets are process-wide (there is
+        # ONE default tracer), matching the reference's md_config
+        # observers feeding process singletons.
+        from . import instruments
+        instruments.wire_config(self.conf)
+        tracer_mod.wire_config(self.conf)
         # the process-wide jit telemetry collection: shared by every
         # Context so any `perf dump` / prometheus render carries it
         self.perf.add(tracer_mod.jit_perf_counters())

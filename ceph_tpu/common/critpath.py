@@ -60,7 +60,7 @@ TRACE_CAPACITY_HINT = int(os.environ.get("CEPH_TPU_TRACE_CAPACITY",
 
 try:
     from .device_attribution import canonical_owner
-    from .percentile import nearest_rank
+    from .percentile import nearest_rank, weighted_nearest_rank
 except ImportError:
     # loaded standalone by PATH (tools/slo_report.py on a raw trace
     # dump): pull the two stdlib-only siblings the same way
@@ -75,7 +75,9 @@ except ImportError:
         spec.loader.exec_module(mod)
         return mod
     canonical_owner = _sibling("device_attribution").canonical_owner
-    nearest_rank = _sibling("percentile").nearest_rank
+    _pct = _sibling("percentile")
+    nearest_rank = _pct.nearest_rank
+    weighted_nearest_rank = _pct.weighted_nearest_rank
 
 # -- the canonical phase taxonomy -------------------------------------------
 
@@ -295,11 +297,21 @@ def decompose(spans: list[dict], unmapped: dict | None = None
             or e.get("args", {}).get("owner")
         if op_class:
             break
+    # sample weight: head-sampled traces stamp 1/rate on their events
+    # (tracer ISSUE 18); the trace's weight de-biases downstream rate
+    # math (SLO windows, class percentiles).  Promoted slow ops carry no
+    # weight — they represent only themselves.
+    w = 1.0
+    for e in spans:
+        sw = e.get("args", {}).get("sample_weight")
+        if sw:
+            w = max(w, float(sw))
     return {
         "total_s": total_us / 1e6,
         "phases": phases,
         "n_spans": len(spans),
         "op_class": canonical_owner(op_class),
+        "w": w,
         "start_ts_us": min(float(e["ts"]) for e in spans),
         "end_ts_us": max(_interval(e)[1] for e in spans),
     }
@@ -406,7 +418,7 @@ class CritPathLedger:
                 t = tracer._t0 + rec["end_ts_us"] / 1e6
                 if seen is None:
                     record = self.ingest(rec["op_class"], rec["total_s"],
-                                         rec["phases"], t=t)
+                                         rec["phases"], t=t, w=rec["w"])
                     with self._lock:
                         if len(self._seen_order) == \
                                 self._seen_order.maxlen:
@@ -428,16 +440,20 @@ class CritPathLedger:
         with self._lock:
             old = seen["rec"]
             cls = seen["cls"]
+            old_w = old.get("w", 1.0)
+            new_w = float(rec.get("w", old_w))
             acc = self._phase_seconds[cls]
             for p in PHASES:
-                acc[p] += float(rec["phases"].get(p, 0.0)) \
-                    - old["phases"][p]
+                acc[p] += float(rec["phases"].get(p, 0.0)) * new_w \
+                    - old["phases"][p] * old_w
             self._totals[cls]["total_s"] += \
-                float(rec["total_s"]) - old["total_s"]
+                float(rec["total_s"]) * new_w - old["total_s"] * old_w
+            self._totals[cls]["ops"] += new_w - old_w
             old["t"] = t
             old["total_s"] = float(rec["total_s"])
             old["phases"] = {p: float(rec["phases"].get(p, 0.0))
                              for p in PHASES}
+            old["w"] = new_w
             seen["n"] = n
             # a late-closing root can carry an EARLIER start than the
             # spans the first fold saw: track the true front so the
@@ -445,14 +461,19 @@ class CritPathLedger:
             seen["start_us"] = min(seen["start_us"], rec["start_ts_us"])
 
     def ingest(self, op_class: str, total_s: float, phases: dict,
-               t: float | None = None) -> dict:
+               t: float | None = None, w: float = 1.0) -> dict:
         """Fold one op record directly (refresh()'s sink; also the
-        synthetic-record entry tests and tools use).  Returns the
-        record dict (refresh keeps it for in-place amendment)."""
+        synthetic-record entry tests and tools use).  ``w`` is the
+        record's sample weight (1/rate for head-sampled traces): the
+        cumulative accumulators scale by it so rates stay unbiased.
+        Returns the record dict (refresh keeps it for in-place
+        amendment)."""
         t = time.perf_counter() if t is None else t
+        w = float(w) if w and w > 0 else 1.0
         record = {"t": t, "total_s": float(total_s),
                   "phases": {p: float(phases.get(p, 0.0))
-                             for p in PHASES}}
+                             for p in PHASES},
+                  "w": w}
         with self._lock:
             dq = self._records.get(op_class)
             if dq is None:
@@ -462,9 +483,9 @@ class CritPathLedger:
             dq.append(record)
             acc = self._phase_seconds[op_class]
             for p in PHASES:
-                acc[p] += record["phases"][p]
-            self._totals[op_class]["ops"] += 1
-            self._totals[op_class]["total_s"] += record["total_s"]
+                acc[p] += record["phases"][p] * w
+            self._totals[op_class]["ops"] += w
+            self._totals[op_class]["total_s"] += record["total_s"] * w
             self.folded += 1
         return record
 
@@ -495,17 +516,21 @@ class CritPathLedger:
         recs = self.records(op_class)
         if not recs:
             return None
-        totals = sorted(r["total_s"] for r in recs)
+        pairs = sorted((r["total_s"], r.get("w", 1.0)) for r in recs)
+        wsum = sum(w for _v, w in pairs)
         agg = dict.fromkeys(PHASES, 0.0)
         for r in recs:
+            rw = r.get("w", 1.0)
             for p in PHASES:
-                agg[p] += r["phases"][p]
+                agg[p] += r["phases"][p] * rw
         whole = sum(agg.values())
         return {
             "ops": len(recs),
-            "p50_ms": round(nearest_rank(totals, 50) * 1e3, 3),
-            "p99_ms": round(nearest_rank(totals, 99) * 1e3, 3),
-            "mean_ms": round(sum(totals) / len(totals) * 1e3, 3),
+            "weighted_ops": round(wsum, 1),
+            "p50_ms": round(weighted_nearest_rank(pairs, 50) * 1e3, 3),
+            "p99_ms": round(weighted_nearest_rank(pairs, 99) * 1e3, 3),
+            "mean_ms": round(sum(v * w for v, w in pairs) / wsum * 1e3, 3)
+            if wsum else 0.0,
             "phase_ms": {p: round(agg[p] * 1e3, 3) for p in PHASES},
             "phases": {p: round(agg[p] / whole, 4) if whole else 0.0
                        for p in PHASES},

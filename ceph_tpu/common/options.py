@@ -161,6 +161,36 @@ OPTIONS: list[Option] = [
            description="ops slower than this many seconds are slow ops "
                        "(flagged in dumps, counted on slow_ops)",
            min=0.0),
+    # -- observability fast path (common/instruments.py, tracer sampling) --
+    Option("instruments_enabled", TYPE_BOOL, LEVEL_ADVANCED, default=True,
+           description="master kill-switch for the hot-path instruments "
+                       "(tracer spans/instants/completes, wire "
+                       "accounting, rpc latency observation): off turns "
+                       "them into cheap no-op guards so the "
+                       "observability.overhead bench can measure the "
+                       "full-instrumentation tax; health checks and "
+                       "perf-counter math keep working either way",
+           see_also=["tracer_sample_rate"]),
+    Option("tracer_sample_rate", TYPE_FLOAT, LEVEL_ADVANCED, default=1.0,
+           min=0.0, max=1.0,
+           description="head-based per-trace sampling rate: the decision "
+                       "is made ONCE when the root TraceContext is "
+                       "created (client/objecter.py, msg/client.py) and "
+                       "rides the context across daemons so a whole "
+                       "distributed trace samples atomically; unsampled "
+                       "ops keep a micro-record and are promoted into "
+                       "the ring when they cross osd_op_complaint_time, "
+                       "and sampled events carry 1/rate weights so "
+                       "trace_report/critpath/SLO rate math stays "
+                       "unbiased",
+           see_also=["instruments_enabled", "osd_op_complaint_time"]),
+    Option("mgr_device_refresh_ttl", TYPE_FLOAT, LEVEL_ADVANCED,
+           default=5.0, min=0.0,
+           description="seconds a prometheus scrape reuses the last "
+                       "device-telemetry snapshot before re-probing JAX "
+                       "backend state (0 = refresh every render); a "
+                       "tight scrape loop stops re-snapshotting live "
+                       "device memory stats every second"),
     Option("mon_osd_min_down_reporters", TYPE_UINT, LEVEL_ADVANCED,
            default=2, description="failure reports needed to mark down"),
     Option("mon_osd_min_up_ratio", TYPE_FLOAT, LEVEL_ADVANCED, default=0.3,

@@ -36,3 +36,23 @@ def nearest_rank(sorted_vals, q: float) -> float:
 def percentile(values, q: float) -> float:
     """Convenience over an UNSORTED sequence (sorts a copy)."""
     return nearest_rank(sorted(values), q)
+
+
+def weighted_nearest_rank(sorted_pairs, q: float) -> float:
+    """Nearest-rank percentile over PRE-SORTED ``(value, weight)`` pairs
+    (q in [0, 100]).  Each observation stands for ``weight`` ops (the
+    tracer's head-sampling 1/rate de-bias): the rank walks cumulative
+    weight instead of cumulative count, and with all weights 1.0 the
+    result matches :func:`nearest_rank` exactly."""
+    if not sorted_pairs:
+        return 0.0
+    total = sum(w for _v, w in sorted_pairs)
+    if total <= 0.0:
+        return 0.0
+    target = max(q, 1e-12) / 100.0 * total
+    acc = 0.0
+    for v, w in sorted_pairs:
+        acc += w
+        if acc >= target - 1e-9:
+            return v
+    return sorted_pairs[-1][0]

@@ -33,22 +33,92 @@ class _Metric:
 
 
 class PerfCounters:
-    """One subsystem's counters (e.g. 'osd', 'ec_backend')."""
+    """One subsystem's counters (e.g. 'osd', 'ec_backend').
+
+    Monotonic accumulation (``inc`` on counter/avg kinds, ``tinc``,
+    ``hinc``) shards into per-thread cells: the owning thread mutates
+    its cell without the lock (single writer + GIL), and read surfaces
+    (:meth:`get`, :meth:`dump`) fold base + cells under the lock.  This
+    removes the instrument-lock contention class on reactor/worker hot
+    paths (ISSUE 18) without changing any dump shape.  Gauges keep the
+    locked base path: ``set``/``dec`` (and ``inc`` on a plain u64) are
+    read-modify-write on one authoritative value, which a shard cannot
+    provide — and they are control-plane-rate, not per-op-rate."""
 
     def __init__(self, name: str):
         self.name = name
         self._metrics: dict[str, _Metric] = {}
         self._lock = threading.Lock()
+        self._local = threading.local()
+        # thread ident -> that thread's {key: [value, sum, count,
+        # bucket_counts|None]} cells.  Registered under _lock; folded
+        # (non-destructively) by readers under _lock.
+        self._cells: dict[int, dict] = {}
+
+    # -- per-thread cells ---------------------------------------------------
+
+    def _cell(self, key: str) -> list:
+        cells = getattr(self._local, "cells", None)
+        if cells is None:
+            cells = self._local.cells = {}
+            ident = threading.get_ident()
+            with self._lock:
+                old = self._cells.get(ident)
+                if old is not None:
+                    # a dead thread's ident was reused: bank its deltas
+                    # into the base before the new owner takes the slot
+                    self._absorb_locked(old)
+                self._cells[ident] = cells
+        c = cells.get(key)
+        if c is None:
+            c = cells[key] = [0, 0.0, 0, None]
+        return c
+
+    def _absorb_locked(self, cells: dict) -> None:
+        """Fold one thread's cell deltas into the base metrics and zero
+        them (under ``self._lock``, for a cell map whose owner is gone)."""
+        for key, c in cells.items():
+            m = self._metrics.get(key)
+            if m is None:
+                continue
+            m.value += c[0]
+            m.sum += c[1]
+            m.count += c[2]
+            if c[3] is not None:
+                for i, n in enumerate(c[3]):
+                    m.bucket_counts[i] += n
+            cells[key] = [0, 0.0, 0, None]
+
+    def _folded_locked(self, m: _Metric, key: str):
+        """(value, sum, count, bucket_counts) with every live cell's
+        deltas folded in — read-only, under ``self._lock``."""
+        value, total, count = m.value, m.sum, m.count
+        bc = list(m.bucket_counts) if m.bucket_counts else []
+        for cells in self._cells.values():
+            c = cells.get(key)
+            if c is None:
+                continue
+            value += c[0]
+            total += c[1]
+            count += c[2]
+            if c[3] is not None:
+                for i, n in enumerate(c[3]):
+                    bc[i] += n
+        return value, total, count, bc
 
     # -- updates -----------------------------------------------------------
 
     def inc(self, key: str, amount: int = 1) -> None:
-        with self._lock:
-            m = self._metrics[key]
-            if m.kind == PERFCOUNTER_AVG:
-                m.sum += amount
-                m.count += 1
-            else:
+        m = self._metrics[key]
+        if m.kind == PERFCOUNTER_AVG:
+            c = self._cell(key)
+            c[1] += amount
+            c[2] += 1
+        elif m.kind == PERFCOUNTER_COUNTER:
+            self._cell(key)[0] += amount
+        else:
+            # plain u64 gauges share the locked path with set/dec
+            with self._lock:
                 m.value += amount
 
     def dec(self, key: str, amount: int = 1) -> None:
@@ -60,28 +130,30 @@ class PerfCounters:
             self._metrics[key].value = value
 
     def get(self, key: str) -> float:
-        """Current value of a plain counter/gauge."""
+        """Current value of a plain counter/gauge (cell deltas folded)."""
         with self._lock:
-            return self._metrics[key].value
+            m = self._metrics[key]
+            return self._folded_locked(m, key)[0]
 
     def tinc(self, key: str, seconds: float) -> None:
         """Add one timed sample (the reference's utime_t tinc)."""
-        with self._lock:
-            m = self._metrics[key]
-            m.sum += seconds
-            m.count += 1
+        c = self._cell(key)
+        c[1] += seconds
+        c[2] += 1
 
     def hinc(self, key: str, value: float) -> None:
-        with self._lock:
-            m = self._metrics[key]
-            for i, bound in enumerate(m.buckets):
-                if value <= bound:
-                    m.bucket_counts[i] += 1
-                    break
-            else:
-                m.bucket_counts[-1] += 1
-            m.sum += value
-            m.count += 1
+        m = self._metrics[key]
+        c = self._cell(key)
+        if c[3] is None:
+            c[3] = [0] * (len(m.buckets) + 1)
+        for i, bound in enumerate(m.buckets):
+            if value <= bound:
+                c[3][i] += 1
+                break
+        else:
+            c[3][-1] += 1
+        c[1] += value
+        c[2] += 1
 
     class _Timer:
         def __init__(self, pc, key):
@@ -104,19 +176,20 @@ class PerfCounters:
         out = {}
         with self._lock:
             for key, m in self._metrics.items():
+                value, total, count, bc = self._folded_locked(m, key)
                 if m.kind in (PERFCOUNTER_AVG, PERFCOUNTER_TIME_AVG):
-                    entry = {"avgcount": m.count, "sum": m.sum}
-                    if m.count:
+                    entry = {"avgcount": count, "sum": total}
+                    if count:
                         entry["avgtime" if m.kind == PERFCOUNTER_TIME_AVG
-                              else "avgvalue"] = m.sum / m.count
+                              else "avgvalue"] = total / count
                     out[key] = entry
                 elif m.kind == PERFCOUNTER_HISTOGRAM:
-                    out[key] = {"sum": m.sum, "count": m.count,
+                    out[key] = {"sum": total, "count": count,
                                 "buckets": dict(zip(
                                     [str(b) for b in m.buckets] + ["inf"],
-                                    m.bucket_counts))}
+                                    bc))}
                 else:
-                    out[key] = m.value
+                    out[key] = value
         return out
 
 

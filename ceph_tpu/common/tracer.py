@@ -37,13 +37,26 @@ import os
 import random
 import threading
 import time
+from bisect import bisect_left
 from collections import deque
 from dataclasses import dataclass
+
+from . import instruments
 
 # log-spaced span-latency bounds (seconds); one overflow bucket follows
 LATENCY_BUCKETS_S = (1e-6, 1e-5, 1e-4, 1e-3, 1e-2, 1e-1, 1.0, 10.0)
 
 TRACE_CAPACITY = int(os.environ.get("CEPH_TPU_TRACE_CAPACITY", 16384))
+
+# finished events buffered per thread before the batch folds into the
+# shared ring: the owning thread touches the ring lock once per batch
+# (or at an explicit completion-boundary flush()) instead of per span —
+# the reactor-thread contention class behind the PR 15 races
+FLUSH_BATCH = 64
+
+# unsampled-trace micro-records kept for slow-op promotion (one small
+# dict entry per in-flight unsampled op; FIFO eviction past the bound)
+MICRO_CAPACITY = 4096
 
 # process-wide id allocators: ids must stay unique across every Tracer
 # instance (cross-daemon stitching joins on them).  The high word is a
@@ -62,13 +75,23 @@ class TraceContext:
     under the caller's (trace id + parent span id) and to attribute the
     work to an owner class (client/serving/recovery/scrub/rebalance).
     Picklable on purpose — net.py RPC frames and wire-mode bus envelopes
-    serialize it."""
+    serialize it.
+
+    ``sampled``/``weight`` are the head-based sampling decision, made
+    ONCE at :meth:`Tracer.new_trace` and carried here so the whole
+    distributed trace samples atomically across daemons: an unsampled
+    context suppresses every span it touches (locally and remotely)
+    except slow-op promotions, and a sampled one stamps its 1/rate
+    weight on every event so downstream rate math stays unbiased."""
     trace_id: int
     span_id: int          # the span new children hang under (0 = root)
     op_class: str = "client"
+    sampled: bool = True
+    weight: float = 1.0   # 1/sample_rate, decided at the root
 
     def child_of(self, span_id: int) -> "TraceContext":
-        return TraceContext(self.trace_id, span_id, self.op_class)
+        return TraceContext(self.trace_id, span_id, self.op_class,
+                            self.sampled, self.weight)
 
 
 class _Activation:
@@ -102,27 +125,21 @@ class Span:
     valid after ``__exit__``; the Chrome event is emitted on exit so the
     ring buffer holds only finished spans."""
 
-    __slots__ = ("tracer", "name", "cat", "args", "tid", "ts_us", "dur",
+    __slots__ = ("tracer", "name", "cat", "args", "ts_us", "dur",
                  "_t0", "trace_id", "span_id", "parent_id", "track",
-                 "op_class", "_ctx_pushed")
+                 "op_class", "sampled", "weight")
 
     def __init__(self, tracer: "Tracer", name: str, cat: str, args: dict):
         self.tracer = tracer
         self.name = name
         self.cat = cat
         self.args = args
-        self.tid = threading.get_ident()
-        self.ts_us = 0.0
         self.dur = 0.0
-        self._t0 = 0.0
-        # distributed-trace linkage, filled on __enter__ when a
-        # TraceContext is active on this thread
+        # distributed-trace linkage (span_id/parent/class/weight) is
+        # filled on __enter__ only when a TraceContext is active; a
+        # nonzero trace_id is the "linked" flag (_trace_ids starts at 1)
         self.trace_id = 0
-        self.span_id = 0
-        self.parent_id = 0
-        self.op_class = ""
         self.track: str | None = None
-        self._ctx_pushed = False
 
     def set(self, **args) -> "Span":
         """Attach results discovered mid-span (e.g. bytes moved)."""
@@ -130,46 +147,104 @@ class Span:
         return self
 
     def __enter__(self) -> "Span":
-        self.tracer._push(self)
-        ctx = self.tracer.current_ctx()
+        tracer = self.tracer
+        tracer._push(self)
+        # one fused walk for the innermost ctx AND track (two separate
+        # current_ctx()/current_track() sweeps cost real time per op)
+        ctx = track = None
+        for c, t in reversed(tracer._ctx_stack()):
+            if ctx is None and c is not None:
+                ctx = c
+            if track is None and t is not None:
+                track = t
+            if ctx is not None and track is not None:
+                break
         if ctx is not None:
             self.trace_id = ctx.trace_id
             self.span_id = next(_span_ids)
             self.parent_id = ctx.span_id
             self.op_class = ctx.op_class
-            # nested spans (this thread, while we are open) chain under us
-            self.tracer._ctx_stack().append((ctx.child_of(self.span_id),
-                                             None))
-            self._ctx_pushed = True
-        self.track = self.tracer.current_track()
+            self.sampled = getattr(ctx, "sampled", True)
+            self.weight = getattr(ctx, "weight", 1.0)
+            # nested spans (this thread, while we are open) chain under
+            # us — even when unsampled, so child daemons inherit the
+            # head decision through child_of()
+            tracer._ctx_stack().append((ctx.child_of(self.span_id),
+                                        None))
+        self.track = track
         self._t0 = time.perf_counter()
-        self.ts_us = (self._t0 - self.tracer._t0) * 1e6
+        self.ts_us = (self._t0 - tracer._t0) * 1e6
         return self
 
     def __exit__(self, *exc) -> bool:
         self.dur = time.perf_counter() - self._t0
-        if self._ctx_pushed:
-            self.tracer._ctx_stack().pop()
-            self._ctx_pushed = False
-        self.tracer._pop(self)
-        self.tracer._finish_span(self)
+        tracer = self.tracer
+        if self.trace_id:
+            tracer._ctx_stack().pop()
+        tracer._pop(self)
+        tracer._finish_span(self)
         return False
 
 
+class _NullSpan:
+    """The kill-switch span: context-manager compatible, records
+    nothing.  One shared instance serves every call site — no per-op
+    allocation when ``instruments_enabled=false``."""
+
+    __slots__ = ()
+    dur = 0.0
+    ts_us = 0.0
+    args: dict = {}
+
+    def set(self, **args) -> "_NullSpan":
+        return self
+
+    def __enter__(self) -> "_NullSpan":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
 class Tracer:
-    """Thread-safe span recorder with a bounded ring of Chrome events."""
+    """Thread-safe span recorder with a bounded ring of Chrome events.
+
+    Finished events buffer per thread and fold into the shared ring in
+    batches (``FLUSH_BATCH``, or an explicit completion-boundary
+    :meth:`flush`), so hot threads touch the ring lock ~1/64th as often
+    as they emit.  Read surfaces (:meth:`dump`, :meth:`histograms`)
+    drain every thread's pending batch first, so nothing observable
+    changes except the lock traffic."""
 
     def __init__(self, capacity: int = TRACE_CAPACITY):
-        self._events: deque[dict] = deque(maxlen=capacity)
+        # finished events: dicts, or lite tuples (name, cat, ts_us,
+        # dur_us, tid) from the untraced fast path — materialized by
+        # dump()
+        self._events: deque = deque(maxlen=capacity)
         self._lock = threading.Lock()
         self._local = threading.local()
+        # per-thread pending-event buffers (thread ident -> list); the
+        # owner appends without the lock (single writer + GIL), batches
+        # fold under the ring lock
+        self._pending: dict[int, list] = {}
         # paired clocks: spans stamp with perf_counter; wall-clock sources
         # (TrackedOp timelines) map through the epoch pair
         self._t0 = time.perf_counter()
         self._wall0 = time.time()
         self.pid = os.getpid()
         # span-name -> [bucket_counts..., overflow] plus (sum, count)
-        self._hist: dict[str, dict] = {}
+        self._hist: dict[str, list] = {}
+        # head-based sampling (ISSUE 18): decided once per root context
+        # in new_trace(); unsampled traces keep only a micro-record here
+        # until they finish fast (dropped) or cross slow_threshold_s
+        # (promoted into the ring)
+        self.sample_rate = 1.0
+        self.slow_threshold_s = 30.0
+        self._micro: dict[int, dict] = {}
+        self._micro_lock = threading.Lock()
 
     # -- span stack (per thread, for nesting introspection) ----------------
 
@@ -203,8 +278,54 @@ class Tracer:
         return st
 
     def new_trace(self, op_class: str = "client") -> TraceContext:
-        """A fresh root context (span_id 0): the client edge of an op."""
-        return TraceContext(next(_trace_ids), 0, op_class)
+        """A fresh root context (span_id 0): the client edge of an op.
+
+        The head-based sampling decision happens HERE, once per trace:
+        the result rides the context (and every child_of() derived from
+        it, across daemons), so a distributed trace is all-in or
+        all-out.  Unsampled roots leave a micro-record (start, class,
+        id) for retroactive slow-op promotion; sampled roots carry a
+        1/rate weight so dump consumers can de-bias rate math."""
+        tid = next(_trace_ids)
+        if self._sample(tid):
+            rate = self.sample_rate
+            w = 1.0 / rate if 0.0 < rate < 1.0 else 1.0
+            return TraceContext(tid, 0, op_class, True, w)
+        self._note_micro(tid, op_class)
+        return TraceContext(tid, 0, op_class, False, 1.0)
+
+    def _sample(self, trace_id: int) -> bool:
+        """Deterministic per-trace-id decision (Knuth multiplicative
+        hash): equidistributed over sequential ids, reproducible for a
+        given id, and free of shared RNG state on the hot path."""
+        rate = self.sample_rate
+        if rate >= 1.0:
+            return True
+        if rate <= 0.0:
+            return False
+        return ((trace_id * 2654435761) & 0xFFFFFFFF) < rate * 4294967296.0
+
+    # -- unsampled-op micro-records (slow-op promotion) ---------------------
+
+    def _note_micro(self, trace_id: int, op_class: str) -> None:
+        with self._micro_lock:
+            self._micro[trace_id] = {"trace_id": trace_id,
+                                     "start_wall": time.time(),
+                                     "op_class": op_class}
+            while len(self._micro) > MICRO_CAPACITY:
+                self._micro.pop(next(iter(self._micro)))
+
+    def _drop_micro(self, trace_id: int) -> None:
+        if trace_id in self._micro:          # cheap pre-check, racy is fine
+            with self._micro_lock:
+                self._micro.pop(trace_id, None)
+
+    def micro_records(self) -> list[dict]:
+        """The in-flight unsampled ops (start wall time, op class, trace
+        id) — what SLOW_OPS triage sees for ops the sampler skipped that
+        have not completed yet."""
+        with self._micro_lock:
+            return [dict(r) for r in self._micro.values()]
 
     def current_ctx(self) -> TraceContext | None:
         """The innermost active TraceContext on this thread (None when
@@ -236,16 +357,45 @@ class Tracer:
     # -- recording ----------------------------------------------------------
 
     def span(self, name: str, cat: str = "", **args) -> Span:
+        if not instruments.enabled():
+            return _NULL_SPAN
         return Span(self, name, cat, args)
 
     def instant(self, name: str, cat: str = "", **args) -> None:
+        if not instruments.enabled():
+            return
+        ctx = self.current_ctx()
+        if ctx is not None and not getattr(ctx, "sampled", True):
+            return                   # unsampled trace: no per-event record
         ev = {"name": name, "cat": cat or "instant", "ph": "i", "s": "t",
               "ts": (time.perf_counter() - self._t0) * 1e6,
               "pid": self.pid, "tid": threading.get_ident()}
         if args:
             ev["args"] = args
-        with self._lock:
-            self._events.append(ev)
+        self._emit(ev)
+
+    def observe(self, name: str, t0: float, t1: float | None = None,
+                cat: str = "") -> None:
+        """Record a finished region measured with ``time.perf_counter()``
+        — the allocation-light fast path for hot UNTRACED spans (the
+        per-op rpc dispatch).  No Span object, no context-manager
+        protocol, no event dict: a lite tuple rides the pending buffer
+        and the ring, and :meth:`dump` materializes whatever survived
+        eviction.  Use :meth:`span` whenever a TraceContext may be
+        active — this path carries no trace linkage."""
+        if not instruments.enabled():
+            return
+        if t1 is None:
+            t1 = time.perf_counter()
+        # inlined _emit_lite: this is the single hottest instrument call
+        # (one per RPC dispatch), so it pays for zero extra frames
+        buf = getattr(self._local, "pending", None)
+        if buf is None:
+            buf = self._pending_buf()
+        buf.append((name, cat, (t0 - self._t0) * 1e6, (t1 - t0) * 1e6,
+                    threading.get_ident()))
+        if len(buf) >= FLUSH_BATCH:
+            self._flush_buf(buf)
 
     def complete(self, name: str, start_wall: float, dur_s: float,
                  cat: str = "", ctx: TraceContext | None = None,
@@ -258,6 +408,16 @@ class Tracer:
         critical-path ledger can attribute it — linkage is EXPLICIT
         opt-in, never ambient, so TrackedOp timelines that happen to
         run under an active context don't double-count as tree nodes."""
+        if not instruments.enabled():
+            return
+        promoted = False
+        if ctx is not None and not getattr(ctx, "sampled", True):
+            if dur_s < self.slow_threshold_s:
+                if ctx.span_id == 0:         # the trace's root completed fast
+                    self._drop_micro(ctx.trace_id)
+                return
+            promoted = True                  # slow op: into the ring anyway
+            self._drop_micro(ctx.trace_id)
         ev = {"name": name, "cat": cat or "op", "ph": "X",
               "ts": (start_wall - self._wall0) * 1e6,
               "dur": dur_s * 1e6,
@@ -267,16 +427,37 @@ class Tracer:
             args["span_id"] = next(_span_ids)
             args["parent_span_id"] = ctx.span_id
             args.setdefault("op_class", ctx.op_class)
+            if promoted:
+                # promoted events represent only themselves: weight 1
+                args["promoted"] = True
+            elif getattr(ctx, "weight", 1.0) != 1.0:
+                args["sample_weight"] = ctx.weight
         if args:
             ev["args"] = args
-        with self._lock:
-            self._events.append(ev)
-        self._hist_add(name, dur_s)
+        self._emit(ev, name, dur_s)
 
     def _finish_span(self, span: Span) -> None:
+        promoted = False
+        if span.trace_id and not span.sampled:
+            # unsampled trace: the span vanishes unless it crossed the
+            # complaint time — then it is promoted into the ring so
+            # SLOW_OPS / flight bundles / slo_report never go dark
+            if span.dur < self.slow_threshold_s:
+                if span.parent_id == 0:      # the root finished fast
+                    self._drop_micro(span.trace_id)
+                return
+            promoted = True
+            self._drop_micro(span.trace_id)
+        if not span.trace_id and not span.args and span.track is None:
+            # the hot shape (untraced, no args, no track): defer the
+            # event-dict build to dump() — evicted events never pay it
+            self._emit_lite((span.name, span.cat,
+                             span.ts_us, span.dur * 1e6,
+                             threading.get_ident()))
+            return
         ev = {"name": span.name, "cat": span.cat or "span", "ph": "X",
               "ts": span.ts_us, "dur": span.dur * 1e6,
-              "pid": self.pid, "tid": span.tid}
+              "pid": self.pid, "tid": threading.get_ident()}
         args = dict(span.args) if span.args else {}
         if span.trace_id:
             args["trace_id"] = span.trace_id
@@ -286,31 +467,108 @@ class Tracer:
             # path ledger (common/critpath.py) can classify a trace
             # without re-deriving it from span-name heuristics
             args.setdefault("op_class", span.op_class)
+            if promoted:
+                args["promoted"] = True
+            elif span.weight != 1.0:
+                args["sample_weight"] = span.weight
         if args:
             ev["args"] = args
         if span.track is not None:
             ev["track"] = span.track
-        with self._lock:
-            self._events.append(ev)
-        self._hist_add(span.name, span.dur)
+        self._emit(ev, span.name, span.dur)
 
-    def _hist_add(self, name: str, dur_s: float) -> None:
+    # -- per-thread batching -------------------------------------------------
+
+    def _pending_buf(self) -> list:
+        buf = getattr(self._local, "pending", None)
+        if buf is None:
+            buf = self._local.pending = []
+            with self._lock:
+                old = self._pending.get(threading.get_ident())
+                if old:
+                    # a dead thread's ident was reused: fold its
+                    # leftovers before the new owner takes the slot
+                    self._fold_locked(old)
+                self._pending[threading.get_ident()] = buf
+        return buf
+
+    def _emit(self, ev: dict, name: str | None = None,
+              dur_s: float = 0.0) -> None:
+        buf = self._pending_buf()
+        buf.append((ev, name, dur_s))
+        if len(buf) >= FLUSH_BATCH:
+            self._flush_buf(buf)
+
+    def _emit_lite(self, ev: tuple) -> None:
+        # a lite event rides the buffer BARE (no wrapper triple): the
+        # fold recognizes the 5-tuple shape and derives name/duration
+        # from it, so the hot path allocates one tuple per op, not two
+        buf = getattr(self._local, "pending", None)
+        if buf is None:
+            buf = self._pending_buf()
+        buf.append(ev)
+        if len(buf) >= FLUSH_BATCH:
+            self._flush_buf(buf)
+
+    def _flush_buf(self, buf: list) -> None:
         with self._lock:
-            h = self._hist.get(name)
-            if h is None:
-                h = self._hist[name] = {
-                    "counts": [0] * (len(LATENCY_BUCKETS_S) + 1),
-                    "sum": 0.0, "count": 0}
-            for i, bound in enumerate(LATENCY_BUCKETS_S):
-                if dur_s <= bound:
-                    h["counts"][i] += 1
-                    break
+            self._fold_locked(buf)
+
+    def _fold_locked(self, buf: list) -> None:
+        # under self._lock.  The owner may append concurrently (without
+        # the lock): capture len first, drain exactly that prefix — the
+        # append lands at the tail and survives for the next flush.
+        n = len(buf)
+        if not n:
+            return
+        items = buf[:n]
+        del buf[:n]
+        for item in items:
+            if len(item) == 5:
+                # bare lite event: (name, cat, ts_us, dur_us, tid)
+                self._events.append(item)
+                self._hist_add_locked(item[0], item[3] * 1e-6)
             else:
-                h["counts"][-1] += 1
-            h["sum"] += dur_s
-            h["count"] += 1
+                ev, name, dur_s = item
+                self._events.append(ev)
+                if name is not None:
+                    self._hist_add_locked(name, dur_s)
+
+    def flush(self) -> None:
+        """Fold the CALLING thread's pending batch into the ring — the
+        completion-boundary hook (pipeline complete, dispatcher worker
+        loop, serving finisher, mux sender loop)."""
+        buf = getattr(self._local, "pending", None)
+        if buf:
+            self._flush_buf(buf)
+
+    def _drain_all_locked(self) -> None:
+        for buf in list(self._pending.values()):
+            self._fold_locked(buf)
+
+    def _hist_add_locked(self, name: str, dur_s: float) -> None:
+        # cells are flat lists [counts, sum, count] and the bucket scan
+        # is a C-level bisect: this runs once per event inside the fold
+        # critical section, so it is the floor of the batched ring cost
+        h = self._hist.get(name)
+        if h is None:
+            h = self._hist[name] = [[0] * (len(LATENCY_BUCKETS_S) + 1),
+                                    0.0, 0]
+        h[0][bisect_left(LATENCY_BUCKETS_S, dur_s)] += 1
+        h[1] += dur_s
+        h[2] += 1
 
     # -- export --------------------------------------------------------------
+
+    def _materialize(self, ev) -> dict:
+        """A ring entry as a Chrome event dict.  Lite tuples (the
+        untraced span/observe fast path) build their dict HERE, once
+        per surviving event, instead of once per op."""
+        if type(ev) is tuple:
+            name, cat, ts, dur, tid = ev
+            return {"name": name, "cat": cat or "span", "ph": "X",
+                    "ts": ts, "dur": dur, "pid": self.pid, "tid": tid}
+        return dict(ev)
 
     def dump(self, stitched: bool = True) -> dict:
         """Chrome trace-event JSON (the ``trace dump`` admin command):
@@ -323,7 +581,8 @@ class Tracer:
         so one client op's spans across N daemons line up on one shared
         timeline (all tracks stamp from this tracer's clock pair)."""
         with self._lock:
-            events = [dict(ev) for ev in self._events]
+            self._drain_all_locked()
+            events = [self._materialize(ev) for ev in self._events]
         if stitched:
             track_pids: dict[str, int] = {}
             meta: list[dict] = []
@@ -347,18 +606,22 @@ class Tracer:
 
     def reset(self) -> dict:
         with self._lock:
+            self._drain_all_locked()
             n = len(self._events)
             self._events.clear()
             self._hist.clear()
+        with self._micro_lock:
+            self._micro.clear()
         return {"success": f"dropped {n} events"}
 
     def histograms(self) -> dict:
         """Per-span-name latency histograms: {name: {buckets (bounds, s),
         counts (len+1, last = overflow), sum, count}}."""
         with self._lock:
+            self._drain_all_locked()
             return {name: {"buckets": list(LATENCY_BUCKETS_S),
-                           "counts": list(h["counts"]),
-                           "sum": h["sum"], "count": h["count"]}
+                           "counts": list(h[0]),
+                           "sum": h[1], "count": h[2]}
                     for name, h in self._hist.items()}
 
 
@@ -373,6 +636,27 @@ def default_tracer() -> Tracer:
             if _default_tracer is None:
                 _default_tracer = Tracer()
     return _default_tracer
+
+
+def wire_config(conf) -> None:
+    """Adopt the default tracer's sampling knobs from a ConfigProxy and
+    follow live updates: ``tracer_sample_rate`` sets the head-based
+    sampling probability, ``osd_op_complaint_time`` doubles as the
+    slow-op promotion threshold (the same bound SLOW_OPS health uses, so
+    'promoted into the ring' and 'flagged slow' agree by construction)."""
+    tr = default_tracer()
+    if "tracer_sample_rate" in conf.schema:
+        tr.sample_rate = float(conf.get("tracer_sample_rate"))
+
+        def _on_rate(_name, v, _tr=tr):
+            _tr.sample_rate = float(v)
+        conf.add_observer("tracer_sample_rate", _on_rate)
+    if "osd_op_complaint_time" in conf.schema:
+        tr.slow_threshold_s = float(conf.get("osd_op_complaint_time"))
+
+        def _on_complaint(_name, v, _tr=tr):
+            _tr.slow_threshold_s = float(v)
+        conf.add_observer("osd_op_complaint_time", _on_complaint)
 
 
 def trace_span(name: str, cat: str = "", **args) -> Span:

@@ -42,6 +42,7 @@ import threading
 import weakref
 from typing import Callable
 
+from . import instruments
 from .perf_counters import PerfCountersBuilder
 
 # the owner classes wire bytes attribute to: device_attribution's
@@ -166,29 +167,91 @@ class WireAccounting:
         self.perf = b.create_perf_counters()
         self.cct.perf.add(self.perf)
         self._lock = threading.Lock()
-        # type -> {"tx_msgs","tx_bytes","rx_msgs","rx_bytes"}
+        # type -> {"tx_msgs","tx_bytes","rx_msgs","rx_bytes"} — the
+        # read-side base; live mutation happens in per-thread shards
         self._types: dict[str, dict] = {}
-        # rpc method -> [count, seconds_sum]
+        # rpc method -> [count, seconds_sum] — same split
         self._rpc: dict[str, list] = {}
+        # per-thread fast-path state (ISSUE 18): cached perf-counter
+        # cells per (direction, class) plus this thread's type/rpc
+        # shards, all mutated lock-free by their owner and folded under
+        # self._lock at read boundaries
+        self._tls = threading.local()
+        self._type_shards: dict[int, dict] = {}
+        self._rpc_shards: dict[int, dict] = {}
+        self._queue_peak = 0
         _ACCOUNTANTS.add(self)
+
+    def _fast(self) -> tuple[dict, dict, dict, dict]:
+        """This thread's (cell cache, type shard, rpc shard, misc cache)
+        tuple, registered for read-time folding on first use."""
+        st = getattr(self._tls, "state", None)
+        if st is None:
+            ident = threading.get_ident()
+            types: dict = {}
+            rpc: dict = {}
+            with self._lock:
+                # a dead thread's ident was reused: bank its shards
+                # into the base before the new owner takes the slot
+                self._absorb_shards_locked(ident)
+                self._type_shards[ident] = types
+                self._rpc_shards[ident] = rpc
+            st = self._tls.state = ({}, types, rpc, {})
+        return st
+
+    def _absorb_shards_locked(self, ident: int) -> None:
+        old = self._type_shards.pop(ident, None)
+        if old:
+            for t, row in old.items():
+                base = self._types.get(t)
+                if base is None:
+                    base = self._types[t] = {
+                        "tx_msgs": 0, "tx_bytes": 0,
+                        "rx_msgs": 0, "rx_bytes": 0}
+                base["tx_msgs"] += row[0]
+                base["tx_bytes"] += row[1]
+                base["rx_msgs"] += row[2]
+                base["rx_bytes"] += row[3]
+        old = self._rpc_shards.pop(ident, None)
+        if old:
+            for m, (c, s) in old.items():
+                rec = self._rpc.setdefault(m, [0, 0.0])
+                rec[0] += c
+                rec[1] += s
 
     # -- per-message -------------------------------------------------------
 
     def _account(self, direction: str, type_name: str, nbytes: int,
                  ctx) -> None:
-        n = max(0, int(nbytes))
+        if not instruments.enabled():
+            return
+        n = int(nbytes)
+        if n < 0:
+            n = 0
         cls = wire_class(ctx)
-        self.perf.inc(f"{direction}_msgs")
-        self.perf.inc(f"{direction}_bytes", n)
-        self.perf.inc(f"class_msgs:{cls}")
-        self.perf.inc(f"class_bytes:{cls}", n)
-        with self._lock:
-            t = self._types.get(type_name)
-            if t is None:
-                t = self._types[type_name] = {"tx_msgs": 0, "tx_bytes": 0,
-                                              "rx_msgs": 0, "rx_bytes": 0}
-            t[f"{direction}_msgs"] += 1
-            t[f"{direction}_bytes"] += n
+        cells, types = self._fast()[:2]
+        key = (direction, cls)
+        row = cells.get(key)
+        if row is None:
+            # first op of this (direction, class) on this thread: bind
+            # the four perf cells + the type-shard index ONCE — the
+            # steady state is four list bumps and two dict lookups
+            pc = self.perf
+            row = cells[key] = (pc._cell(f"{direction}_msgs"),
+                                pc._cell(f"{direction}_bytes"),
+                                pc._cell(f"class_msgs:{cls}"),
+                                pc._cell(f"class_bytes:{cls}"),
+                                0 if direction == "tx" else 2)
+        row[0][0] += 1
+        row[1][0] += n
+        row[2][0] += 1
+        row[3][0] += n
+        t = types.get(type_name)
+        if t is None:
+            t = types[type_name] = [0, 0, 0, 0]
+        di = row[4]
+        t[di] += 1
+        t[di + 1] += n
 
     def account_tx(self, type_name: str, nbytes: int, ctx=None) -> None:
         self._account("tx", type_name, nbytes, ctx)
@@ -209,30 +272,81 @@ class WireAccounting:
                         else getattr(msg, "trace", None))
 
     def note_queue_depth(self, depth: int) -> None:
-        self.perf.set("send_queue_depth", int(depth))
-        if depth > self.perf.get("send_queue_peak"):
-            self.perf.set("send_queue_peak", int(depth))
+        if not instruments.enabled():
+            return
+        d = int(depth)
+        self.perf.set("send_queue_depth", d)
+        # plain-attribute peak pre-check: the old get() folded every
+        # thread's cells under the lock ON EVERY SEND; the gauge write
+        # happens only on a new peak now
+        if d > self._queue_peak:
+            self._queue_peak = d
+            self.perf.set("send_queue_peak", d)
 
     def observe_rpc(self, method: str, seconds: float) -> None:
-        self.perf.hinc("rpc_latency_ms", seconds * 1000.0)
-        with self._lock:
-            rec = self._rpc.setdefault(method, [0, 0.0])
-            rec[0] += 1
-            rec[1] += seconds
+        if not instruments.enabled():
+            return
+        st = self._fast()
+        rpc, misc = st[2], st[3]
+        hist = misc.get("rpc_hist")
+        if hist is None:
+            # bind the histogram cell + bucket bounds once per thread;
+            # the steady state is one linear bucket scan + three bumps
+            # (hinc() re-resolves the metric and cell on every call)
+            m = self.perf._metrics["rpc_latency_ms"]
+            c = self.perf._cell("rpc_latency_ms")
+            if c[3] is None:
+                c[3] = [0] * (len(m.buckets) + 1)
+            hist = misc["rpc_hist"] = (c, tuple(m.buckets))
+        c, bounds = hist
+        ms = seconds * 1000.0
+        i = 0
+        n = len(bounds)
+        while i < n and ms > bounds[i]:
+            i += 1
+        c[3][i] += 1
+        c[1] += ms
+        c[2] += 1
+        rec = rpc.get(method)
+        if rec is None:
+            rec = rpc[method] = [0, 0.0]
+        rec[0] += 1
+        rec[1] += seconds
 
     # -- read surfaces -----------------------------------------------------
 
     def per_type(self) -> dict[str, dict]:
         """Per-message-type table (the prometheus ``ceph_tpu_wire_bytes``
-        family + the `daemonperf` wire columns)."""
+        family + the `daemonperf` wire columns), live shards folded in
+        non-destructively."""
         with self._lock:
-            return {t: dict(v) for t, v in sorted(self._types.items())}
+            out = {t: dict(v) for t, v in self._types.items()}
+            for shard in list(self._type_shards.values()):
+                # list(dict.items()) is one GIL-atomic snapshot; the
+                # owner may keep appending — later reads catch up
+                for t, row in list(shard.items()):
+                    e = out.get(t)
+                    if e is None:
+                        e = out[t] = {"tx_msgs": 0, "tx_bytes": 0,
+                                      "rx_msgs": 0, "rx_bytes": 0}
+                    e["tx_msgs"] += row[0]
+                    e["tx_bytes"] += row[1]
+                    e["rx_msgs"] += row[2]
+                    e["rx_bytes"] += row[3]
+        return dict(sorted(out.items()))
 
     def rpc_methods(self) -> dict[str, dict]:
         with self._lock:
-            return {m: {"count": c, "sum_s": round(s, 6),
-                        "avg_ms": round(s / c * 1000.0, 3) if c else 0.0}
-                    for m, (c, s) in sorted(self._rpc.items())}
+            agg: dict[str, list] = {m: list(v)
+                                    for m, v in self._rpc.items()}
+            for shard in list(self._rpc_shards.values()):
+                for m, row in list(shard.items()):
+                    rec = agg.setdefault(m, [0, 0.0])
+                    rec[0] += row[0]
+                    rec[1] += row[1]
+        return {m: {"count": c, "sum_s": round(s, 6),
+                    "avg_ms": round(s / c * 1000.0, 3) if c else 0.0}
+                for m, (c, s) in sorted(agg.items())}
 
     def class_bytes(self) -> dict[str, float]:
         return {cls: self.perf.get(f"class_bytes:{cls}")

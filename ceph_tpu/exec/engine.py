@@ -461,6 +461,9 @@ class ServingEngine:
         self.perf.hinc("op_e2e_lat", e2e)
         default_tracer().complete("serving.op", op.t_submit_wall, e2e,
                                   kind=op.kind, op_class=op.op_class)
+        # finisher completion boundary: fold this thread's pending span
+        # batch into the tracer ring once per retired op
+        default_tracer().flush()
         with self._lock:
             self._in_flight -= 1
             if not self._in_flight and not self._depth:

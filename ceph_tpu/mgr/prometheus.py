@@ -15,6 +15,8 @@ identical across a histogram's `_bucket`/`_count`/`_sum` series.
 """
 from __future__ import annotations
 
+import time
+
 from ..common import default_context
 from ..common.perf_counters import (
     PERFCOUNTER_AVG, PERFCOUNTER_HISTOGRAM, PERFCOUNTER_TIME_AVG,
@@ -353,16 +355,35 @@ def _stats_rate_gauges(family, prefix: str) -> None:
                 f'stat="{stat}"}} {round(v, 3)}')
 
 
+def _device_refresh_due(cct, now: float) -> bool:
+    """TTL gate on the per-scrape device-telemetry refresh
+    (``mgr_device_refresh_ttl``): a tight scrape loop re-renders the
+    LAST snapshot's gauges instead of re-snapshotting JAX backend state
+    every render.  ``ttl=0`` restores refresh-every-scrape.  The stamp
+    lives ON the context — a fresh context's first scrape must refresh
+    its own gauges regardless of when another context last scraped."""
+    try:
+        ttl = float(cct.conf.get("mgr_device_refresh_ttl"))
+    except Exception:
+        ttl = 0.0
+    last = getattr(cct, "_prom_device_refresh", float("-inf"))
+    if ttl > 0.0 and now - last < ttl:
+        return False
+    cct._prom_device_refresh = now
+    return True
+
+
 def render(cct=None, prefix: str = "ceph_tpu") -> str:
     """The /metrics payload: every registered collection's metrics plus
     the tracer's span-latency histograms."""
     cct = cct if cct is not None else default_context()
     # refresh the device gauges BEFORE the collection walk renders them
     # (never initializes a backend: scrape must not be the thing that
-    # dials a wedged tunnel)
+    # dials a wedged tunnel), at most once per mgr_device_refresh_ttl
     try:
-        from ..common import device_telemetry
-        device_telemetry.refresh(cct)
+        if _device_refresh_due(cct, time.monotonic()):
+            from ..common import device_telemetry
+            device_telemetry.refresh(cct)
     except Exception:                       # pragma: no cover
         pass
     # same for the roofline ledger's aggregate device_efficiency gauges;
@@ -384,19 +405,24 @@ def render(cct=None, prefix: str = "ceph_tpu") -> str:
 
     for coll_name, pc in sorted(cct.perf.snapshot().items()):
         label = f'collection="{coll_name}"'
+        # fold the per-thread counter cells: hot-path inc/tinc/hinc land
+        # in thread-local shards, and a scrape must see them
+        with pc._lock:
+            folded = {key: pc._folded_locked(m, key)
+                      for key, m in pc._metrics.items()}
         for key, m in sorted(pc._metrics.items()):
             metric = f"{prefix}_{_sanitize(key)}"
+            value, total, count, bc = folded[key]
             if m.kind in (PERFCOUNTER_AVG, PERFCOUNTER_TIME_AVG):
                 fam = family(metric, "summary", m.description)
-                fam.lines.append(f"{metric}_sum{{{label}}} {m.sum}")
-                fam.lines.append(f"{metric}_count{{{label}}} {m.count}")
+                fam.lines.append(f"{metric}_sum{{{label}}} {total}")
+                fam.lines.append(f"{metric}_count{{{label}}} {count}")
             elif m.kind == PERFCOUNTER_HISTOGRAM:
                 fam = family(metric, "histogram", m.description)
-                _histogram_series(fam, label, m.buckets, m.bucket_counts,
-                                  m.sum)
+                _histogram_series(fam, label, m.buckets, bc, total)
             else:
                 fam = family(metric, "counter", m.description)
-                fam.lines.append(f"{metric}{{{label}}} {m.value}")
+                fam.lines.append(f"{metric}{{{label}}} {value}")
 
     _mclock_depth_gauges(family, prefix)
     _recovery_reserver_gauges(family, prefix)

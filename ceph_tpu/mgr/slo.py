@@ -107,10 +107,19 @@ class SLOTracker:
     def _window(records: list[dict], window_s: float, bound_ms: float,
                 budget: float, now: float) -> dict:
         recs = [r for r in records if now - r["t"] <= window_s]
-        bad = sum(1 for r in recs if r["total_s"] * 1e3 > bound_ms)
+        # weighted by each record's sample weight (1/rate for head-
+        # sampled traces, 1.0 otherwise): bad_frac stays an unbiased
+        # estimate of the true bad-op RATE under sampling.  `ops` stays
+        # the observed record count — it feeds the min_ops significance
+        # floor, which is about how much EVIDENCE we have, not how many
+        # ops the evidence represents.
+        bad = sum(r.get("w", 1.0) for r in recs
+                  if r["total_s"] * 1e3 > bound_ms)
+        wsum = sum(r.get("w", 1.0) for r in recs)
         n = len(recs)
-        bad_frac = bad / n if n else 0.0
-        return {"window_s": window_s, "ops": n, "bad": bad,
+        bad_frac = bad / wsum if wsum else 0.0
+        return {"window_s": window_s, "ops": n,
+                "weighted_ops": round(wsum, 1), "bad": round(bad, 1),
                 "bad_frac": round(bad_frac, 6),
                 "burn": round(bad_frac / budget, 3)}
 

@@ -311,12 +311,18 @@ class MuxClient:
         each riding call's trace gets one ``mux.batch_send`` child
         covering the coalesced serialize+enqueue, so critical-path
         attribution sees frame time the per-call rpc spans cannot."""
+        from ..common import instruments
+        if not instruments.enabled():
+            return
         from ..common.tracer import default_tracer
         tr = default_tracer()
         for c in live:
             if getattr(c.trace, "trace_id", None):
                 tr.complete("mux.batch_send", wall, dur, cat="mux",
                             ctx=c.trace, batched_calls=n)
+        # sender-loop completion boundary: fold this thread's pending
+        # batch into the ring once per frame, not once per riding call
+        tr.flush()
 
     def _conn_for_send(self) -> AsyncConnection | None:
         with self._cond:

@@ -174,6 +174,9 @@ class Dispatcher:
         frame: each riding call's trace gets one ``mux.batch_reply``
         child covering the coalesced reply serialize+enqueue (the send
         the per-method ``rpc.*`` server spans end before)."""
+        from ..common import instruments
+        if not instruments.enabled():
+            return
         from ..common.tracer import default_tracer
         tr = default_tracer()
         for c in calls:
@@ -218,6 +221,10 @@ class Dispatcher:
                 # out: results are cached under their reqids — the
                 # client's resend on the next connection collects them
                 pass
+            # dispatcher completion boundary: fold this worker's pending
+            # span batch into the ring once per frame, not per span
+            from ..common.tracer import default_tracer
+            default_tracer().flush()
 
 
 class AsyncServerTransport:

@@ -47,10 +47,15 @@ from .auth.cephx import (AuthError, Authorizer, CephxClient,
 from .backend.wire import (BANNER, FrameParser, TAG_HELLO, TAG_MESSAGE,
                            WireError, frame_encode)
 from .common import wire_accounting
+from .common.tracer import default_tracer
 
 SERVICE = "osd"
 KEYRING = "client.admin.keyring"
 NOTIFY_TIMEOUT = 10.0
+
+# interned "rpc.<method>" span names: dispatch records one tracer event
+# per op, and building the name fresh each time is measurable at that rate
+_RPC_SPAN_NAMES: dict[str, str] = {}
 
 
 # -- socket RPC messages (own registry: these never ride the PG bus) ---------
@@ -309,20 +314,23 @@ class Channel:
             action = hooks.on_send(
                 type(msg).__name__, len(data),
                 target=type(msg).__name__)
+        if self.acct is not None:
+            # real framed bytes; the op class comes from the riding
+            # trace ctx (RpcCall) or the sender's active context.
+            # Accounting is sharded per thread now — it needs no lock,
+            # and keeping it OUT of _wlock keeps concurrent senders
+            # from serializing on an instrument
+            self.acct.account_msg(
+                msg, nbytes=len(data),
+                ctx=getattr(msg, "trace", None)
+                or default_tracer().current_ctx())
         with self._wlock:
-            # stats ride the same lock that serializes concurrent
-            # senders (dispatch reply vs notify push): counting outside
-            # it loses increments and drifts from the peer's rx side
+            # the plain stats dict still rides the lock that serializes
+            # concurrent senders (dispatch reply vs notify push):
+            # counting it outside would lose increments and drift from
+            # the peer's rx side
             self.stats["tx_msgs"] += 1
             self.stats["tx_bytes"] += len(data)
-            if self.acct is not None:
-                # real framed bytes; the op class comes from the riding
-                # trace ctx (RpcCall) or the sender's active context
-                from .common.tracer import default_tracer
-                self.acct.account_msg(
-                    msg, nbytes=len(data),
-                    ctx=getattr(msg, "trace", None)
-                    or default_tracer().current_ctx())
             if action == "ok":
                 self.sock.sendall(data)
         if action != "ok":
@@ -600,13 +608,23 @@ class ClusterServer:
             fn = getattr(self, f"_rpc_{call.method}", None)
             if fn is None:
                 raise ValueError(f"unknown method {call.method!r}")
-            from .common.tracer import default_tracer
             tr = default_tracer()
-            with self.lock, \
-                    tr.activate(getattr(call, "trace", None),
-                                track="server"), \
-                    tr.span(f"rpc.{call.method}", cat="rpc"):
-                value = fn(ch, **call.args)
+            trace = getattr(call, "trace", None)
+            sname = _RPC_SPAN_NAMES.get(call.method)
+            if sname is None:
+                sname = _RPC_SPAN_NAMES[call.method] = "rpc." + call.method
+            if trace is not None:
+                with self.lock, tr.activate(trace, track="server"), \
+                        tr.span(sname, cat="rpc"):
+                    value = fn(ch, **call.args)
+            else:
+                # untraced op: no context/track to adopt and nothing to
+                # link — record through the allocation-light observe()
+                # path instead of the full Span protocol
+                with self.lock:
+                    t0_span = time.perf_counter()
+                    value = fn(ch, **call.args)
+                    tr.observe(sname, t0_span, cat="rpc")
             return self._rpc_remember(
                 key, RpcResult(call.rid, True, value,
                                trace=getattr(call, "trace", None)))
@@ -996,7 +1014,6 @@ class TcpRados:
         """One send + one bounded wait on the CURRENT connection.
         Raises ConnectionError (link died) or TimeoutError (no reply —
         e.g. a black-holed request) for the retry loop to handle."""
-        from .common.tracer import default_tracer
         tr = default_tracer()
         ctx = tr.current_ctx() or tr.new_trace("client")
         self.ch.send(RpcCall(rid, method, args, trace=ctx,
@@ -1032,7 +1049,6 @@ class TcpRados:
         # every RPC is (part of) a client op: adopt the caller's trace
         # or root one, so resend/backoff time below stamps into a trace
         # the critical-path ledger can attribute to `retry`
-        from .common.tracer import default_tracer
         tr = default_tracer()
         ctx = tr.current_ctx() or tr.new_trace("client")
         try:
@@ -1053,7 +1069,6 @@ class TcpRados:
 
     def _call_with_retries(self, rid, method, args, total, attempts,
                            per_attempt, deadline, ctx=None):
-        from .common.tracer import default_tracer
         tr = default_tracer()
         last: BaseException | None = None
         timeouts = 0

@@ -48,7 +48,8 @@ import jax.numpy as jnp
 from ..common import default_context
 from ..common import device_attribution
 from ..common.perf_counters import PerfCountersBuilder
-from ..common.tracer import activate_trace, current_trace, trace_span
+from ..common.tracer import (activate_trace, current_trace,
+                             default_tracer, trace_span)
 from ..failure.breaker import CircuitBreaker, state_rank
 from ..failure.injector import InjectedFault, InjectedOOM
 
@@ -443,6 +444,9 @@ class CodecPipeline:
         # free buffers promptly
         fut._packed = fut._dev = fut._unpack = fut._host_fallback = None
         fut._finish(result, error)
+        # pipeline completion boundary: fold this thread's pending span
+        # batch into the tracer ring once per completed item
+        default_tracer().flush()
         return fut
 
     def complete_one(self) -> bool:

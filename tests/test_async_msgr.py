@@ -425,19 +425,39 @@ class TestMuxStack:
             conn.close()
 
     def test_shed_by_class_under_tiny_queue(self, served):
-        """Dispatch queue clamped to 1: background traffic bounces with
-        EBUSY while the server stays up and client ops still complete."""
+        """Dispatch queue clamped to 1 with every worker HELD on a gated
+        rpc: background traffic bounces with EBUSY while the server
+        stays up and client ops still complete.  (Holding the workers
+        makes the shed deterministic — on an idle host a fast drain can
+        otherwise serve the whole flood without ever filling a queue of
+        one.)"""
         server, keyring = served
         server._transport.shed = ShedPolicy(1)
         server._transport.dispatcher.shed = server._transport.shed
+        gate = threading.Event()
+        running = threading.Semaphore(0)
+
+        def _rpc_block(ch):
+            running.release()
+            gate.wait(30.0)
+            return "unblocked"
+
+        server._rpc_block = _rpc_block
         mux = MuxClient("127.0.0.1", server.port, keyring, n_conns=1)
         try:
             s = mux.session()
             s.call("mkpool", {"name": "p", "replicated": True, "size": 3})
+            # ONE parked blocker stalls the whole pool: rpc dispatch
+            # serializes handlers on the cluster lock, so the other
+            # workers pop an op each and wait on the lock, and the flood
+            # piles into the depth-1 queue
+            blocker = mux.session().call_async("block", {}, timeout=30.0)
+            assert running.acquire(timeout=10.0)
             outcomes = {"ok": 0, "shed": 0}
             calls = [s.call_async("ping", {"payload": i},
                                   op_class=BG_SCRUB, timeout=10.0)
                      for i in range(200)]
+            gate.set()
             for c in calls:
                 c.event.wait(30.0)
                 try:
@@ -446,6 +466,8 @@ class TestMuxStack:
                 except IOError as e:
                     assert e.errno == EBUSY
                     outcomes["shed"] += 1
+            blocker.event.wait(30.0)
+            assert blocker.value() == "unblocked"
             assert outcomes["shed"] > 0, "tiny queue never shed"
             assert mux.stats()["sheds_seen"] == outcomes["shed"]
             snap = server._transport.shed.snapshot()

@@ -108,6 +108,15 @@ METRIC_PATHS = {
     # reference so suppressed debt can't quietly snowball.
     "lint.new": (("lint", "new"), False),
     "lint.baselined": (("lint", "baselined"), False),
+    # observability fast path (ISSUE 18): the instrumentation tax over
+    # the serving.async mux workload — instruments-on goodput diffed
+    # like every throughput metric, and overhead_pct held to an
+    # ABSOLUTE cap (METRIC_LIMITS): full instruments at default
+    # sampling must cost single-digit percent, every artifact, no ref
+    "observability.overhead_pct": (("observability", "overhead_pct"),
+                                   False),
+    "observability.ops_s": (
+        ("observability", "instruments_on", "ops_s"), True),
 }
 
 # absolute bounds checked on the NEW artifact alone — no reference
@@ -137,6 +146,10 @@ METRIC_LIMITS = {
     # artifact — a new finding is a bug (or a missing justification),
     # never acceptable drift
     "lint.new": (0, "max"),
+    # the ISSUE 18 acceptance cap: full instruments at default sampling
+    # cost <= 10% of kill-switch goodput on the mux workload (to be
+    # ratcheted down as the fast path matures)
+    "observability.overhead_pct": (10.0, "max"),
 }
 
 # fraction of regression tolerated per metric before the gate fails;
@@ -176,7 +189,12 @@ METRIC_THRESHOLDS = {"efficiency.pct_of_peak": 0.30,
                      # entry is ~6% at today's size, so diff loosely and
                      # let review argue each justification — the gate
                      # only stops a silent suppression avalanche
-                     "lint.baselined": 0.50}
+                     "lint.baselined": 0.50,
+                     # a ratio of two back-to-back wall-clock socket
+                     # arms: the absolute 10% cap in METRIC_LIMITS is
+                     # the real gate; the diff only stops a cliff
+                     "observability.overhead_pct": 5.0,
+                     "observability.ops_s": 0.30}
 
 _BLOCK_DEVICE = {
     "core.mib_s": ("device",),
@@ -208,6 +226,8 @@ _BLOCK_DEVICE = {
     # these fall back to the artifact's overall platform
     "lint.new": ("lint", "device"),
     "lint.baselined": ("lint", "device"),
+    "observability.overhead_pct": ("observability", "device"),
+    "observability.ops_s": ("observability", "device"),
 }
 
 

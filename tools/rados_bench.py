@@ -81,6 +81,62 @@ def _pct(sorted_vals, p):
     return sorted_vals[i]
 
 
+def _closed_loop_segment(mux, n_clients: int, ops_per_client: int,
+                         payload: bytes, timeout_s: float) -> dict:
+    """One closed-loop burst over an ALREADY-CONNECTED mux: every logical
+    session runs ``ops_per_client`` ping RPCs (next op submits when the
+    previous completes; EBUSY sheds retry the same op).  Shared by
+    :func:`run_mux_bench` (one segment per process) and
+    :func:`run_mux_overhead_bench` (many segments against one warmed
+    server, so segment-to-segment deltas isolate instrument cost from
+    setup noise)."""
+    import errno as _errno
+    import threading
+    import time
+
+    total = n_clients * ops_per_client
+    lock = threading.Lock()
+    state = {"done": 0, "failed": 0, "shed_retries": 0}
+    lats: list[float] = []
+    finished = threading.Event()
+
+    def mk_cb(sess, left):
+        def cb(call):
+            r = call.result
+            shed = (not isinstance(r, BaseException)
+                    and not r.ok and r.errno == _errno.EBUSY)
+            with lock:
+                if shed:
+                    state["shed_retries"] += 1
+                elif isinstance(r, BaseException) or not r.ok:
+                    state["failed"] += 1
+                    state["done"] += 1
+                else:
+                    lats.append(time.monotonic() - call.t_submit)
+                    state["done"] += 1
+                fin = state["done"] >= total
+            if fin:
+                finished.set()
+                return
+            if shed:        # refused: retry the SAME op
+                sess.call_async("ping", {"payload": payload},
+                                cb=mk_cb(sess, left))
+            elif left > 1:  # completed: next op in the loop
+                sess.call_async("ping", {"payload": payload},
+                                cb=mk_cb(sess, left - 1))
+        return cb
+
+    t0 = time.perf_counter()
+    for _ in range(n_clients):
+        s = mux.session()
+        s.call_async("ping", {"payload": payload}, cb=mk_cb(s, ops_per_client))
+    ok = finished.wait(timeout_s)
+    elapsed = time.perf_counter() - t0
+    lats.sort()
+    return {"finished_in_time": bool(ok), "elapsed_s": elapsed,
+            "state": state, "lats": lats}
+
+
 def run_mux_bench(n_clients: int = 10000, ops_per_client: int = 2,
                   n_conns: int = 8, payload_bytes: int = 64,
                   queue_max: int | None = None,
@@ -125,48 +181,12 @@ def run_mux_bench(n_clients: int = 10000, ops_per_client: int = 2,
                             os.path.join(td, KEYRING), n_conns=n_conns)
             mux.connect()
             payload = b"\xab" * payload_bytes
-            total = n_clients * ops_per_client
-            lock = threading.Lock()
-            state = {"done": 0, "failed": 0, "shed_retries": 0}
-            lats: list[float] = []
-            finished = threading.Event()
-
-            def mk_cb(sess, left):
-                def cb(call):
-                    import errno as _errno
-                    r = call.result
-                    shed = (not isinstance(r, BaseException)
-                            and not r.ok and r.errno == _errno.EBUSY)
-                    with lock:
-                        if shed:
-                            state["shed_retries"] += 1
-                        elif isinstance(r, BaseException) or not r.ok:
-                            state["failed"] += 1
-                            state["done"] += 1
-                        else:
-                            lats.append(
-                                time.monotonic() - call.t_submit)
-                            state["done"] += 1
-                        fin = state["done"] >= total
-                    if fin:
-                        finished.set()
-                        return
-                    if shed:        # refused: retry the SAME op
-                        sess.call_async("ping", {"payload": payload},
-                                        cb=mk_cb(sess, left))
-                    elif left > 1:  # completed: next op in the loop
-                        sess.call_async("ping", {"payload": payload},
-                                        cb=mk_cb(sess, left - 1))
-                return cb
-
-            t0 = time.perf_counter()
-            for _ in range(n_clients):
-                s = mux.session()
-                s.call_async("ping", {"payload": payload},
-                             cb=mk_cb(s, ops_per_client))
-            ok = finished.wait(timeout_s)
-            elapsed = time.perf_counter() - t0
-            lats.sort()
+            seg = _closed_loop_segment(mux, n_clients, ops_per_client,
+                                       payload, timeout_s)
+            ok = seg["finished_in_time"]
+            elapsed = seg["elapsed_s"]
+            state = seg["state"]
+            lats = seg["lats"]
             st = mux.stats()
             shed_snap = (server._transport.shed.snapshot()
                          if server._transport is not None else {})
@@ -200,6 +220,111 @@ def run_mux_bench(n_clients: int = 10000, ops_per_client: int = 2,
             cluster.shutdown()
             for k, v in saved.items():
                 conf.set(k, v)
+
+
+def run_mux_overhead_bench(n_clients: int = 64, ops_per_client: int = 300,
+                           n_conns: int = 2, payload_bytes: int = 64,
+                           rounds: int = 7, timeout_s: float = 120.0) -> dict:
+    """Instrument-overhead A/B on the serving.async mux workload.
+
+    One server and one warmed mux; ``rounds`` PAIRED closed-loop
+    segments (instruments on vs off via the kill-switch) alternate over
+    the SAME connections, each measured in PROCESS CPU time per op.
+    Wall-clock throughput on a small shared host swings 2x run-to-run
+    from scheduler noise and per-process setup differences; CPU-per-op
+    against one warmed server isolates the work the instruments actually
+    add.  The published overhead is the MEDIAN of the per-round paired
+    deltas, with the on/off order alternating each round so slow drift
+    cancels instead of biasing one arm.
+    """
+    import gc
+    import os
+    import tempfile
+    import time
+
+    from ceph_tpu.cluster import MiniCluster
+    from ceph_tpu.common import instruments
+    from ceph_tpu.msg import MuxClient
+    from ceph_tpu.net import KEYRING, ClusterServer
+
+    total = n_clients * ops_per_client
+    with tempfile.TemporaryDirectory() as td:
+        cluster = MiniCluster(n_osds=3, osds_per_host=3, chunk_size=512,
+                              data_dir=td)
+        server = ClusterServer(cluster)
+        mux = None
+        try:
+            server.start()
+            mux = MuxClient("127.0.0.1", server.port,
+                            os.path.join(td, KEYRING), n_conns=n_conns)
+            mux.connect()
+            payload = b"\xab" * payload_bytes
+
+            def segment(off: bool) -> dict:
+                gc.collect()
+                c0 = time.process_time()
+                if off:
+                    with instruments.disabled():
+                        seg = _closed_loop_segment(
+                            mux, n_clients, ops_per_client, payload,
+                            timeout_s)
+                else:
+                    seg = _closed_loop_segment(
+                        mux, n_clients, ops_per_client, payload, timeout_s)
+                cpu = time.process_time() - c0
+                state, lats = seg["state"], seg["lats"]
+                completed = state["done"] - state["failed"]
+                return {
+                    "cpu_us_per_op": cpu / total * 1e6,
+                    "ops_s": round(completed / seg["elapsed_s"], 1)
+                    if seg["elapsed_s"] else 0.0,
+                    "p99_ms": round(_pct(lats, 99) * 1e3, 3),
+                    "completed": completed,
+                }
+
+            def median(vals):
+                s = sorted(vals)
+                m = len(s) // 2
+                return s[m] if len(s) % 2 else (s[m - 1] + s[m]) / 2
+
+            segment(False)    # warmup: discarded (cold code paths, sockets)
+            deltas = []
+            on_segs, off_segs = [], []
+            for i in range(rounds):
+                first_off = bool(i % 2)        # alternate A/B, B/A order
+                a = segment(first_off)
+                b = segment(not first_off)
+                on_seg, off_seg = (b, a) if first_off else (a, b)
+                on_segs.append(on_seg)
+                off_segs.append(off_seg)
+                deltas.append(
+                    (on_seg["cpu_us_per_op"] - off_seg["cpu_us_per_op"])
+                    / off_seg["cpu_us_per_op"] * 100.0)
+
+            def arm(segs):
+                return {
+                    "ops_s": median([s["ops_s"] for s in segs]),
+                    "p99_ms": median([s["p99_ms"] for s in segs]),
+                    "cpu_us_per_op": round(
+                        median([s["cpu_us_per_op"] for s in segs]), 2),
+                }
+
+            return {
+                "mode": "mux-overhead",
+                "clients": n_clients,
+                "ops_per_client": ops_per_client,
+                "connections": n_conns,
+                "rounds": rounds,
+                "overhead_pct": round(max(0.0, median(deltas)), 2),
+                "deltas_pct": [round(d, 2) for d in sorted(deltas)],
+                "instruments_on": arm(on_segs),
+                "instruments_off": arm(off_segs),
+            }
+        finally:
+            if mux is not None:
+                mux.close()
+            server.stop()
+            cluster.shutdown()
 
 
 def run_mux_overload_pair(n_clients: int = 10000,

@@ -108,19 +108,31 @@ def from_trace_dump(doc) -> dict:
         if rec is not None:
             per_class.setdefault(rec["op_class"], []).append(rec)
     classes: dict = {}
+    sampled = False
     for cls, recs in sorted(per_class.items()):
-        totals = sorted(r["total_s"] for r in recs)
+        # sample-weight de-bias (tracer head sampling, ISSUE 18): each
+        # record stands for w ops; percentiles walk cumulative weight
+        # and phase fractions scale by it, so a 1%-sampled dump reports
+        # the same rates an unsampled one would
+        pairs = sorted((r["total_s"], r.get("w", 1.0)) for r in recs)
+        wsum = sum(w for _v, w in pairs)
+        if any(w != 1.0 for _v, w in pairs):
+            sampled = True
         agg: dict[str, float] = {}
         for r in recs:
+            rw = r.get("w", 1.0)
             for p, v in r["phases"].items():
-                agg[p] = agg.get(p, 0.0) + v
+                agg[p] = agg.get(p, 0.0) + v * rw
         whole = sum(agg.values())
         classes[cls] = {
-            "p99_ms": round(pctl.nearest_rank(totals, 99) * 1e3, 3),
+            "p99_ms": round(
+                pctl.weighted_nearest_rank(pairs, 99) * 1e3, 3),
             "ops": len(recs),
+            "weighted_ops": round(wsum, 1),
             "phases": {p: round(v / whole, 4) if whole else 0.0
                        for p, v in agg.items()}}
-    return {"source": "trace", "classes": classes, "burn": {}}
+    return {"source": "trace", "sampled": sampled, "classes": classes,
+            "burn": {}}
 
 
 def build_report(doc) -> dict:
@@ -142,6 +154,9 @@ def build_report(doc) -> dict:
 
 def render(report: dict) -> str:
     lines = [f"latency attribution ({report['source']} artifact):"]
+    if report.get("sampled"):
+        lines.append("  (head-sampled dump: percentiles and phase mixes "
+                     "weighted by sample_weight)")
     if not report["classes"]:
         lines.append("  no per-class records")
     for cls, entry in sorted(report["classes"].items()):

@@ -57,14 +57,32 @@ def percentile_us(durs_us: list[float], q: float) -> float:
     return _pctl.percentile(durs_us, q)
 
 
+def percentile_us_w(pairs: list[tuple], q: float) -> float:
+    """Weighted nearest-rank over (duration_us, sample_weight) pairs —
+    identical to :func:`percentile_us` when every weight is 1.0."""
+    return _pctl.weighted_nearest_rank(sorted(pairs), q)
+
+
+def event_weight(ev: dict) -> float:
+    """The event's sample weight (1/rate stamped by the tracer's head
+    sampler; 1.0 for unsampled-era and promoted events)."""
+    try:
+        w = float(ev.get("args", {}).get("sample_weight", 1.0))
+    except (TypeError, ValueError):
+        return 1.0
+    return w if w > 0.0 else 1.0
+
+
 def self_times(events: list[dict]) -> dict[str, dict]:
-    """name -> {count, total_us, self_us, durs_us}; nesting resolved per
-    (pid, tid) with a containment stack sweep over ts-sorted complete
-    events.  ``durs_us`` holds every occurrence's total duration (the
-    p50/p99 source)."""
+    """name -> {count, weight, total_us, self_us, durs_us, wdurs};
+    nesting resolved per (pid, tid) with a containment stack sweep over
+    ts-sorted complete events.  ``durs_us`` holds every occurrence's
+    total duration (the p50/p99 source); ``wdurs`` pairs each with its
+    sample weight and ``weight`` sums them (the de-biased op-count
+    estimate for head-sampled dumps)."""
     agg: dict[str, dict] = defaultdict(
-        lambda: {"count": 0, "total_us": 0.0, "self_us": 0.0,
-                 "durs_us": []})
+        lambda: {"count": 0, "weight": 0.0, "total_us": 0.0,
+                 "self_us": 0.0, "durs_us": [], "wdurs": []})
     by_track: dict[tuple, list[dict]] = defaultdict(list)
     for ev in events:
         by_track[(ev.get("pid"), ev.get("tid"))].append(ev)
@@ -81,13 +99,23 @@ def self_times(events: list[dict]) -> dict[str, dict]:
             if stack:                   # nested: charge the parent less
                 parent = agg[stack[-1]["name"]]
                 parent["self_us"] -= dur
+            w = event_weight(ev)
             a = agg[ev["name"]]
             a["count"] += 1
+            a["weight"] += w
             a["total_us"] += dur
             a["self_us"] += dur
             a["durs_us"].append(dur)
+            a["wdurs"].append((dur, w))
             stack.append(ev)
     return dict(agg)
+
+
+def is_sampled(agg: dict[str, dict]) -> bool:
+    """True when any row carries a non-unit sample weight (the dump came
+    from a head-sampled tracer and percentiles are weight-de-biased)."""
+    return any(abs(a.get("weight", a["count"]) - a["count"]) > 1e-9
+               for a in agg.values())
 
 
 def render_table(agg: dict[str, dict], limit: int = 0) -> str:
@@ -96,17 +124,23 @@ def render_table(agg: dict[str, dict], limit: int = 0) -> str:
     if limit:
         rows = rows[:limit]
     width = max([len("span")] + [len(name) for name, _ in rows])
-    lines = [f"{'span':<{width}}  {'count':>7}  {'total ms':>10}  "
-             f"{'self ms':>10}  {'avg ms':>9}  {'p50 ms':>9}  "
-             f"{'p99 ms':>9}"]
+    lines = []
+    if is_sampled(agg):
+        est = round(sum(a.get("weight", a["count"]) for _n, a in rows))
+        n = sum(a["count"] for _n, a in rows)
+        lines.append(f"sampled trace: p50/p99 weighted by sample_weight "
+                     f"(~{est} ops estimated from {n} recorded spans)")
+    lines.append(f"{'span':<{width}}  {'count':>7}  {'total ms':>10}  "
+                 f"{'self ms':>10}  {'avg ms':>9}  {'p50 ms':>9}  "
+                 f"{'p99 ms':>9}")
     for name, a in rows:
         avg = a["total_us"] / a["count"] / 1e3 if a["count"] else 0.0
-        durs = a.get("durs_us", [])
+        pairs = a.get("wdurs") or [(d, 1.0) for d in a.get("durs_us", [])]
         lines.append(
             f"{name:<{width}}  {a['count']:>7}  "
             f"{a['total_us'] / 1e3:>10.3f}  {a['self_us'] / 1e3:>10.3f}  "
-            f"{avg:>9.3f}  {percentile_us(durs, 50) / 1e3:>9.3f}  "
-            f"{percentile_us(durs, 99) / 1e3:>9.3f}")
+            f"{avg:>9.3f}  {percentile_us_w(pairs, 50) / 1e3:>9.3f}  "
+            f"{percentile_us_w(pairs, 99) / 1e3:>9.3f}")
     return "\n".join(lines)
 
 
@@ -119,18 +153,20 @@ def render_json(agg: dict[str, dict], limit: int = 0) -> str:
         rows = rows[:limit]
     spans = []
     for name, a in rows:
-        durs = a.get("durs_us", [])
+        pairs = a.get("wdurs") or [(d, 1.0) for d in a.get("durs_us", [])]
         spans.append({
             "name": name,
             "count": a["count"],
+            "est_count": round(a.get("weight", a["count"]), 1),
             "total_ms": round(a["total_us"] / 1e3, 6),
             "self_ms": round(a["self_us"] / 1e3, 6),
             "avg_ms": round(a["total_us"] / a["count"] / 1e3, 6)
             if a["count"] else 0.0,
-            "p50_ms": round(percentile_us(durs, 50) / 1e3, 6),
-            "p99_ms": round(percentile_us(durs, 99) / 1e3, 6),
+            "p50_ms": round(percentile_us_w(pairs, 50) / 1e3, 6),
+            "p99_ms": round(percentile_us_w(pairs, 99) / 1e3, 6),
         })
-    return json.dumps({"spans": spans, "num_spans": len(spans)})
+    return json.dumps({"spans": spans, "num_spans": len(spans),
+                       "sampled": is_sampled(agg)})
 
 
 def _track_names(all_events: list[dict]) -> dict:
