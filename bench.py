@@ -308,6 +308,7 @@ _EFFICIENCY: dict | None = None  # the roofline device-efficiency block
 _RESILIENCE: dict | None = None  # goodput under faults + breaker fallback
 _SLO: dict | None = None         # critical-path attribution + budget block
 _LINT: dict | None = None        # ceph-lint static-analysis summary block
+_TIERING: dict | None = None     # hot-tier cold/warm flash-crowd block
 
 
 def _pipeline_pass(sinfo, ec, batches, degraded, depth: int,
@@ -1017,6 +1018,38 @@ def slo_section(platform: str | None) -> dict:
         return {"device": "none", "error": repr(e)[:200]}
 
 
+def tiering_section(platform: str | None) -> dict:
+    """The `tiering` block (ROADMAP 7): a flash crowd — 90% of arrivals
+    collapsing onto 0.1% of the keyspace — of mixed reads/writes from
+    10k mux clients, served cold (straight off the EC base pool) and
+    then warm (through a writeback cache tier, after one warmup pass of
+    the identical stream).  tools/perf_gate.py gates the warm hit rate
+    (>= 0.8), warm-over-cold p99 (<= 1.0) and warm-over-cold
+    device-time-per-op: the tier must actually absorb the crowd, not
+    just sit in the path."""
+    try:
+        from tools.rados_bench import run_tier_mux_bench
+        device = "jax" if platform is not None else "numpy"
+        with phase("tiering"):
+            # the run resets the process tracer ring (its device
+            # seconds are per-segment critpath deltas) — safe here:
+            # slo_section already folded and captured its own block
+            res = run_tier_mux_bench(
+                n_clients=int(os.environ.get("BENCH_TIER_CLIENTS",
+                                             10000)),
+                ops_per_client=1, n_objects=1000, object_bytes=2048,
+                device=device, timeout_s=240.0)
+        # the gate compares like-for-like devices across artifacts:
+        # carry the codec arg separately and mark the block with the
+        # platform vocabulary every other block uses
+        res["codec_device"] = res.pop("device")
+        res["device"] = "tpu" if platform == "tpu" else "cpu"
+        return res
+    except Exception as e:                 # never fail the artifact
+        print(f"# tiering bench failed: {e!r}", file=sys.stderr)
+        return {"device": "none", "error": repr(e)[:200]}
+
+
 def efficiency_section(platform: str | None) -> dict:
     """The roofline ledger the sections above populated (every
     traced_jit dispatch recorded its measured seconds next to its
@@ -1096,6 +1129,8 @@ def emit(value, vs_baseline, extra):
         line.setdefault("slo", _SLO)
     if _LINT is not None:
         line.setdefault("lint", _LINT)
+    if _TIERING is not None:
+        line.setdefault("tiering", _TIERING)
     # always carried, even on the watchdog/fallback paths: the per-phase
     # breakdown and the per-attempt probe record accumulated so far.  A
     # phase still OPEN when the watchdog fires is exactly the one that
@@ -1293,7 +1328,7 @@ def main() -> int:
     # is up — its own subsystem, measured before the device codec pass so
     # a tunnel death mid-codec still leaves the serving block in the line
     global _SERVING, _OBSERVABILITY, _RECOVERY, _PIPELINE, _EFFICIENCY, \
-        _RESILIENCE, _SLO, _LINT
+        _RESILIENCE, _SLO, _LINT, _TIERING
     # static-analysis trajectory first: pure AST work, no device needed,
     # so even a probe/tunnel death right after still carries the block
     _LINT = lint_section()
@@ -1312,6 +1347,9 @@ def main() -> int:
     _RESILIENCE = resilience_section(platform)
     # critical-path attribution + SLO budget over a loaded cluster pass
     _SLO = slo_section(platform)
+    # hot-tier flash crowd, cold vs warm, at mux-client scale (after
+    # slo: the run resets the tracer ring slo folds from)
+    _TIERING = tiering_section(platform)
     # the roofline efficiency block reads the ledger the sections above
     # populated — computed here so a codec-pass death still carries it
     _EFFICIENCY = efficiency_section(platform)

@@ -108,7 +108,8 @@ def check_no_host_sync(index: ProjectIndex) -> list[Finding]:
 
 # ------------------------------------------------- 2. unbounded-queue
 
-_QUEUE_SCOPE = ("ceph_tpu/exec", "ceph_tpu/recovery")
+_QUEUE_SCOPE = ("ceph_tpu/exec", "ceph_tpu/recovery",
+                "ceph_tpu/tier")
 _QUEUE_CTORS = {"Queue", "LifoQueue", "PriorityQueue"}
 
 
@@ -319,7 +320,8 @@ def check_bounded_retry(index: ProjectIndex) -> list[Finding]:
 
 # ------------------------------------------------- 6. span-owner
 
-_SPAN_SCOPE = ("ceph_tpu/exec", "ceph_tpu/recovery")
+_SPAN_SCOPE = ("ceph_tpu/exec", "ceph_tpu/recovery",
+               "ceph_tpu/tier")
 _SPAN_CALLS = {"trace_span", "span"}
 
 
@@ -354,7 +356,7 @@ def check_span_owner(index: ProjectIndex) -> list[Finding]:
 # ------------------------------------------------- 7. span-phase
 
 _PHASE_SCOPE = ("ceph_tpu/exec", "ceph_tpu/recovery",
-                "ceph_tpu/ops/pipeline.py")
+                "ceph_tpu/ops/pipeline.py", "ceph_tpu/tier")
 _PHASE_CALLS = {"trace_span", "span", "complete"}
 
 
@@ -557,7 +559,7 @@ def check_percentile_redef(index: ProjectIndex) -> list[Finding]:
 # ------------------------------------------------- 12. wire-sizer
 
 MESSAGE_MODULES = ("ceph_tpu/backend/messages.py", "ceph_tpu/net.py",
-                   "ceph_tpu/msg/proto.py")
+                   "ceph_tpu/msg/proto.py", "ceph_tpu/tier")
 # message-shaped dataclasses that never ride a channel
 NOT_WIRE_MESSAGES = {"FaultConfig"}
 

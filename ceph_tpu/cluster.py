@@ -214,6 +214,10 @@ class MiniCluster:
         # optional fault injection campaign (inject_faults): one seeded
         # FaultInjector spanning bus/store/device planes
         self.fault_injector = None
+        # cache tiers (create_tier): cache pool id -> (TierService,
+        # TierAgent); the TIER_* health checks register lazily with the
+        # first tier (the enable_recovery_scheduler discipline)
+        self.tiers: dict[int, tuple] = {}
         # telemetry spine (mgr/stats + mgr/health + flight recorder):
         # status() renders the stats digest, health() is a thin view over
         # the check engine, and any check entering WARN/ERR snapshots a
@@ -325,6 +329,19 @@ class MiniCluster:
             self.cct.admin_socket.unregister(cmd)
             self.cct.admin_socket.register(cmd, self._slo_admin_fns[cmd],
                                            desc)
+
+        # object-granularity heat (the tier agent's promotion surface):
+        # `heat top [n]` folds the per-PG hit sets into a bounded top-N
+        # hot-object digest (mgr/heat.py:top_objects)
+        def _heat_top(n=20, **kw):
+            from .mgr.heat import top_objects
+            return {"top": top_objects(self, int(n))}
+        self._slo_admin_fns["heat top"] = _heat_top
+        self.cct.admin_socket.unregister("heat top")
+        self.cct.admin_socket.register(
+            "heat top", _heat_top,
+            "top-N hottest objects by hit-set membership "
+            "(object-granularity heat under the PG/OSD maps)")
 
     def _slo_flight_source(self) -> dict:
         self.critpath.refresh()
@@ -553,6 +570,80 @@ class MiniCluster:
         self.recovery.attach_backend(
             g.backend, pgid=g.pgid, daemon=self.osds[g.backend.whoami],
             pool_params=pool.params)
+
+    # -- cache tiering (tier/) ---------------------------------------------
+
+    def create_tier(self, cache_pool: int, base_pool: int, *,
+                    mode: str = "writeback", frontend=None):
+        """Bind a replicated cache pool over an EC base pool (the mon's
+        ``osd tier add`` + ``cache-mode``): returns the
+        :class:`~ceph_tpu.tier.TierService` with its flush/evict agent
+        attached as ``.agent``.  The ``TIER_FULL`` /
+        ``TIER_FLUSH_BACKLOG`` health checks and the ``tier status``
+        admin command register with the FIRST tier (lazily, the
+        enable_recovery_scheduler discipline: clusters without tiering
+        never evaluate them)."""
+        from .tier import TierAgent, TierService
+        if cache_pool in self.tiers:
+            raise ValueError(f"pool {cache_pool} is already a cache tier")
+        svc = TierService(self, cache_pool, base_pool, mode=mode,
+                          frontend=frontend,
+                          name=f"c{self.cluster_id}.p{cache_pool}")
+        svc.agent = TierAgent(svc)
+        first = not self.tiers
+        self.tiers[cache_pool] = (svc, svc.agent)
+        if first:
+            from .mgr.health import (tier_flush_backlog_check,
+                                     tier_full_check)
+            self.health_engine.register(
+                "TIER_FULL", tier_full_check(lambda: self.tiers),
+                description="a cache tier's residency is at/over its "
+                            "tier_full_ratio watermark")
+            self.health_engine.register(
+                "TIER_FLUSH_BACKLOG",
+                tier_flush_backlog_check(lambda: self.tiers),
+                description="a tier agent keeps ending its passes over "
+                            "tier_dirty_ratio_high: the base pool is "
+                            "not absorbing flushes fast enough")
+
+            def _tier_status(**kw):
+                return {str(pid): s.stats()
+                        for pid, (s, _a) in sorted(self.tiers.items())}
+            self._slo_admin_fns["tier status"] = _tier_status
+            self.cct.admin_socket.unregister("tier status")
+            self.cct.admin_socket.register(
+                "tier status", _tier_status,
+                "per-tier cache mode, residency, hit rate, and "
+                "promotion/flush/evict counters")
+        self.clusterlog.info(
+            f"pool {cache_pool} is now a {mode} cache tier over pool "
+            f"{base_pool}", channel="mon")
+        return svc
+
+    # -- pool parameter updates (the mon's 'osd pool set') ------------------
+
+    def pool_set(self, pool_id: int, key: str, value) -> None:
+        """``ceph osd pool set <pool> <key> <value>``: update one pool
+        param LIVE and persist it.  The ``hit_set_*`` family re-arms
+        per-PG hit-set accumulation in place (the observer hook pool
+        params get in lieu of ConfigProxy observers): the accumulating
+        set restarts under the new geometry, the persisted archive ring
+        is resumed, and ``hit_set_count 0`` disarms tracking."""
+        if pool_id not in self.pools:
+            raise KeyError(f"no pool {pool_id}")
+        pool = self.pools[pool_id]["pool"]
+        pool.params[key] = str(value)
+        if key in ("hit_set_count", "hit_set_period",
+                   "hit_set_target_size", "hit_set_fpp"):
+            for g in self.pools[pool_id]["pgs"].values():
+                if int(pool.params.get("hit_set_count", 0)) > 0:
+                    self._arm_hit_sets(g, pool)
+                else:
+                    g.engine.hit_set = None
+                    g.engine.hit_set_params = None
+        self.clusterlog.info(
+            f"pool '{pool.name}' set {key} = {value}", channel="mon")
+        self._save_meta()
 
     # -- fault injection (failure/) ----------------------------------------
 
@@ -1411,6 +1502,9 @@ class MiniCluster:
         if self.fault_injector is not None:
             self.fault_injector.close()
             self.fault_injector = None
+        for svc, _agent in self.tiers.values():
+            svc.close()
+        self.tiers.clear()
         # telemetry spine down FIRST: a prometheus scrape racing the
         # teardown must not evaluate checks over half-closed PGs
         self.stats.close()

@@ -158,6 +158,18 @@ SPAN_PHASES: dict[str, str] = {
     "osd.op": OTHER,
     "serving.op": OTHER,
     "backfill.pg": OTHER,
+    # cache tier (tier/service.py): the proxy read forwards across the
+    # tier boundary to the base pool (wire-shaped hop); promotion,
+    # writeback flush, and eviction are data-movement orchestration
+    # whose leaf work (codec, store) claims its own phases
+    "tier.read": OTHER,
+    "tier.write": OTHER,
+    "tier.agent": OTHER,
+    "tier.proxy_read": WIRE,
+    "tier.proxy_write": WIRE,
+    "tier.promote": DISPATCH,
+    "tier.flush": DISPATCH,
+    "tier.evict": DISPATCH,
     # the dmClock-class background roots (osd_daemon.queue_background)
     "osd.client": OTHER,
     "osd.serving": OTHER,

@@ -488,6 +488,46 @@ OPTIONS: list[Option] = [
            description="minimum ops in BOTH burn windows before the SLO "
                        "checks can page (an idle class holds no "
                        "evidence either way)"),
+    # -- cache tiering (tier/) ---------------------------------------------
+    Option("tier_promote_min_recency", TYPE_UINT, LEVEL_ADVANCED,
+           default=2, min=0,
+           description="consecutive most-recent hit sets a missed "
+                       "object must appear in before the proxy read "
+                       "also promotes it into the cache pool "
+                       "(min_read_recency_for_promote; 0 promotes on "
+                       "first touch, higher values stop one-shot scans "
+                       "from thrashing the tier)"),
+    Option("tier_dirty_ratio_high", TYPE_FLOAT, LEVEL_ADVANCED,
+           default=0.6, min=0.0, max=1.0,
+           description="dirty objects over tier_target_max_objects "
+                       "past which the agent arms flush mode "
+                       "(cache_target_dirty_high_ratio)",
+           see_also=["tier_dirty_ratio_low", "tier_target_max_objects"]),
+    Option("tier_dirty_ratio_low", TYPE_FLOAT, LEVEL_ADVANCED,
+           default=0.4, min=0.0, max=1.0,
+           description="flush mode disarms once the dirty fraction "
+                       "drops under this (hysteresis below "
+                       "tier_dirty_ratio_high: the next absorbed write "
+                       "does not immediately re-arm the agent)",
+           see_also=["tier_dirty_ratio_high"]),
+    Option("tier_full_ratio", TYPE_FLOAT, LEVEL_ADVANCED,
+           default=0.8, min=0.0, max=1.0,
+           description="resident objects over tier_target_max_objects "
+                       "past which the agent evicts cold clean objects "
+                       "(cache_target_full_ratio) and TIER_FULL raises",
+           see_also=["tier_target_max_objects"]),
+    Option("tier_target_max_objects", TYPE_UINT, LEVEL_ADVANCED,
+           default=256, min=1,
+           description="capacity target of the RAM-resident cache pool "
+                       "in objects: the denominator of every tier "
+                       "watermark (target_max_objects)",
+           see_also=["tier_full_ratio", "tier_dirty_ratio_high"]),
+    Option("tier_agent_max_ops", TYPE_UINT, LEVEL_ADVANCED,
+           default=16, min=1,
+           description="flush/evict operations one agent pass may "
+                       "issue (osd_agent_max_ops): the agent shares "
+                       "the cluster with clients and must not convoy "
+                       "them"),
     Option("log_file", TYPE_STR, LEVEL_BASIC, default="",
            description="path to log file"),
     Option("log_max_recent", TYPE_UINT, LEVEL_ADVANCED, default=500,

@@ -216,3 +216,39 @@ def hot_shard_check(tracker: HeatTracker, cct):
             f"median {med:.0f})",
             detail=detail, count=len(hot))
     return check
+
+
+def top_objects(cluster, n: int = 20) -> list[dict]:
+    """Bounded top-N hot-OBJECT digest folded from the per-PG hit sets
+    — object granularity under the PG/OSD heat maps above, and the
+    tier agent's promotion-evidence surface (`heat top`).
+
+    Bloom hit sets cannot enumerate their members, so candidates come
+    from the cluster's object bookkeeping and each is membership-tested
+    against its PG's current + archived sets
+    (``object_temperature``).  Only pools with hit sets armed
+    contribute; the result is bounded by a heap, never by truncating a
+    sort of the whole namespace."""
+    import heapq
+    from ..osd.hit_set import is_hit_set_oid
+    scored = []
+    for pid, oids in sorted(cluster.objects.items()):
+        engines = {}          # pg ps -> engine (one hit-set probe setup)
+        for oid in sorted(oids):
+            if is_hit_set_oid(oid):
+                continue
+            ps = cluster.object_pg(pid, oid)
+            eng = engines.get(ps)
+            if eng is None:
+                eng = engines[ps] = \
+                    cluster.pools[pid]["pgs"][ps].engine
+            if eng.hit_set_params is None:
+                continue
+            t = eng.object_temperature(oid)
+            if t > 0:
+                scored.append((t, f"{pid}/{oid}", pid, oid))
+    # nlargest == sorted(..., reverse=True)[:n] and is STABLE: equal
+    # temperatures keep the pool/oid iteration order (alphabetical)
+    top = heapq.nlargest(int(n), scored, key=lambda rec: rec[0])
+    return [{"pool": pid, "oid": oid, "temperature": t}
+            for t, _, pid, oid in top]

@@ -267,6 +267,42 @@ def _heat_gauges(family, prefix: str) -> None:
                         f'stat="{stat}"}} {rec[stat]}')
 
 
+def _tier_gauges(family, prefix: str) -> None:
+    """``ceph_tpu_tier_ops{owner,op}`` /
+    ``ceph_tpu_tier_state{owner,stat}`` — every live cache tier's
+    promotion/flush/evict counters plus residency, dirtiness, and hit
+    rate (tier/service.py): the before/after instrument for the
+    hot-tier loop (ROADMAP item 7)."""
+    try:
+        from ..tier import live_tier_services
+    except Exception:                       # pragma: no cover
+        return
+    ops_fam = state_fam = None
+    for svc in sorted(live_tier_services(), key=lambda s: s.name):
+        owner = _sanitize(svc.name)
+        for op in ("hit", "miss", "proxy_read", "proxy_write", "promote",
+                   "promote_skip", "writeback", "flush", "evict",
+                   "invalidate"):
+            if ops_fam is None:
+                ops_fam = family(f"{prefix}_tier_ops", "counter",
+                                 "cache-tier operations by kind "
+                                 "(tier/service.py)")
+            ops_fam.lines.append(
+                f'{prefix}_tier_ops{{owner="{owner}",op="{op}"}} '
+                f'{int(svc.perf.get(op))}')
+        st = svc.stats()
+        for stat, v in (("objects", st["objects"]),
+                        ("dirty", svc.perf.get("dirty")),
+                        ("hit_rate", round(st["hit_rate"], 6))):
+            if state_fam is None:
+                state_fam = family(f"{prefix}_tier_state", "gauge",
+                                   "cache-tier residency, dirtiness, "
+                                   "and hit rate")
+            state_fam.lines.append(
+                f'{prefix}_tier_state{{owner="{owner}",'
+                f'stat="{stat}"}} {v}')
+
+
 def _slo_gauges(family, prefix: str) -> None:
     """``ceph_tpu_slo_budget{owner,class,stat}`` — every live
     SLOTracker's per-class objective state: the configured p99 bound,
@@ -437,6 +473,7 @@ def render(cct=None, prefix: str = "ceph_tpu") -> str:
     _device_efficiency_gauges(family, prefix, eff_snap)
     _wire_gauges(family, prefix)
     _heat_gauges(family, prefix)
+    _tier_gauges(family, prefix)
 
     span_metric = f"{prefix}_span_latency_seconds"
     hists = default_tracer().histograms()

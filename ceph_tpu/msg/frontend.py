@@ -112,6 +112,17 @@ class ShardedFrontend:
             self.routed[sid] += 1
         return sid, eng
 
+    def serve_read(self, name: str, reader, op_class: str = CLIENT_OP):
+        """Admit one already-resident read (a cache-tier hit) through
+        the owning shard's shed ladder, then run ``reader()`` inline;
+        returns ``(shard_id, reader())``.  The hit costs no codec
+        dispatch, but it still competes for admission — an overloaded
+        shard sheds tier hits by class exactly like codec work (raises
+        :class:`FrontendBusy`) instead of letting the "free" path
+        bypass overload control."""
+        sid, _eng = self._admit(name, op_class)
+        return sid, reader()
+
     def submit_encode(self, name: str, buf, op_class: str = CLIENT_OP,
                       **kw):
         """Admit one encode on the owning shard; returns

@@ -452,7 +452,7 @@ class ClusterServer:
     # cache (4 MiB gets x 4096 entries) for hits that barely happen
     IDEMPOTENT_RPCS = frozenset(
         {"get", "stat", "ls", "pools", "status", "health", "getxattr",
-         "ping"})
+         "ping", "tier_read"})
 
     def inject_faults(self, injector) -> None:
         """Arm (or, with None, disarm) transport-plane fault injection:
@@ -722,10 +722,45 @@ class ClusterServer:
     def _rpc_health(self, ch):
         return self.cluster.health()
 
-    def _rpc_ping(self, ch, payload=None):
+    def _rpc_ping(self, ch, payload=None, key=None):
         """Echo: the serving-path microbenchmark op (rados_bench mux
-        mode) — round-trips the transport without touching the cluster."""
+        mode) — round-trips the transport without touching the cluster.
+        ``key`` carries the workload generator's object key (zipf /
+        flash-crowd streams) so key-addressed load shapes ride the real
+        wire format; the echo ignores it."""
         return payload
+
+    def _rpc_tier_read(self, ch, pool, key):
+        """Tiered read: when ``pool`` is a cache tier, serve ``key``
+        through it (hit / proxy / recency-gated promote — the
+        flash-crowd serving op); otherwise read straight from the pool
+        with the tier's own base op vector, so the tiering bench's cold
+        arm measures the exact path a miss proxies to.  Idempotent: a
+        promotion is a copy-up, so re-executing on a resend is safe."""
+        c = self.cluster
+        pid = c.pool_ids[pool]
+        tier = c.tiers.get(pid)
+        if tier is not None:
+            return tier[0].read(key)
+        from .osd.osd_ops import ObjectOperation
+        r = c.operate(pid, key, ObjectOperation().read(0, 0).getxattrs())
+        return bytes(r.ops[0].outdata)
+
+    def _rpc_tier_write(self, ch, pool, key, payload):
+        """Tiered write: absorbed by the cache tier bound over ``pool``
+        (writeback marks dirty, proxy forwards, readonly refuses) or
+        written straight to the pool when no tier is bound — the cold
+        arm's EC full-stripe write, encode and all.  Replay-deduped
+        like ``put`` (NOT in IDEMPOTENT_RPCS)."""
+        c = self.cluster
+        pid = c.pool_ids[pool]
+        tier = c.tiers.get(pid)
+        if tier is not None:
+            tier[0].write(key, bytes(payload))
+            return len(payload)
+        from .osd.osd_ops import ObjectOperation
+        c.operate(pid, key, ObjectOperation().write_full(bytes(payload)))
+        return len(payload)
 
     def _rpc_watch(self, ch, pool, oid, cookie):
         from .osd.osd_ops import ObjectOperation

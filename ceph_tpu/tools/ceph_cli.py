@@ -100,7 +100,8 @@ def main(argv=None) -> int:
                          "log last [N] | watch | -w | flight dump | "
                          "slo status | slo dump | "
                          "device roofline | device profile status | "
-                         "osd tree | osd df | pg dump | df")
+                         "osd pool set POOL KEY VALUE | heat top [N] | "
+                         "tier status | osd tree | osd df | pg dump | df")
     args = ap.parse_args(argv)
 
     import os
@@ -208,6 +209,34 @@ def main(argv=None) -> int:
                                      and gobj.oid != PG_META)
                 st = "up" if c.osdmap.is_up(o) else "down"
                 print(f"osd.{o:<4} {st:<6} {n_obj} shard objects")
+        elif args.cmd[:3] == ["osd", "pool", "set"] and len(args.cmd) == 6:
+            # `ceph osd pool set <pool> <key> <value>` — live-tunable pool
+            # params; hit_set_* keys re-arm the hit-set engines in place
+            name, key, value = args.cmd[3:]
+            if name not in c.pool_ids:
+                print(f"error: no pool {name!r}", file=sys.stderr)
+                return 2
+            c.pool_set(c.pool_ids[name], key, value)
+            print(f"set pool {name} {key} to {value}")
+        elif args.cmd[:2] == ["heat", "top"]:
+            n = int(args.cmd[2]) if len(args.cmd) > 2 else 20
+            rows = c.cct.admin_socket.call("heat top", n=n)["top"]
+            print("POOL/OID                       TEMPERATURE")
+            for r in rows:
+                print(f"{r['pool']}/{r['oid']:<28} {r['temperature']}")
+        elif cmd == "tier status":
+            import json as _json
+            try:
+                print(_json.dumps(c.cct.admin_socket.call(cmd),
+                                  indent=2, default=str))
+            except KeyError:
+                # the admin command registers with the first
+                # create_tier — a tier is a RUNTIME binding, so a
+                # reopened CLI process has none until one is bound
+                print("no cache tiers bound in this process "
+                      "(bind one with MiniCluster.create_tier)",
+                      file=sys.stderr)
+                return 2
         elif cmd == "pg dump":
             print(render_pg_dump(c))
         elif cmd == "df":

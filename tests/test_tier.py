@@ -1,0 +1,354 @@
+"""The tier/ subsystem: promote/proxy/flush/evict over (cache, base)
+pool bindings (reference: src/osd/PrimaryLogPG.cc maybe_handle_cache /
+agent_work, src/osd/TierAgentState.h; the mon's `osd tier add` +
+`cache-mode` surface is MiniCluster.create_tier).
+
+Seed-level hit-set and xattr-dirty mechanics are covered by
+test_tiering.py; this file pins the SERVICE invariants the ISSUE names:
+promote→hit, evict→miss→re-promote, dirty flush ordering, writeback
+durability across a kill -9 restart with zero acked-write loss, the
+live-tunable hit_set_* pool params, and the TIER_* health checks."""
+import numpy as np
+import pytest
+
+from ceph_tpu.cluster import MiniCluster
+from ceph_tpu.common import Context
+from ceph_tpu.osd.osd_ops import ObjectOperation
+
+
+def _data(n, seed=0):
+    return np.random.default_rng(seed).integers(
+        0, 256, n, dtype=np.uint8).tobytes()
+
+
+def _mk(tmp_path=None, **conf):
+    cct = Context(overrides=conf) if conf else None
+    c = MiniCluster(n_osds=6, osds_per_host=2, chunk_size=512,
+                    cct=cct, data_dir=tmp_path)
+    base = c.create_ec_pool("base", {"k": "2", "m": "1",
+                                     "device": "numpy"}, pg_num=4)
+    cache = c.create_replicated_pool(
+        "cache", size=3, pg_num=4,
+        params={"hit_set_count": "2", "hit_set_period": "8"})
+    return c, cache, base
+
+
+@pytest.fixture
+def tiered():
+    c, cache, base = _mk(tier_promote_min_recency=1)
+    svc = c.create_tier(cache, base)
+    yield c, svc, cache, base
+    c.shutdown()
+
+
+class TestReadPath:
+    def test_miss_proxies_then_promotes_then_hits(self, tiered):
+        c, svc, cache, base = tiered
+        payload = _data(3000, 1)
+        c.operate(base, "obj", ObjectOperation().write_full(payload))
+        # cold read: not resident -> miss, proxied from the EC base,
+        # promoted (min_recency=1: the miss's own hit-set record counts)
+        assert svc.read("obj") == payload
+        ctr = svc.stats()["counters"]
+        assert (ctr["miss"], ctr["proxy_read"], ctr["promote"]) == (1, 1, 1)
+        assert "obj" in svc.resident()
+        # re-read: a HIT, the base pool is never touched again
+        assert svc.read("obj") == payload
+        ctr = svc.stats()["counters"]
+        assert ctr["hit"] == 1 and ctr["proxy_read"] == 1
+
+    def test_single_cold_read_does_not_promote_at_recency_2(self):
+        c, cache, base = _mk()          # default min_recency = 2
+        svc = c.create_tier(cache, base)
+        try:
+            c.operate(base, "o", ObjectOperation().write_full(b"x" * 64))
+            svc.read("o")               # recency 1: proxy only
+            ctr = svc.stats()["counters"]
+            assert ctr["promote"] == 0 and ctr["promote_skip"] == 1
+            assert "o" not in svc.resident()
+            # age the hit set into the archive ring, then re-read:
+            # current + newest archive both contain it -> recency 2
+            svc.agent.age()
+            svc.read("o")
+            assert svc.stats()["counters"]["promote"] == 1
+            assert "o" in svc.resident()
+        finally:
+            c.shutdown()
+
+    def test_absent_everywhere_raises_enoent(self, tiered):
+        _c, svc, _cache, _base = tiered
+        with pytest.raises(IOError):
+            svc.read("never-written")
+
+    def test_evict_then_miss_then_repromote(self, tiered):
+        c, svc, cache, base = tiered
+        payload = _data(900, 3)
+        c.operate(base, "e", ObjectOperation().write_full(payload))
+        assert svc.read("e") == payload             # promoted
+        svc.evict("e")
+        with pytest.raises(IOError):
+            c.operate(cache, "e", ObjectOperation().stat())
+        assert svc.read("e") == payload             # miss -> re-promote
+        ctr = svc.stats()["counters"]
+        assert ctr["miss"] == 2 and ctr["promote"] == 2
+        c.operate(cache, "e", ObjectOperation().stat())
+
+
+class TestWritePath:
+    def test_writeback_absorbs_then_flush_orders_base_before_clean(
+            self, tiered):
+        c, svc, cache, base = tiered
+        payload = _data(2500, 7)
+        svc.write("w", payload)
+        assert svc.is_dirty("w")
+        with pytest.raises(IOError):          # not yet on the base
+            c.operate(base, "w", ObjectOperation().stat())
+        svc.flush("w")
+        # ordering invariant: by the time the dirty mark is gone the
+        # base MUST hold the bytes (flush commits base-first)
+        assert not svc.is_dirty("w")
+        r = c.operate(base, "w", ObjectOperation().read(0, 0))
+        assert bytes(r.ops[0].outdata)[:len(payload)] == payload
+        # and a re-flush of a clean object is idempotent (the crash
+        # window between base write and mark clear re-runs flush)
+        svc.flush("w")
+        r = c.operate(base, "w", ObjectOperation().read(0, 0))
+        assert bytes(r.ops[0].outdata)[:len(payload)] == payload
+
+    def test_readonly_mode_refuses_writes(self):
+        c, cache, base = _mk()
+        svc = c.create_tier(cache, base, mode="readonly")
+        try:
+            with pytest.raises(IOError) as ei:
+                svc.write("x", b"nope")
+            assert ei.value.errno == -30          # EROFS
+            # reads still proxy from the base
+            c.operate(base, "x", ObjectOperation().write_full(b"ro"))
+            assert svc.read("x")[:2] == b"ro"
+        finally:
+            c.shutdown()
+
+    def test_proxy_mode_forwards_and_invalidates(self):
+        c, cache, base = _mk(tier_promote_min_recency=1)
+        svc = c.create_tier(cache, base, mode="proxy")
+        try:
+            c.operate(base, "p", ObjectOperation().write_full(b"v1" * 32))
+            assert svc.read("p") == b"v1" * 32    # promoted copy resident
+            svc.write("p", b"v2" * 32)            # forwarded to the base
+            r = c.operate(base, "p", ObjectOperation().read(0, 0),
+                          internal=True)
+            assert bytes(r.ops[0].outdata)[:64] == b"v2" * 32
+            # the stale cached copy was dropped, not served
+            ctr = svc.stats()["counters"]
+            assert ctr["proxy_write"] == 1 and ctr["invalidate"] == 1
+            assert svc.read("p") == b"v2" * 32
+        finally:
+            c.shutdown()
+
+
+class TestAgent:
+    def test_flush_hysteresis_and_heat_ranked_evict(self, tiered):
+        c, svc, cache, base = tiered
+        conf = c.cct.conf
+        conf.set("tier_target_max_objects", 5)
+        conf.set("tier_dirty_ratio_high", 0.5)
+        conf.set("tier_dirty_ratio_low", 0.25)
+        for i in range(4):
+            svc.write(f"d{i}", _data(300 + i, i))
+        stats = svc.agent.tick()
+        # 4/5 dirty > 0.5 high: flush down to <= 0.25 low (1 left), not 0
+        assert stats["flushes"] == 3
+        assert stats["dirty_ratio"] <= 0.25
+        assert svc.agent.backlog_ticks == 0
+        # now keep d0 hot each period while the rest age cold: agent
+        # passes are the clock (hit sets are op-count-periodic), so
+        # ticks with age=True rotate heat out of the count=2 ring
+        conf.set("tier_full_ratio", 0.1)
+        total = {"evictions": 0, "skipped_hot": 0}
+        for _ in range(4):
+            assert svc.read("d0") == _data(300, 0)
+            stats = svc.agent.tick(age=True)
+            total["evictions"] += stats["evictions"]
+            total["skipped_hot"] += stats["skipped_hot"]
+        assert total["evictions"] >= 3            # the cold ones left
+        assert total["skipped_hot"] >= 1          # the hot one was spared
+        assert svc.resident() == ["d0"]
+        # evicted objects read back through the tier (base holds them)
+        assert svc.read("d2") == _data(302, 2)
+
+    def test_hard_full_overrides_hot_skip(self, tiered):
+        c, svc, _cache, _base = tiered
+        c.cct.conf.set("tier_target_max_objects", 1)
+        c.cct.conf.set("tier_full_ratio", 0.5)
+        svc.write("h0", b"a" * 64)
+        svc.write("h1", b"b" * 64)
+        svc.read("h0"), svc.read("h1")            # everything is hot
+        stats = svc.agent.tick(max_ops=16)
+        assert stats["evictions"] >= 1            # at hard capacity the
+        assert len(svc.resident()) <= 1           # agent stops being polite
+
+
+class TestWritebackDurability:
+    def test_kill9_restart_loses_no_acked_write(self, tmp_path):
+        """The writeback promise: an acked absorbed write IS durable.
+        Every transaction's WAL record is flushed to the OS before the
+        ack (backend/filestore.py _append_wal), so abandoning the
+        process image wholesale — no shutdown, no checkpoint, the
+        kill -9 analog — and rebooting from the directory must replay
+        every acked write, still dirty, and flushable to the base."""
+        c1, cache, base = _mk(tmp_path)
+        svc1 = c1.create_tier(cache, base)
+        payloads = {f"k{i}": _data(1200 + i, 40 + i) for i in range(6)}
+        for oid, p in payloads.items():
+            svc1.write(oid, p)                    # acked writebacks
+        del svc1, c1                              # kill -9: no shutdown
+
+        c2 = MiniCluster.load(tmp_path)
+        cache2, base2 = c2.pool_ids["cache"], c2.pool_ids["base"]
+        svc2 = c2.create_tier(cache2, base2)
+        try:
+            for oid, p in payloads.items():
+                assert svc2.read(oid) == p, f"acked write {oid} lost"
+                assert svc2.is_dirty(oid)         # dirty mark rode the WAL
+            # and the replayed dirty set flushes through the EC base
+            for oid in payloads:
+                svc2.flush(oid)
+            for oid, p in payloads.items():
+                r = c2.operate(base2, oid, ObjectOperation().read(0, 0))
+                assert bytes(r.ops[0].outdata)[:len(p)] == p
+        finally:
+            c2.shutdown()
+
+
+class TestPoolSetLiveTune:
+    def test_hit_set_params_rearm_live_and_persist(self, tmp_path):
+        c, cache, _base = _mk(tmp_path)
+        g = c.pg_group(cache, "o")
+        assert g.engine.hit_set_params["period"] == 8
+        c.pool_set(cache, "hit_set_period", 16)
+        c.pool_set(cache, "hit_set_count", 4)
+        c.pool_set(cache, "hit_set_target_size", 512)
+        for gg in c.pools[cache]["pgs"].values():
+            assert gg.engine.hit_set_params["period"] == 16
+            assert gg.engine.hit_set_params["count"] == 4
+        # accumulation continues under the new geometry
+        for i in range(20):
+            c.operate(cache, "o", ObjectOperation().write_full(b"x"))
+        assert g.engine.object_temperature("o") >= 1
+        c.shutdown()
+        # the retune is a POOL property: it survives restart
+        c2 = MiniCluster.load(tmp_path)
+        g2 = c2.pg_group(c2.pool_ids["cache"], "o")
+        assert g2.engine.hit_set_params["period"] == 16
+        assert g2.engine.hit_set_params["count"] == 4
+        c2.shutdown()
+
+    def test_hit_set_count_zero_disarms(self):
+        c, cache, _base = _mk()
+        try:
+            c.pool_set(cache, "hit_set_count", 0)
+            for g in c.pools[cache]["pgs"].values():
+                assert g.engine.hit_set_params is None
+            c.operate(cache, "o", ObjectOperation().write_full(b"x"))
+            assert c.pg_group(cache, "o").engine \
+                .object_temperature("o") == 0
+            c.pool_set(cache, "hit_set_count", 2)     # re-arm
+            c.operate(cache, "o", ObjectOperation().write_full(b"x"))
+            assert c.pg_group(cache, "o").engine \
+                .object_temperature("o") >= 1
+        finally:
+            c.shutdown()
+
+    def test_unknown_pool_raises(self):
+        c, _cache, _base = _mk()
+        try:
+            with pytest.raises(KeyError):
+                c.pool_set(999, "hit_set_count", 1)
+        finally:
+            c.shutdown()
+
+
+class TestTierHealth:
+    def test_tier_full_raises_and_clears(self, tiered):
+        c, svc, _cache, _base = tiered
+        c.cct.conf.set("tier_target_max_objects", 2)
+        c.cct.conf.set("tier_full_ratio", 0.5)
+        svc.write("f0", b"x" * 64)
+        svc.write("f1", b"y" * 64)
+        h = c.health()
+        assert "TIER_FULL" in h["checks"]
+        assert h["status"] != "HEALTH_OK"
+        # one funded pass drains it: at hard capacity the agent evicts
+        # hot objects too, and drives residency STRICTLY below the
+        # watermark so the check cannot stay latched
+        svc.agent.tick(max_ops=16)
+        assert "TIER_FULL" not in c.health()["checks"]
+
+    def test_flush_backlog_raises_and_clears(self, tiered):
+        c, svc, _cache, _base = tiered
+        c.cct.conf.set("tier_target_max_objects", 4)
+        c.cct.conf.set("tier_dirty_ratio_high", 0.25)
+        for i in range(3):
+            svc.write(f"b{i}", b"z" * 32)
+        # two zero-budget passes end over the high watermark: a STREAK
+        svc.agent.tick(max_ops=0)
+        assert "TIER_FLUSH_BACKLOG" not in c.health()["checks"]
+        svc.agent.tick(max_ops=0)
+        assert "TIER_FLUSH_BACKLOG" in c.health()["checks"]
+        # a funded pass drains the dirty set and the check clears
+        svc.agent.tick(max_ops=16)
+        assert svc.agent.backlog_ticks == 0
+        assert "TIER_FLUSH_BACKLOG" not in c.health()["checks"]
+
+
+class TestFrontendAdmission:
+    def test_overloaded_shard_sheds_tier_hits(self):
+        from ceph_tpu.msg.frontend import FrontendBusy, ShardedFrontend
+
+        class BusyEngine:
+            def depths(self):
+                return {"_total": 10_000}
+        c, cache, base = _mk(tier_promote_min_recency=1)
+        fe = ShardedFrontend({0: BusyEngine()}, queue_limit=4)
+        svc = c.create_tier(cache, base, frontend=fe)
+        try:
+            svc.write("s", b"q" * 16)   # resident (writes skip admission)
+            # the hit path is admission-gated: a saturated shard sheds
+            # it with EBUSY instead of letting "free" reads bypass
+            # overload control
+            with pytest.raises(FrontendBusy):
+                svc.read("s")
+        finally:
+            c.shutdown()
+
+
+class TestAdminSurfaces:
+    def test_tier_status_and_heat_top(self, tiered):
+        c, svc, cache, base = tiered
+        c.operate(base, "hot", ObjectOperation().write_full(b"h" * 32))
+        for _ in range(3):
+            svc.read("hot")
+        st = c.cct.admin_socket.call("tier status")
+        s = st[str(cache)]
+        assert s["mode"] == "writeback" and s["base_pool"] == base
+        assert s["counters"]["promote"] == 1
+        assert 0.0 < s["hit_rate"] < 1.0
+        top = c.cct.admin_socket.call("heat top", n=5)["top"]
+        assert any(r["oid"] == "hot" and r["temperature"] >= 1
+                   for r in top)
+        assert len(top) <= 5
+
+    def test_double_tier_binding_refused(self, tiered):
+        c, _svc, cache, base = tiered
+        with pytest.raises(ValueError):
+            c.create_tier(cache, base)
+
+    def test_prometheus_tier_families_render(self, tiered):
+        c, svc, _cache, base = tiered
+        c.operate(base, "m", ObjectOperation().write_full(b"m" * 16))
+        svc.read("m")
+        from ceph_tpu.mgr.prometheus import render
+        text = render(c.cct)
+        assert "ceph_tpu_tier_ops" in text
+        assert 'op="promote"' in text
+        assert "ceph_tpu_tier_state" in text

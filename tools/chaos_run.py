@@ -77,6 +77,156 @@ def _health_checks(cluster) -> set[str]:
     return set(cluster.health().get("checks", ()))
 
 
+def _tier_phase(cluster, mon, cct, base_pid, seed, ops, rng, now,
+                health_seen, say) -> dict:
+    """Cache tiering under chaos (tier/): a flash-crowd key stream
+    writes back through a replicated hot tier, the TIER_* checks raise
+    and clear, then TWO acting OSDs of one cache PG die — every read
+    still answers (degrading to base-pool proxies for the dead PG, the
+    no-loss invariant), and hits resume after the OSDs boot back."""
+    from tools.rados_bench import WorkloadKeys
+
+    cct.conf.set("tier_promote_min_recency", 1)
+    cache = cluster.create_replicated_pool(
+        "chaos_cache", size=3, pg_num=4,
+        params={"hit_set_count": "2", "hit_set_period": "16"})
+    svc = cluster.create_tier(cache, base_pid)
+
+    # flash crowd: zipf-skewed keys, half the mid-campaign arrivals
+    # collapsing onto the hottest 10% of the key space
+    keys = WorkloadKeys(n_keys=24, dist="zipf", zipf_s=1.1,
+                        flash=(0.5, 0.25, 0.5), hot_frac=0.1,
+                        seed=seed, prefix="t")
+    tier_model: dict[str, bytes] = {}
+    n_ops = max(40, ops)
+    for i in range(n_ops):
+        oid = keys.key(i / n_ops)
+        if oid not in tier_model or rng.random() < 0.3:
+            data = rng.randbytes(STRIPE)
+            svc.write(oid, data)                # acked writeback
+            tier_model[oid] = data
+        else:
+            assert svc.read(oid) == tier_model[oid], \
+                f"tier read of acked {oid} diverged"
+    assert svc.stats()["counters"]["hit"] > 0
+
+    # TIER_FLUSH_BACKLOG: two zero-budget agent passes end over the
+    # (tightened) high-dirty watermark, then a funded pass drains it
+    cct.conf.set("tier_target_max_objects", 4 * len(svc.resident()))
+    cct.conf.set("tier_dirty_ratio_high", 0.01)
+    cct.conf.set("tier_dirty_ratio_low", 0.0)
+    svc.agent.tick(max_ops=0)
+    svc.agent.tick(max_ops=0)
+    checks = _health_checks(cluster)
+    health_seen |= checks
+    assert "TIER_FLUSH_BACKLOG" in checks, \
+        f"starved tier agent did not raise a flush backlog: {checks}"
+    for _ in range(10):
+        if svc.agent.tick(max_ops=64)["dirty_ratio"] == 0.0:
+            break
+    assert "TIER_FLUSH_BACKLOG" not in _health_checks(cluster), \
+        "TIER_FLUSH_BACKLOG did not clear after the dirty set drained"
+
+    # TIER_FULL: residency at target raises, a hard-full pass clears
+    cct.conf.set("tier_target_max_objects", max(1, len(svc.resident())))
+    checks = _health_checks(cluster)
+    health_seen |= checks
+    assert "TIER_FULL" in checks, f"full tier did not raise: {checks}"
+    svc.agent.tick(max_ops=256)
+    assert "TIER_FULL" not in _health_checks(cluster), \
+        "TIER_FULL did not clear after the agent evicted"
+    cct.conf.set("tier_target_max_objects", 256)   # roomy again: the
+    # death drill below re-promotes, and that churn must not re-trip
+    # the full watermark we just proved clears
+
+    # tier OSD death: kill one cache PG's ENTIRE acting set (a single
+    # surviving replica still serves reads, so whole-set death is what
+    # forces the proxy degradation).  The victims must leave every base
+    # PG at most one member short (EC k=2 of 3 stays readable) and
+    # every other cache PG a survivor (replicated reads need one)
+    target_g = victims = None
+    for g in cluster.pools[cache]["pgs"].values():
+        trio = set(g.acting)
+        safe = all(len(trio & set(og.acting)) <= 1
+                   for og in cluster.pools[base_pid]["pgs"].values()) \
+            and all(len(trio & set(og.acting)) <= 2
+                    for og in cluster.pools[cache]["pgs"].values()
+                    if og is not g)
+        if safe:
+            target_g, victims = g, tuple(g.acting)
+            break
+    assert target_g is not None, "no safe victim set for tier OSD death"
+    affected = sorted(o for o in tier_model
+                      if cluster.pg_group(cache, o) is target_g)
+    if not affected:
+        # the skewed key stream missed the one safe PG: pin a couple of
+        # acked writebacks onto it, flushed CLEAN before the deaths (a
+        # dirty object whose only copies die with the cache PG is the
+        # loss writeback mode legitimately cannot prevent)
+        for j in range(256):
+            oid = f"pin{j:04d}"
+            if cluster.pg_group(cache, oid) is target_g:
+                data = rng.randbytes(STRIPE)
+                svc.write(oid, data)
+                tier_model[oid] = data
+                affected.append(oid)
+                if len(affected) >= 2:
+                    break
+        assert affected, "could not pin objects onto the victim PG"
+        for oid in affected:
+            svc.flush(oid)
+
+    hosts = {o: o // 3 for o in range(9)}
+    t = now + 100.0
+    for v in victims:
+        reps = [o for o in range(9)
+                if o not in victims and hosts[o] != hosts[v]]
+        rep_a = reps[0]
+        rep_b = next(o for o in reps if hosts[o] != hosts[rep_a])
+        mon.prepare_failure(v, rep_a, failed_since=t - 25.0, now=t)
+        mon.prepare_failure(v, rep_b, failed_since=t - 25.0, now=t)
+    mon.propose_pending(t)
+    assert all(cluster.osdmap.is_down(v) for v in victims)
+    health_seen |= _health_checks(cluster)
+
+    # every acked tier write still answers: resident-on-dead-PG reads
+    # degrade to base proxies, nothing blocks, nothing is lost
+    pre_proxy = svc.stats()["counters"]["proxy_read"]
+    for oid, want in sorted(tier_model.items()):
+        assert svc.read(oid) == want, \
+            f"acked tier write {oid} lost under tier OSD death"
+    degraded_proxies = svc.stats()["counters"]["proxy_read"] - pre_proxy
+    assert degraded_proxies >= len(affected), \
+        f"dead-PG reads did not proxy: {degraded_proxies} proxies " \
+        f"for {len(affected)} affected objects"
+
+    # heal: boot the victims back, then hits resume on the healed PG
+    for v in victims:
+        assert mon.osd_boot(v, now=t + 5.0), f"osd.{v} re-boot refused"
+    mon.propose_pending(t + 5.0)
+    cluster.deliver_all()
+    assert all(cluster.osdmap.is_up(v) for v in victims)
+    pre_hit = svc.stats()["counters"]["hit"]
+    for _ in range(2):                       # pass 1 re-promotes evicted
+        for oid in affected:                 # copies, pass 2 hits
+            assert svc.read(oid) == tier_model[oid]
+    assert svc.stats()["counters"]["hit"] > pre_hit, \
+        "healed cache PG never served a hit again"
+    final = _health_checks(cluster)
+    assert not any(k.startswith("TIER_") for k in final), \
+        f"TIER_* still raised after heal: {final}"
+    st = svc.stats()
+    return {"acked_writes": len(tier_model),
+            "verified": len(tier_model),
+            "workload": keys.describe(),
+            "victim_pg": str(target_g.pgid),
+            "victims": list(victims),
+            "affected_objects": len(affected),
+            "degraded_proxy_reads": degraded_proxies,
+            "hit_rate": round(st["hit_rate"], 4),
+            "counters": st["counters"]}
+
+
 def run_campaign(seed: int = 7, ops: int = 40, data_dir=None,
                  verbose: bool = False) -> dict:
     """One full campaign; returns the report dict (raises AssertionError
@@ -273,6 +423,11 @@ def run_campaign(seed: int = 7, ops: int = 40, data_dir=None,
                 f"acked write {oid} lost (TCP read)"
             assert cluster.get(pid, oid, len(want)) == want, \
                 f"acked write {oid} lost (local read)"
+
+        # -- phase 5: cache tier flash crowd + tier OSD death
+        say("phase 5: cache tier flash crowd + tier OSD death")
+        report["tier"] = _tier_phase(cluster, mon, cct, pid, seed, ops,
+                                     rng, now, health_seen, say)
 
         report.update({
             "ok": True,

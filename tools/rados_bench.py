@@ -81,15 +81,125 @@ def _pct(sorted_vals, p):
     return sorted_vals[i]
 
 
+class WorkloadKeys:
+    """Deterministic key streams for production-shaped workloads: a
+    uniform or zipfian draw over an ``n_keys`` keyspace, optionally
+    overlaid with a FLASH CROWD — a window of the run during which a
+    fraction of arrivals collapses onto a tiny hot set (the head of the
+    zipf ranking), the millions-of-users "everyone opens the same
+    object" shape a cache tier exists for.
+
+    Coordinates are op-sequence PROGRESS (0..1), not wall-clock, so a
+    stream is reproducible at any scale: generating 10k clients' keys
+    is 10k * ops calls of :meth:`key`, seeded once.  Thread-safe (mux
+    completion callbacks submit from reactor threads)."""
+
+    def __init__(self, n_keys: int = 10000, dist: str = "uniform",
+                 zipf_s: float = 1.1, flash: tuple | None = None,
+                 hot_frac: float = 0.001, seed: int = 0,
+                 prefix: str = "obj"):
+        import random
+        import threading
+        if dist not in ("uniform", "zipf"):
+            raise ValueError(f"unknown key distribution {dist!r}")
+        if flash is not None:
+            frac, start, dur = flash
+            if not (0.0 <= frac <= 1.0 and 0.0 <= start <= 1.0
+                    and 0.0 <= dur <= 1.0):
+                raise ValueError(f"flash-crowd out of [0,1]: {flash}")
+        self.n = int(n_keys)
+        self.dist = dist
+        self.s = float(zipf_s)
+        self.flash = flash
+        self.hot = max(1, int(round(hot_frac * self.n)))
+        self.prefix = prefix
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self._seen: set[int] = set()
+        self.counts = {"total": 0, "flash": 0}
+        if dist == "zipf":
+            # rank r (1-based) with P(r) proportional to 1/r^s: an
+            # explicit CDF + bisect — exact, no rejection loop, and the
+            # head of the ranking doubles as the flash-crowd hot set
+            acc, cdf = 0.0, []
+            for r in range(1, self.n + 1):
+                acc += 1.0 / (r ** self.s)
+                cdf.append(acc)
+            self._cdf = [c / acc for c in cdf]
+
+    def _rank(self) -> int:
+        if self.dist == "zipf":
+            import bisect
+            return bisect.bisect_left(self._cdf, self._rng.random())
+        return self._rng.randrange(self.n)
+
+    def key(self, progress: float) -> str:
+        """The next key for an arrival at ``progress`` (0..1) of the
+        run: hot-set draw inside the flash-crowd window, the base
+        distribution outside it."""
+        with self._lock:
+            self.counts["total"] += 1
+            rank = None
+            if self.flash is not None:
+                frac, start, dur = self.flash
+                if start <= progress < start + dur \
+                        and self._rng.random() < frac:
+                    self.counts["flash"] += 1
+                    rank = self._rng.randrange(self.hot)
+            if rank is None:
+                rank = self._rank()
+            self._seen.add(rank)
+            return f"{self.prefix}{rank:08d}"
+
+    def describe(self) -> dict:
+        with self._lock:
+            return {"dist": self.dist,
+                    "zipf_s": self.s if self.dist == "zipf" else None,
+                    "n_keys": self.n,
+                    "hot_set": self.hot,
+                    "flash": list(self.flash) if self.flash else None,
+                    "keys_drawn": self.counts["total"],
+                    "flash_draws": self.counts["flash"],
+                    "distinct_keys": len(self._seen)}
+
+
+def parse_key_dist(spec: str) -> tuple[str, float]:
+    """``uniform`` or ``zipf:<s>`` -> (dist, s)."""
+    if spec == "uniform":
+        return "uniform", 0.0
+    if spec.startswith("zipf:"):
+        return "zipf", float(spec.split(":", 1)[1])
+    if spec == "zipf":
+        return "zipf", 1.1
+    raise ValueError(f"--key-dist {spec!r}: expected uniform or zipf:<s>")
+
+
+def parse_flash_crowd(spec: str) -> tuple[float, float, float]:
+    """``frac:start:dur`` (all 0..1, progress coordinates) -> tuple."""
+    parts = spec.split(":")
+    if len(parts) != 3:
+        raise ValueError(
+            f"--flash-crowd {spec!r}: expected frac:start:dur")
+    return float(parts[0]), float(parts[1]), float(parts[2])
+
+
 def _closed_loop_segment(mux, n_clients: int, ops_per_client: int,
-                         payload: bytes, timeout_s: float) -> dict:
+                         payload: bytes, timeout_s: float,
+                         keys: WorkloadKeys | None = None,
+                         method: str = "ping",
+                         extra: dict | None = None) -> dict:
     """One closed-loop burst over an ALREADY-CONNECTED mux: every logical
-    session runs ``ops_per_client`` ping RPCs (next op submits when the
-    previous completes; EBUSY sheds retry the same op).  Shared by
-    :func:`run_mux_bench` (one segment per process) and
+    session runs ``ops_per_client`` RPCs (next op submits when the
+    previous completes; EBUSY sheds retry the same op).  ``method``
+    picks the op — ``ping`` (transport echo), ``tier_read`` (served
+    through the cluster, ``extra`` carrying the pool), or a CALLABLE
+    ``progress -> (method, args)`` for mixed streams (the tiering
+    bench's read/write flash crowd).  Shared by
+    :func:`run_mux_bench` (one segment per process),
     :func:`run_mux_overhead_bench` (many segments against one warmed
     server, so segment-to-segment deltas isolate instrument cost from
-    setup noise)."""
+    setup noise) and :func:`run_tier_mux_bench` (cold/warm tier arms
+    against one preloaded cluster)."""
     import errno as _errno
     import threading
     import time
@@ -100,7 +210,22 @@ def _closed_loop_segment(mux, n_clients: int, ops_per_client: int,
     lats: list[float] = []
     finished = threading.Event()
 
-    def mk_cb(sess, left):
+    def _op():
+        # a fresh arrival draws its method + key at the CURRENT
+        # progress of the run, so the flash-crowd window covers a
+        # contiguous slice of the op sequence at any client count
+        with lock:
+            progress = state["done"] / total
+        if callable(method):
+            m, a = method(progress)
+        else:
+            m = method
+            a = {"payload": payload} if m == "ping" else dict(extra or {})
+        if keys is not None:
+            a["key"] = keys.key(progress)
+        return m, a
+
+    def mk_cb(sess, left, m, args):
         def cb(call):
             r = call.result
             shed = (not isinstance(r, BaseException)
@@ -118,18 +243,18 @@ def _closed_loop_segment(mux, n_clients: int, ops_per_client: int,
             if fin:
                 finished.set()
                 return
-            if shed:        # refused: retry the SAME op
-                sess.call_async("ping", {"payload": payload},
-                                cb=mk_cb(sess, left))
+            if shed:        # refused: retry the SAME op (same key)
+                sess.call_async(m, args, cb=mk_cb(sess, left, m, args))
             elif left > 1:  # completed: next op in the loop
-                sess.call_async("ping", {"payload": payload},
-                                cb=mk_cb(sess, left - 1))
+                nm, na = _op()
+                sess.call_async(nm, na, cb=mk_cb(sess, left - 1, nm, na))
         return cb
 
     t0 = time.perf_counter()
     for _ in range(n_clients):
         s = mux.session()
-        s.call_async("ping", {"payload": payload}, cb=mk_cb(s, ops_per_client))
+        m0, first = _op()
+        s.call_async(m0, first, cb=mk_cb(s, ops_per_client, m0, first))
     ok = finished.wait(timeout_s)
     elapsed = time.perf_counter() - t0
     lats.sort()
@@ -141,7 +266,8 @@ def run_mux_bench(n_clients: int = 10000, ops_per_client: int = 2,
                   n_conns: int = 8, payload_bytes: int = 64,
                   queue_max: int | None = None,
                   op_threads: int | None = None,
-                  timeout_s: float = 120.0) -> dict:
+                  timeout_s: float = 120.0,
+                  keys: WorkloadKeys | None = None) -> dict:
     """Closed-loop mux bench: ``n_clients`` logical sessions multiplexed
     over ``n_conns`` TCP connections to an async ClusterServer, each
     running ``ops_per_client`` ping RPCs closed-loop (next op submits
@@ -182,7 +308,7 @@ def run_mux_bench(n_clients: int = 10000, ops_per_client: int = 2,
             mux.connect()
             payload = b"\xab" * payload_bytes
             seg = _closed_loop_segment(mux, n_clients, ops_per_client,
-                                       payload, timeout_s)
+                                       payload, timeout_s, keys=keys)
             ok = seg["finished_in_time"]
             elapsed = seg["elapsed_s"]
             state = seg["state"]
@@ -212,6 +338,7 @@ def run_mux_bench(n_clients: int = 10000, ops_per_client: int = 2,
                 "server_shed": shed_snap,
                 "mux_stats": st,
                 "threads": threading.active_count(),
+                "workload": keys.describe() if keys is not None else None,
             }
         finally:
             if mux is not None:
@@ -220,6 +347,188 @@ def run_mux_bench(n_clients: int = 10000, ops_per_client: int = 2,
             cluster.shutdown()
             for k, v in saved.items():
                 conf.set(k, v)
+
+
+def run_tier_mux_bench(n_clients: int = 10000, ops_per_client: int = 2,
+                       n_conns: int = 8, n_objects: int = 1000,
+                       object_bytes: int = 2048, zipf_s: float = 1.1,
+                       flash: tuple = (0.9, 0.0, 1.0),
+                       hot_frac: float = 0.001, write_frac: float = 0.2,
+                       seed: int = 17, device: str = "numpy",
+                       timeout_s: float = 300.0) -> dict:
+    """Flash-crowd tiering bench at mux scale: ``n_clients`` logical
+    sessions run a zipf + flash-crowd key stream (``hot_frac`` of the
+    keyspace — 0.1% by default — absorbing ``flash[0]`` of arrivals)
+    of closed-loop mixed tier_read/tier_write RPCs (``write_frac``
+    writes) against one preloaded cluster, three segments with
+    IDENTICAL streams (same seed):
+
+    - **cold**: no tier bound — reads are full EC base-pool reads over
+      the wire (the path a miss proxies to) and writes are EC
+      full-stripe writes, encode and all;
+    - **warmup**: a writeback tier bound over the base — misses
+      promote (min_recency 1), writes absorb, populating the hot set;
+    - **warm**: the same stream against the warmed tier — the number
+      the cache exists for.
+
+    Device seconds per segment come from the critical-path ledger
+    (DEVICE-phase attribution: codec dispatches and host-SIMD fallback
+    both land there).  A healthy EC READ never touches the codec, so
+    the cold arm's device time is its write encodes — exactly the work
+    writeback absorption elides — and warm-vs-cold compares
+    device-time-per-op as well as p99.  Returns cold/warm p99 + device
+    time, the warm pass's hit rate and promotion churn, and the
+    workload description.
+    """
+    import os
+    import random
+    import tempfile
+    import sys as _sys
+
+    from ceph_tpu.cluster import MiniCluster
+    from ceph_tpu.common import Context
+    from ceph_tpu.common.tracer import default_tracer
+    from ceph_tpu.msg import MuxClient
+    from ceph_tpu.net import KEYRING, ClusterServer
+    from ceph_tpu.osd.osd_ops import ObjectOperation
+
+    def _mk_keys():
+        # one stream per segment, SAME seed: the zipf ranks and flash
+        # decisions replay draw-for-draw, so cold and warm arms serve
+        # the same key sequence
+        return WorkloadKeys(n_keys=n_objects, dist="zipf", zipf_s=zipf_s,
+                            flash=flash, hot_frac=hot_frac, seed=seed)
+
+    def _device_seconds(cluster) -> float:
+        cluster.critpath.refresh()
+        return sum(acc.get("device", 0.0)
+                   for acc in cluster.critpath.phase_seconds().values())
+
+    with tempfile.TemporaryDirectory() as td:
+        cct = Context(overrides={
+            # promote on the first recorded hit-set appearance: a flash
+            # crowd earns residency immediately, like the reference's
+            # min_read_recency_for_promote=1 deployments
+            "tier_promote_min_recency": 1,
+            "tier_target_max_objects": max(256, n_objects),
+        })
+        cluster = MiniCluster(n_osds=6, osds_per_host=2, chunk_size=512,
+                              cct=cct, data_dir=td)
+        server = None
+        mux = None
+        try:
+            base = cluster.create_ec_pool(
+                "tierbase", {"k": "2", "m": "1", "device": device},
+                pg_num=4)
+            cache = cluster.create_replicated_pool(
+                "tiercache", size=3, pg_num=4,
+                params={"hit_set_count": "4", "hit_set_period": "3600"})
+            for i in range(n_objects):
+                data = bytes([(i + j) % 251
+                              for j in range(64)]) * (object_bytes // 64)
+                cluster.operate(base, f"obj{i:08d}",
+                                ObjectOperation().write_full(data))
+            server = ClusterServer(cluster)
+            server.start()
+            mux = MuxClient("127.0.0.1", server.port,
+                            os.path.join(td, KEYRING), n_conns=n_conns)
+            mux.connect()
+
+            wdata = bytes(range(64)) * (object_bytes // 64)
+
+            def _mix(pool: str):
+                # the read/write choice replays draw-for-draw across
+                # segments (own seeded rng, consumed once per arrival)
+                wrng = random.Random(seed ^ 0x5BD1)
+
+                def draw(progress):
+                    if wrng.random() < write_frac:
+                        return "tier_write", {"pool": pool,
+                                              "payload": wdata}
+                    return "tier_read", {"pool": pool}
+                return draw
+
+            def _segment(pool: str, keys: WorkloadKeys) -> dict:
+                d0 = _device_seconds(cluster)
+                seg = _closed_loop_segment(
+                    mux, n_clients, ops_per_client, b"", timeout_s,
+                    keys=keys, method=_mix(pool))
+                dd = _device_seconds(cluster) - d0
+                st, lats = seg["state"], seg["lats"]
+                done = st["done"] - st["failed"]
+                return {"completed": done, "failed": st["failed"],
+                        "finished_in_time": seg["finished_in_time"],
+                        "elapsed_s": round(seg["elapsed_s"], 4),
+                        "ops_s": round(done / seg["elapsed_s"], 1)
+                        if seg["elapsed_s"] else 0.0,
+                        "p50_ms": round(_pct(lats, 50) * 1e3, 3),
+                        "p99_ms": round(_pct(lats, 99) * 1e3, 3),
+                        "device_s": round(dd, 6),
+                        "device_us_per_op": round(dd / done * 1e6, 3)
+                        if done else 0.0}
+
+            default_tracer().reset()
+            cold = _segment("tierbase", _mk_keys())
+            print(f"# tiering: cold p99 {cold['p99_ms']:.2f} ms, "
+                  f"{cold['device_us_per_op']:.0f} us device/op",
+                  file=_sys.stderr)
+
+            svc = cluster.create_tier(cache, base)
+            c0 = dict(svc.stats()["counters"])
+            warmup = _segment("tiercache", _mk_keys())
+            c1 = dict(svc.stats()["counters"])
+            warm = _segment("tiercache", _mk_keys())
+            c2 = dict(svc.stats()["counters"])
+
+            def _delta(a, b, k):
+                return int(b.get(k, 0)) - int(a.get(k, 0))
+
+            hits = _delta(c1, c2, "hit")
+            misses = _delta(c1, c2, "miss")
+            warm["hit_rate"] = round(hits / (hits + misses), 4) \
+                if hits + misses else 0.0
+            warm["promotions"] = _delta(c1, c2, "promote")
+            warmup_block = {"elapsed_s": warmup["elapsed_s"],
+                            "promotions": _delta(c0, c1, "promote"),
+                            "hit_rate": round(
+                                _delta(c0, c1, "hit")
+                                / max(1, _delta(c0, c1, "hit")
+                                      + _delta(c0, c1, "miss")), 4)}
+            keys_desc = _mk_keys()
+            out = {
+                "mode": "tier-mux",
+                "device": device,
+                "clients": n_clients,
+                "ops_per_client": ops_per_client,
+                "objects": n_objects,
+                "object_bytes": object_bytes,
+                "hot_objects": keys_desc.hot,
+                "resident": len(svc.resident()),
+                "cold": cold,
+                "warmup": warmup_block,
+                "warm": warm,
+                "workload": {"dist": "zipf", "zipf_s": zipf_s,
+                             "hot_frac": hot_frac, "flash": list(flash),
+                             "write_frac": write_frac, "seed": seed},
+            }
+            if cold["p99_ms"]:
+                out["warm_over_cold_p99"] = round(
+                    warm["p99_ms"] / cold["p99_ms"], 4)
+            if cold["device_us_per_op"]:
+                out["warm_over_cold_device_us"] = round(
+                    warm["device_us_per_op"] / cold["device_us_per_op"],
+                    4)
+            print(f"# tiering: warm p99 {warm['p99_ms']:.2f} ms, "
+                  f"{warm['device_us_per_op']:.0f} us device/op, "
+                  f"hit rate {warm['hit_rate']:.3f}, "
+                  f"{warm['promotions']} promotions", file=_sys.stderr)
+            return out
+        finally:
+            if mux is not None:
+                mux.close()
+            if server is not None:
+                server.stop()
+            cluster.shutdown()
 
 
 def run_mux_overhead_bench(n_clients: int = 64, ops_per_client: int = 300,
@@ -330,22 +639,35 @@ def run_mux_overhead_bench(n_clients: int = 64, ops_per_client: int = 300,
 def run_mux_overload_pair(n_clients: int = 10000,
                           ops_per_client: int = 2,
                           n_conns: int = 8,
-                          overload_queue_max: int = 64) -> dict:
+                          overload_queue_max: int = 64,
+                          key_dist: str | None = None,
+                          flash_crowd: str | None = None) -> dict:
     """The bench.py ``serving.async`` block: one clean-capacity run
     (queue limit ABOVE the client count: nothing sheds) and one
     overload run (tiny dispatch queue, one worker: the shed ladder must
-    refuse work while goodput continues)."""
+    refuse work while goodput continues).  ``key_dist`` /
+    ``flash_crowd`` overlay a key stream on the arrivals (fresh
+    generator per arm: the streams stay independently reproducible)."""
+    def mk_keys():
+        if key_dist is None and flash_crowd is None:
+            return None
+        dist, s = parse_key_dist(key_dist or "uniform")
+        return WorkloadKeys(
+            n_keys=n_clients, dist=dist, zipf_s=s,
+            flash=parse_flash_crowd(flash_crowd) if flash_crowd else None)
     capacity = run_mux_bench(n_clients, ops_per_client, n_conns,
-                             queue_max=max(2 * n_clients, 2048))
+                             queue_max=max(2 * n_clients, 2048),
+                             keys=mk_keys())
     overload = run_mux_bench(min(n_clients, 2000), ops_per_client,
                              n_conns, queue_max=overload_queue_max,
-                             op_threads=1)
+                             op_threads=1, keys=mk_keys())
     return {
         "clients": capacity["clients"],
         "ops_s": capacity["ops_s"],
         "p99_ms": capacity["p99_ms"],
         "p50_ms": capacity["p50_ms"],
         "threads": capacity["threads"],
+        "workload": capacity.get("workload"),
         "capacity": capacity,
         "overload": {
             "clients": overload["clients"],
@@ -401,6 +723,14 @@ def main(argv=None) -> int:
     ap.add_argument("--overload-queue-max", type=int, default=64,
                     help="mux mode: dispatch-queue limit for the overload "
                          "arm (tiny = heavy shedding)")
+    ap.add_argument("--key-dist", default=None,
+                    help="mux mode: key distribution over the keyspace — "
+                         "uniform or zipf:<s> (e.g. zipf:1.2)")
+    ap.add_argument("--flash-crowd", default=None,
+                    help="mux mode: frac:start:dur — during the "
+                         "[start, start+dur) slice of the run (progress "
+                         "coordinates, 0..1), frac of arrivals hit the "
+                         "0.1%% hot set (the cache-tier stress shape)")
     ap.add_argument("--json", action="store_true", dest="as_json")
     args = ap.parse_args(argv)
 
@@ -408,7 +738,8 @@ def main(argv=None) -> int:
         result = run_mux_overload_pair(
             n_clients=args.clients, ops_per_client=args.ops_per_client,
             n_conns=args.conns,
-            overload_queue_max=args.overload_queue_max)
+            overload_queue_max=args.overload_queue_max,
+            key_dist=args.key_dist, flash_crowd=args.flash_crowd)
         if args.as_json:
             print(json.dumps(result))
         else:
@@ -424,6 +755,14 @@ def main(argv=None) -> int:
               f"p99 {ov['p99_ms']:.3f} ms  "
               f"shed-rate {ov['shed_rate']:.2%} "
               f"({ov['shed_retries']} refusals)\n")
+            wl = result.get("workload")
+            if wl:
+                w(f"workload:      {wl['dist']}"
+                  f"{':%g' % wl['zipf_s'] if wl['zipf_s'] else ''} over "
+                  f"{wl['n_keys']} keys, {wl['distinct_keys']} touched"
+                  + (f", flash {wl['flash']} hit {wl['flash_draws']}/"
+                     f"{wl['keys_drawn']} draws onto {wl['hot_set']} "
+                     f"hot keys" if wl["flash"] else "") + "\n")
         return 0
 
     from ceph_tpu.common import parse_size
