@@ -795,6 +795,23 @@ def serving_section(platform: str | None) -> dict:
         except Exception as e:             # never fail the artifact
             print(f"# serving.async bench failed: {e!r}", file=sys.stderr)
             res["async"] = {"error": repr(e)[:200]}
+        try:                               # zero-copy data-path arms
+            from tools.rados_bench import run_zero_copy_pair
+            with phase("serving.zero_copy"):
+                res["zero_copy"] = run_zero_copy_pair()
+            z = res["zero_copy"]
+            print(f"# serving.zero_copy: fused "
+                  f"{z['copies_per_byte']:.2f} copies/B at "
+                  f"{z['fused']['ops_s']:.0f} ops/s (p99 "
+                  f"{z['fused']['p99_ms']:.1f} ms) vs legacy "
+                  f"{z['legacy_copies_per_byte']:.2f} copies/B at "
+                  f"{z['legacy']['ops_s']:.0f} ops/s — "
+                  f"{z['goodput_ratio']}x goodput on "
+                  f"{z['payload_bytes']}B payloads", file=sys.stderr)
+        except Exception as e:             # never fail the artifact
+            print(f"# serving.zero_copy bench failed: {e!r}",
+                  file=sys.stderr)
+            res["zero_copy"] = {"error": repr(e)[:200]}
         return res
     except Exception as e:                 # never fail the artifact
         print(f"# serving bench failed: {e!r}", file=sys.stderr)

@@ -31,6 +31,7 @@ from .engine import (Finding, ProjectIndex, Rule, all_rules,  # noqa: F401
                      write_baseline)
 
 # registering the rule modules populates the registry as a side effect
+from . import rules_copy  # noqa: F401,E402
 from . import rules_guards  # noqa: F401,E402
 from . import rules_jax  # noqa: F401,E402
 from . import rules_locks  # noqa: F401,E402

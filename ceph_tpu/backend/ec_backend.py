@@ -480,7 +480,10 @@ class ECBackend(PGBackend):
             # place the rot — rather than laundering it as repaired.
             total = hinfo.projected_total_chunk_size
             if pure_append and appended:
-                hinfo.append(old_size, append_chunks)
+                # fused path: one device crc dispatch over the stacked
+                # appended rows when the plugin has a device codec
+                ecutil.hinfo_append(hinfo, old_size, append_chunks,
+                                    ec_impl=self.ec_impl)
             elif not pure_append:
                 hinfo.set_total_chunk_size_clear_hash(total)
             self._persist_hinfo(oid, hinfo, shard_txns)

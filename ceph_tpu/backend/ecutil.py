@@ -10,7 +10,11 @@ stripes is a pure relayout — bit-identical output, MXU-sized launches.
 """
 from __future__ import annotations
 
+import functools
+
 import numpy as np
+
+from ..common import copy_ledger
 
 # -- crc32c (Castagnoli), seed-chained like ceph_crc32c ----------------------
 # HashInfo chains bufferlist::crc32c(seed) per shard with initial seed -1
@@ -88,6 +92,56 @@ def crc32c(seed: int, data: bytes | np.ndarray) -> int:
     for i in range(n16, len(buf)):
         crc = t0[(crc ^ buf[i]) & 0xFF] ^ (crc >> 8)
     return crc
+
+
+# -- crc32c combine algebra (the fused-checksum kernel's host half) ---------
+#
+# The crc32c register update is GF(2)-linear in (seed, data bits), so
+#     crc32c(seed, D) == crc32c(seed, zeros(len(D))) ^ crc32c(0, D)
+# (zlib's crc32_combine identity).  That factorization is what lets the
+# device compute seed-FREE per-row crcs inside the encode dispatch
+# (ops/rs_kernels.crc32c_rows) while HashInfo's seed-chained ceph
+# semantics are restored exactly on the host with one 32x32 GF(2)
+# matrix application per append: advance the previous cumulative crc
+# through n zero bytes, then xor the device's crc32c(0, chunk).
+
+def _gf2_times(op: list[int], vec: int) -> int:
+    out = 0
+    i = 0
+    while vec:
+        if vec & 1:
+            out ^= op[i]
+        vec >>= 1
+        i += 1
+    return out
+
+
+def _gf2_square(op: list[int]) -> list[int]:
+    return [_gf2_times(op, op[i]) for i in range(32)]
+
+
+@functools.lru_cache(maxsize=None)
+def crc32c_zeros_op(nbytes: int) -> tuple:
+    """The 32x32 GF(2) operator advancing a crc32c register through
+    ``nbytes`` zero bytes, as bit-image columns (entry i = image of
+    register bit i).  Square-and-multiply over the one-zero-byte
+    operator: O(log n) squarings, lru-cached per length."""
+    assert nbytes >= 0
+    t0 = _CRC_TABLES[0]
+    # one zero byte: crc' = (crc >> 8) ^ T0[crc & 0xFF]
+    byte_op = [t0[1 << i] if i < 8 else (1 << (i - 8)) for i in range(32)]
+    result = [1 << i for i in range(32)]          # identity
+    while nbytes:
+        if nbytes & 1:
+            result = [_gf2_times(byte_op, result[i]) for i in range(32)]
+        byte_op = _gf2_square(byte_op)
+        nbytes >>= 1
+    return tuple(result)
+
+
+def crc32c_zeros(crc: int, nbytes: int) -> int:
+    """``crc32c(crc, b"\\x00" * nbytes)`` in O(log n) (no zero buffer)."""
+    return _gf2_times(list(crc32c_zeros_op(nbytes)), crc & 0xFFFFFFFF)
 
 
 class StripeInfo:
@@ -192,6 +246,26 @@ class HashInfo:
                     self.cumulative_shard_hashes[shard], buf)
         self.total_chunk_size += sizes.pop()
 
+    def append_crcs(self, old_size: int, crc0s: dict[int, int],
+                    nbytes: int) -> None:
+        """Append with PRE-computed seed-free crcs — the fused device
+        checksum path.  ``crc0s[shard] = crc32c(0, chunk_bytes)`` (what
+        ``ops.rs_kernels.crc32c_rows`` returns); each running hash
+        advances by the crc32_combine identity
+
+            crc32c(seed, D) == crc32c_zeros(seed, len(D)) ^ crc32c(0, D)
+
+        so the device never needs the host's running seed.  Bitwise
+        identical to :meth:`append` on the same bytes."""
+        assert old_size == self.total_chunk_size
+        if not crc0s:
+            return
+        if self.has_chunk_hash():
+            for shard, c0 in crc0s.items():
+                self.cumulative_shard_hashes[shard] = crc32c_zeros(
+                    self.cumulative_shard_hashes[shard], nbytes) ^ c0
+        self.total_chunk_size += nbytes
+
     def clear(self) -> None:
         self.total_chunk_size = 0
         self.cumulative_shard_hashes = [0xFFFFFFFF] * len(self.cumulative_shard_hashes)
@@ -252,6 +326,31 @@ def _from_shard_major(shards: np.ndarray, chunk_size: int) -> np.ndarray:
     return np.ascontiguousarray(stripes).reshape(-1)
 
 
+def _pack_shard_major(arrs: list[np.ndarray], k: int,
+                      chunk_size: int) -> np.ndarray:
+    """Single-copy shard-major pack of MANY logical buffers: each
+    buffer's [S, k, c] stripe view lands transposed DIRECTLY into one
+    contiguous [k, total] output — one strided ``copyto`` per buffer —
+    replacing the two-copy ``_to_shard_major``-then-``concatenate``
+    relayout.  The surviving copy is the data path's host relayout
+    floor (until staging buffers land shard-major), reported to the
+    copy ledger as ``relayout``."""
+    total = sum(len(b) for b in arrs) // k
+    out = np.empty((k, total), dtype=np.uint8)
+    off = 0
+    for b in arrs:
+        ln = len(b) // k
+        s = ln // chunk_size
+        # out[:, off:off+ln].reshape splits the row extent into chunk
+        # cells without copying (strides stay expressible), so copyto
+        # streams straight from the stripe view into the packed output
+        np.copyto(out[:, off:off + ln].reshape(k, s, chunk_size),
+                  b.reshape(s, k, chunk_size).swapaxes(0, 1))
+        off += ln
+    copy_ledger.count_copy("relayout", out.nbytes)
+    return out
+
+
 def encode(sinfo: StripeInfo, ec_impl, data: bytes | np.ndarray,
            want: set | None = None) -> dict[int, np.ndarray]:
     """Encode a stripe-aligned logical buffer into per-shard chunk buffers.
@@ -302,9 +401,7 @@ def encode_many(sinfo: StripeInfo, ec_impl,
         arrs.append(buf)
     shard_lens = [(len(b) // sinfo.stripe_width) * sinfo.chunk_size
                   for b in arrs]
-    streams = [_to_shard_major(b, k, sinfo.chunk_size) for b in arrs]
-    data_shards = np.concatenate(streams, axis=1) if len(streams) > 1 \
-        else streams[0]
+    data_shards = _pack_shard_major(arrs, k, sinfo.chunk_size)
     total = data_shards.shape[1]
     encoded = {ec_impl.chunk_index(i): data_shards[i].copy()
                for i in range(k)}
@@ -342,6 +439,35 @@ def _device_codec(ec_impl, nbytes: int):
     return probe(int(nbytes))
 
 
+def hinfo_append(hinfo: HashInfo, old_size: int,
+                 chunks: dict[int, np.ndarray], ec_impl=None) -> None:
+    """HashInfo maintenance with the checksum fused into a device
+    dispatch: when the plugin has a device codec and the hashes are
+    live, the appended chunk rows stack into ONE ``crc32c_rows`` call
+    and the seed-free results chain through
+    :meth:`HashInfo.append_crcs` — no host crc loop over the shards.
+    Everything else (numpy routing, hash-less objects, uneven appends)
+    falls through to the bitwise-identical :meth:`HashInfo.append`."""
+    if not chunks:
+        return
+    if hinfo.has_chunk_hash() and ec_impl is not None:
+        lens = {len(v) for v in chunks.values()}
+        if len(lens) == 1:
+            nbytes = lens.pop()
+            codec = _device_codec(ec_impl, nbytes * len(chunks)) \
+                if nbytes else None
+            if codec is not None:
+                shards = sorted(chunks)
+                rows = np.stack([_as_u8(chunks[s]) for s in shards])
+                from ..ops import rs_kernels
+                crc0 = np.asarray(rs_kernels.crc32c_rows(rows))
+                hinfo.append_crcs(old_size,
+                                  {s: int(c)
+                                   for s, c in zip(shards, crc0)}, nbytes)
+                return
+    hinfo.append(old_size, chunks)
+
+
 def encode_many_pipelined(sinfo: StripeInfo, ec_impl,
                           bufs: list[bytes | np.ndarray], pipeline,
                           owner: str | None = None):
@@ -369,9 +495,7 @@ def encode_many_pipelined(sinfo: StripeInfo, ec_impl,
                   for b in arrs]
 
     def pack():
-        streams = [_to_shard_major(b, k, sinfo.chunk_size) for b in arrs]
-        return np.concatenate(streams, axis=1) if len(streams) > 1 \
-            else streams[0]
+        return _pack_shard_major(arrs, k, sinfo.chunk_size)
 
     def dispatch(data_shards):
         return pipeline.dispatch_encode(codec, data_shards,

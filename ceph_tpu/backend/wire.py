@@ -36,6 +36,8 @@ import struct
 from dataclasses import dataclass
 from hashlib import sha256
 
+import numpy as np
+
 from .ecutil import crc32c
 
 BANNER = b"ceph_tpu msgr v2\n"
@@ -73,6 +75,68 @@ def frame_encode(tag: int, segments: list[bytes], *,
         mac = hmac.new(secret, pre + b"".join(segments), sha256).digest()
         out.append(mac[:_MAC_LEN])
     return b"".join(out)
+
+
+def frame_encode_parts(tag: int, segments: list, *,
+                       secret: bytes | None = None) -> list:
+    """:func:`frame_encode` without the payload join: returns the frame
+    as an ordered list of buffers for a gather-write path (the async
+    connection splices them into its write queue unjoined — ISSUE 20's
+    device->wire leg).
+
+    Each entry of ``segments`` is either a bytes-like segment or a LIST
+    of bytes-like pieces forming one scattered segment (the sideband's
+    length table + spliced payload views).  Byte-for-byte identical on
+    the wire to ``frame_encode(tag, [b"".join(...), ...])``: the
+    preamble lengths sum the pieces, the HMAC updates incrementally in
+    piece order (exactly how :class:`~ceph_tpu.msg.parser.StreamParser`
+    verifies), and the crc-mode epilogue seed-chains across pieces.
+    Small control pieces coalesce into the head/tail buffers; only the
+    large scattered pieces stay unjoined, so queue entries stay O(payloads).
+    """
+    if not 1 <= len(segments) <= MAX_SEGMENTS:
+        raise WireError(f"{len(segments)} segments (1..{MAX_SEGMENTS})")
+    flat = [s if isinstance(s, list) else [s] for s in segments]
+    lens = [sum(len(p) for p in seg) for seg in flat]
+    pre = _PREAMBLE.pack(tag, len(segments), 0,
+                         *(lens + [0] * (MAX_SEGMENTS - len(segments))))
+    parts: list = []
+    head = [pre, _CRC.pack(_crc(pre))]
+
+    def _flush_head():
+        if head:
+            parts.append(b"".join(head) if len(head) > 1 else head[0])
+            head.clear()
+
+    if secret is not None:
+        h = hmac.new(secret, pre, sha256)
+        for seg in flat:
+            for p in seg:
+                h.update(p)
+                if isinstance(p, memoryview) and len(p) >= 1024:
+                    _flush_head()
+                    parts.append(p)
+                else:
+                    head.append(bytes(p) if isinstance(p, memoryview)
+                                else p)
+        head.append(h.digest()[:_MAC_LEN])
+    else:
+        tail = []
+        for seg in flat:
+            c = 0xFFFFFFFF
+            for p in seg:
+                c = crc32c(c, p if isinstance(p, bytes)
+                           else np.frombuffer(p, dtype=np.uint8))
+                if isinstance(p, memoryview) and len(p) >= 1024:
+                    _flush_head()
+                    parts.append(p)
+                else:
+                    head.append(bytes(p) if isinstance(p, memoryview)
+                                else p)
+            tail.append(_CRC.pack(c ^ 0xFFFFFFFF))
+        head.extend(tail)
+    _flush_head()
+    return parts
 
 
 class FrameParser:

@@ -294,6 +294,16 @@ OPTIONS: list[Option] = [
                        "calls to coalesce once one is queued (0 sends "
                        "immediately)",
            see_also=["ms_async_batch_max"]),
+    Option("ms_zero_copy", TYPE_BOOL, LEVEL_ADVANCED, default=True,
+           description="serialize batch-frame payloads through the "
+                       "raw sideband segment (length-prefixed bulk data "
+                       "after the pickled control header) so a payload "
+                       "byte is copied ~once between socket and device "
+                       "staging; off forces the legacy all-pickle frame "
+                       "(the bench's 'legacy' arm). Both formats decode "
+                       "regardless of the setting — this only gates the "
+                       "ENCODE side, so mixed-version peers interoperate",
+           see_also=["ms_async_batch_max"]),
     Option("pipeline_breaker_threshold", TYPE_UINT, LEVEL_ADVANCED,
            default=3,
            description="consecutive device-side codec failures before "

@@ -303,6 +303,33 @@ def _tier_gauges(family, prefix: str) -> None:
                 f'stat="{stat}"}} {v}')
 
 
+def _copy_gauges(family, prefix: str) -> None:
+    """``ceph_tpu_copy_bytes{source}`` / ``ceph_tpu_copy_state{stat}``
+    — the payload copy ledger (common/copy_ledger.py): bytes copied per
+    surviving host-copy source, bytes served to consumers, and the
+    ``copies_per_byte`` quotient the zero-copy data path is gated on
+    (ROADMAP item 2)."""
+    try:
+        from ..common.copy_ledger import ledger
+    except Exception:                       # pragma: no cover
+        return
+    snap = ledger().snapshot()
+    copied_fam = family(f"{prefix}_copy_bytes", "counter",
+                        "payload bytes copied, by copy source "
+                        "(common/copy_ledger.py)")
+    for source, v in sorted(snap["copied"].items()):
+        copied_fam.lines.append(
+            f'{prefix}_copy_bytes{{source="{_sanitize(source)}"}} {v}')
+    state_fam = family(f"{prefix}_copy_state", "gauge",
+                       "payload bytes served and copies per served byte")
+    for stat, v in (("served_bytes", snap["served"]),
+                    ("copied_total", snap["copied_total"]),
+                    ("copies_per_byte",
+                     round(snap["copies_per_byte"], 6))):
+        state_fam.lines.append(
+            f'{prefix}_copy_state{{stat="{stat}"}} {v}')
+
+
 def _slo_gauges(family, prefix: str) -> None:
     """``ceph_tpu_slo_budget{owner,class,stat}`` — every live
     SLOTracker's per-class objective state: the configured p99 bound,
@@ -474,6 +501,7 @@ def render(cct=None, prefix: str = "ceph_tpu") -> str:
     _wire_gauges(family, prefix)
     _heat_gauges(family, prefix)
     _tier_gauges(family, prefix)
+    _copy_gauges(family, prefix)
 
     span_metric = f"{prefix}_span_latency_seconds"
     hists = default_tracer().histograms()

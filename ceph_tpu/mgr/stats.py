@@ -215,6 +215,18 @@ class StatsAggregator:
         return (self._wire_class_delta("client")
                 + self._wire_class_delta("serving")) / ops
 
+    @staticmethod
+    def bytes_copied_per_byte_served() -> float:
+        """ROADMAP item 2's success metric: host payload copies per
+        payload byte consumed, from the process-global copy ledger —
+        ~3 on the legacy pickle path, ~1 on the sideband path.  0.0
+        while nothing served (or the ledger is unavailable)."""
+        try:
+            from ..common.copy_ledger import ledger
+        except Exception:                   # pragma: no cover
+            return 0.0
+        return ledger().copies_per_byte()
+
     def digest(self) -> dict:
         """The rate digest ``Cluster.status()`` / `ceph_tpu top` render:
         client IO, recovery, serving-batch throughput, wire traffic,
@@ -250,6 +262,11 @@ class StatsAggregator:
                 "bytes_s": self.rate("bytes_in"),
                 # client+serving wire bytes per completed client op
                 "wire_bytes_per_op": self.wire_bytes_per_op(),
+                # host copies per payload byte consumed — the zero-copy
+                # data path's success metric (common/copy_ledger.py);
+                # cumulative since process start, not windowed
+                "bytes_copied_per_byte_served":
+                    self.bytes_copied_per_byte_served(),
             },
             "wire": {
                 "tx_bytes_s": self.rate("tx_bytes", WIRE_PREFIXES),
@@ -285,6 +302,8 @@ class StatsAggregator:
             "serving_op_s": d["serving"]["op_s"],
             "serving_bytes_s": d["serving"]["bytes_s"],
             "serving_wire_per_op": d["serving"]["wire_bytes_per_op"],
+            "serving_copies_per_byte":
+                d["serving"]["bytes_copied_per_byte_served"],
             "wire_tx_bytes_s": d["wire"]["tx_bytes_s"],
             "wire_tx_msgs_s": d["wire"]["tx_msgs_s"],
             "jit_compiles": d["jit"]["compiles"],

@@ -35,6 +35,7 @@ import threading
 import time
 import uuid
 
+from ..common import copy_ledger, instruments
 from ..osd.mclock import CLIENT_OP
 from .connection import AsyncConnection
 from .proto import RpcBatch
@@ -112,7 +113,9 @@ class MuxClient:
     def __init__(self, host: str, port: int, keyring, *, cct=None,
                  n_conns: int = 2, name: str = "mux"):
         from ..common import default_context
+        from .. import net
         self._conf = (cct if cct is not None else default_context()).conf
+        net.wire_zero_copy_config(self._conf)
         self._host, self._port = host, port
         with open(keyring, "rb") as f:
             self._key = pickle.load(f)["key"]
@@ -239,6 +242,14 @@ class MuxClient:
                 self.completed += 1
                 self._finish_locked(call, r)
                 finished.append(call)
+        if instruments.enabled():
+            # copy-ledger denominator: result payload bytes landing in
+            # their consumer's completion (pairs with the server-side
+            # request tally at dispatch)
+            served = sum(len(r.value) for r in results
+                         if net._sb_eligible(r.value))
+            if served:
+                copy_ledger.count_served(served)
         for call in finished:
             self._signal(call)
 

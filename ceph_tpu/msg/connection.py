@@ -38,6 +38,13 @@ RECV_SIZE = 256 * 1024
 DEFAULT_WRITE_QUEUE_BYTES = 4 << 20
 SEND_TIMEOUT = 5.0
 
+# vectored drain: gather up to this many queue entries / bytes into one
+# sendmsg(2) — a sideband frame is several unjoined views (head, payload
+# splices, tail), and per-entry send() would pay one syscall per view
+_SENDMSG_MAX_BUFS = 64
+_SENDMSG_MAX_BYTES = 1 << 20
+_HAS_SENDMSG = hasattr(socket.socket, "sendmsg")
+
 
 class AsyncConnection:
     """One framed, reactor-driven socket endpoint (Channel's async twin:
@@ -47,11 +54,16 @@ class AsyncConnection:
                  secret: bytes | None = None, expect_banner: bool = False,
                  name: str = "conn", on_message=None, on_closed=None,
                  write_queue_bytes: int = DEFAULT_WRITE_QUEUE_BYTES,
-                 send_banner: bool = False, register: bool = True):
+                 send_banner: bool = False, register: bool = True,
+                 staging=None):
         self.sock = sock
         self.reactor = reactor
         self.name = name
         self.secret = secret
+        # sideband landing policy (net._decode): a msg/staging pool on
+        # server connections (handlers get pooled views), None on
+        # client/handshake connections (completions get owned bytes)
+        self.staging = staging
         self.parser = StreamParser(secret, expect_banner=expect_banner)
         self.on_message = on_message
         self.on_closed = on_closed
@@ -90,6 +102,33 @@ class AsyncConnection:
         from .. import net
         return net._encode(msg, self.secret)
 
+    def _encode_parts(self, msg):
+        from .. import net
+        return net._encode_parts(msg, self.secret)
+
+    def _send_parts(self, msg, parts: list, timeout: float) -> None:
+        """Enqueue one frame as multiple write-queue entries (payload
+        views unjoined).  Entries land atomically under _wlock, so
+        concurrent senders cannot interleave mid-frame; each entry
+        carries its own byte count as throttle budget, so partial-send
+        release and close-time accounting stay exact per entry."""
+        total = sum(len(p) for p in parts)
+        if not self.wthrottle.get(total, timeout=timeout):
+            self.close(ConnectionError(
+                f"{self.name}: write backpressure timeout"))
+            raise ConnectionError(f"{self.name}: write queue full")
+        if self._closed:
+            self.wthrottle.put(total)
+            raise ConnectionError(f"{self.name}: connection closed")
+        with self._wlock:
+            self._stats_tx(total)
+            for p in parts:
+                self._enqueue_locked_entry(
+                    p if isinstance(p, memoryview) else memoryview(p),
+                    len(p))
+        self._account_tx(msg, total)
+        self.reactor.update_interest(self.sock, self)
+
     def _stats_tx(self, nbytes: int) -> None:
         # plain-dict read-modify-write: callers hold _wlock (pairs with
         # the rx bumps in on_readable)
@@ -119,9 +158,18 @@ class AsyncConnection:
         or exhausted backpressure budget (peer stopped reading)."""
         if self._closed:
             raise ConnectionError(f"{self.name}: connection closed")
+        hooks = self.faults() if self.faults is not None else None
+        if hooks is None:
+            # zero-copy fast path: payload-bearing frames splice their
+            # payload views into the write queue unjoined (ISSUE 20
+            # layer d).  Fault campaigns (hooks armed) keep the single-
+            # buffer frame so truncate/reset see one contiguous image.
+            parts = self._encode_parts(msg)
+            if parts is not None:
+                self._send_parts(msg, parts, timeout)
+                return
         data = self._encode(msg)
         action = "ok"
-        hooks = self.faults() if self.faults is not None else None
         if hooks is not None:
             action = hooks.on_send(type(msg).__name__, len(data),
                                    target=type(msg).__name__)
@@ -220,29 +268,51 @@ class AsyncConnection:
 
     def _decode(self, tag, segs):
         from .. import net
-        return net._decode(tag, segs, authed=self.secret is not None)
+        return net._decode(tag, segs, authed=self.secret is not None,
+                           staging=self.staging)
 
     def on_writable(self) -> None:
         released = 0
         err: BaseException | None = None
         with self._wlock:
             while self._wq:
-                mv, throttled = self._wq[0]
+                if _HAS_SENDMSG:
+                    bufs, cap = [], 0
+                    for e in self._wq:
+                        bufs.append(e[0])
+                        cap += len(e[0])
+                        if len(bufs) >= _SENDMSG_MAX_BUFS or \
+                                cap >= _SENDMSG_MAX_BYTES:
+                            break
+                    send = lambda: self.sock.sendmsg(bufs)  # noqa: E731
+                else:
+                    cap = len(self._wq[0][0])
+                    send = lambda: self.sock.send(self._wq[0][0])  # noqa: E731
                 try:
-                    n = self.sock.send(mv)
+                    n = send()
                 except (BlockingIOError, InterruptedError):
                     break
                 except OSError as e:
                     err = ConnectionError(f"send failed: {e}")
                     break
-                if throttled:
-                    rel = min(n, throttled)
-                    self._wq[0][1] -= rel
-                    released += rel
-                if n == len(mv):
-                    self._wq.pop(0)
-                else:
-                    self._wq[0][0] = mv[n:]
+                full = n >= cap
+                # walk the sent count across entries (a gathered send
+                # can complete several and split the last)
+                while self._wq:
+                    mv, throttled = self._wq[0]
+                    take = min(n, len(mv))
+                    if throttled:
+                        rel = min(take, throttled)
+                        self._wq[0][1] -= rel
+                        released += rel
+                    if take == len(mv):
+                        self._wq.pop(0)
+                    else:
+                        self._wq[0][0] = mv[take:]
+                    n -= take
+                    if n <= 0:
+                        break
+                if not full:
                     break
             drained = not self._wq
         if released:

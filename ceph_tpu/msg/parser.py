@@ -22,15 +22,22 @@ from __future__ import annotations
 import hmac
 from hashlib import sha256
 
+import numpy as np
+
 from ..backend.ecutil import crc32c
 from ..backend.wire import (BANNER, MAX_SEGMENTS, WireError, _CRC,
                             _MAC_LEN, _PREAMBLE)
+from ..common import copy_ledger
 
 _COMPACT_MIN = 1 << 16
 
 
 def _crc(data) -> int:
-    return crc32c(0xFFFFFFFF, bytes(data)) ^ 0xFFFFFFFF
+    # np.frombuffer is a zero-copy view of the receive buffer — the
+    # segment checksum never materializes payload bytes (the native
+    # crc kernel reads pointer+length in place)
+    return crc32c(0xFFFFFFFF,
+                  np.frombuffer(data, dtype=np.uint8)) ^ 0xFFFFFFFF
 
 
 class StreamParser:
@@ -70,6 +77,12 @@ class StreamParser:
         try:
             self._buf += data
         except BufferError:
+            # retained views pin the buffer: rebuild.  This copies the
+            # unconsumed tail AND the new bytes — report both to the
+            # copy ledger so bytes_copied_per_byte_served cannot
+            # undercount the parser's own copies (ISSUE 20 satellite 1)
+            copy_ledger.count_copy(
+                "fallback", (len(self._buf) - self._pos) + len(data))
             self._buf = self._buf[self._pos:] + bytes(data)
             self._pos = 0
         frames = []
@@ -83,8 +96,13 @@ class StreamParser:
     def _maybe_compact(self) -> None:
         if self._pos > _COMPACT_MIN and self._pos * 2 > len(self._buf):
             try:
+                moved = len(self._buf) - self._pos
                 del self._buf[:self._pos]
                 self._pos = 0
+                # amortized head-trim moves the unconsumed tail down —
+                # the parser's only steady-state copy; count it so the
+                # ledger's ratio carries the true parser overhead
+                copy_ledger.count_copy("compaction", moved)
             except BufferError:
                 pass                     # retained views pin the buffer
 

@@ -58,6 +58,79 @@ net._TYPES.update({
 })
 
 
+# ---- sideband codecs (ISSUE 20: zero-copy batch frames) ------------------
+#
+# Batch frames are where bulk payloads actually ride the mux transport,
+# so both batch types register extract/reattach hooks with the shared
+# codec: eligible args/value blobs lift out of the pickled control
+# header into the frame's raw third segment (net._encode_parts), and
+# land on the far side with one staged copy (net._sideband_payloads).
+# Extraction copies the CONTAINERS only (a fresh calls list + args
+# dicts, never payload bytes): retries resend the same RpcCall objects,
+# which must keep their real payloads.
+
+def _batch_extract(msg):
+    views: list = []
+    calls, dirty = [], False
+    for c in msg.calls:
+        repl = net._call_extract_args(c, views)
+        if repl is not None:
+            dirty = True
+            c = net.RpcCall(c.rid, c.method, repl, trace=c.trace,
+                            session=c.session, op_class=c.op_class)
+        calls.append(c)
+    if not dirty:
+        return None
+    return RpcBatch(calls), views
+
+
+def _batch_reattach(msg, payloads) -> None:
+    for c in msg.calls:
+        net._call_reattach_args(c, payloads)
+
+
+def _batch_payload_bytes(msg) -> int:
+    return sum(len(v) for c in msg.calls for v in c.args.values()
+               if net._sb_eligible(v))
+
+
+def _result_batch_extract(msg):
+    views: list = []
+    results, dirty = [], False
+    for r in msg.results:
+        if net._sb_splice(r.value):
+            dirty = True
+            v = r.value
+            views.append(v if isinstance(v, memoryview)
+                         else memoryview(v))
+            r = net.RpcResult(r.rid, r.ok,
+                              net.SidebandRef(len(views) - 1),
+                              r.error, r.errno, trace=r.trace)
+        results.append(r)
+    if not dirty:
+        return None
+    return RpcResultBatch(results), views
+
+
+def _result_batch_reattach(msg, payloads) -> None:
+    for r in msg.results:
+        net._rpc_result_reattach(r, payloads)
+
+
+def _result_batch_payload_bytes(msg) -> int:
+    return sum(len(r.value) for r in msg.results
+               if net._sb_eligible(r.value))
+
+
+net._SIDEBAND_CODECS.update({
+    "RpcBatch": net._SidebandCodec(
+        _batch_extract, _batch_reattach, _batch_payload_bytes),
+    "RpcResultBatch": net._SidebandCodec(
+        _result_batch_extract, _result_batch_reattach,
+        _result_batch_payload_bytes),
+})
+
+
 def batch_trace_ctx(msg):
     """The trace context a batch frame's wire bytes charge to: batches
     are client-op vectors, so the first traced member speaks for the

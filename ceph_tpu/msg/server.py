@@ -246,6 +246,13 @@ class AsyncServerTransport:
 
         def opt(key, default):
             return conf.get(key) if conf is not None else default
+        if conf is not None:
+            from .. import net
+            net.wire_zero_copy_config(conf)
+        # server connections land request sidebands in the pooled
+        # staging buffers (the one sanctioned copy: wire -> staging)
+        from .staging import default_pool
+        self.staging = default_pool()
         self.reactor = Reactor(name=self.name)
         self.write_queue_bytes = int(opt("ms_async_write_queue_bytes",
                                          4 << 20))
@@ -295,7 +302,8 @@ class AsyncServerTransport:
             sock, self.reactor, expect_banner=True, send_banner=True,
             name=f"{self.name}.c{self._accepts}",
             on_message=self._on_message, on_closed=self._on_closed,
-            write_queue_bytes=self.write_queue_bytes)
+            write_queue_bytes=self.write_queue_bytes,
+            staging=self.staging)
         conn.acct = self.core.wire
         conn.auth = _AuthState()
         conn.auth.timer = self.reactor.call_later(

@@ -45,8 +45,8 @@ from pathlib import Path
 from .auth.cephx import (AuthError, Authorizer, CephxClient,
                          CephxServiceHandler, KeyServer)
 from .backend.wire import (BANNER, FrameParser, TAG_HELLO, TAG_MESSAGE,
-                           WireError, frame_encode)
-from .common import wire_accounting
+                           WireError, frame_encode, frame_encode_parts)
+from .common import copy_ledger, instruments, wire_accounting
 from .common.tracer import default_tracer
 
 SERVICE = "osd"
@@ -126,6 +126,16 @@ class RpcResult:
 
 
 @dataclass
+class SidebandRef:
+    """Placeholder left in a pickled control header where a bulk payload
+    was extracted to the frame's raw sideband segment (ISSUE 20): ``i``
+    indexes the sideband's length table.  Decode replaces every ref with
+    its staged payload before the message reaches any consumer, so refs
+    are never visible outside the codec."""
+    i: int
+
+
+@dataclass
 class NotifyPush:
     cookie: int
     notify_id: int
@@ -156,6 +166,9 @@ wire_accounting.register_wire_sizes({
     CephxDone: lambda m: len(m.reply),
     RpcCall: lambda m: len(m.method) + _blob(m.args),
     RpcResult: lambda m: _blob(m.value) + len(m.error),
+    # a sideband placeholder is one u32 index on the wire; the payload
+    # it stands for is metered by the frame's real byte length
+    SidebandRef: lambda m: 4,
     NotifyPush: lambda m: len(m.payload) + 16,
     NotifyAck: lambda m: _blob(m.value) + 16,
 })
@@ -243,21 +256,214 @@ def _encode(msg, secret: bytes | None) -> bytes:
             raise WireError(f"{name} may not ride an unauthenticated "
                             f"connection")
         payload = pickle.dumps(msg)
+        if instruments.enabled():
+            codec = _SIDEBAND_CODECS.get(name)
+            if codec is not None:
+                pb = codec.payload_bytes(msg)
+                if pb:
+                    # the legacy path's two tx-side payload copies:
+                    # pickle.dumps above and frame_encode's b"".join
+                    copy_ledger.count_copy("pickle", pb)
+                    copy_ledger.count_copy("join", pb)
     return frame_encode(TAG_MESSAGE, [name.encode(), payload],
                         secret=secret)
 
 
-def _decode(tag: int, segs: list[bytes], *, authed: bool):
+# ---- raw-payload sideband (ISSUE 20: zero-copy batch frames) -------------
+#
+# A payload-bearing post-auth message may serialize as a THREE-segment
+# frame: [type name, pickled control header, raw sideband].  Bulk
+# bytes-like values are lifted out of the header before pickling (a
+# SidebandRef marks each slot) and ride the third segment length-
+# prefixed, so the encode side never pickles payload bytes (the views
+# splice straight into the connection's write queue) and the decode
+# side lands them with ONE copy — into a pooled staging buffer (server)
+# or owned bytes (client/blocking channel).  Frames dispatch on segment
+# count, so both formats decode regardless of ms_zero_copy: the option
+# gates only the encode side and mixed peers interoperate.
+
+_SB_MIN = copy_ledger.PAYLOAD_MIN
+# encode-side splice threshold: lifting a value costs a header rewrite,
+# a table entry, and an extra write-queue part — worth it only once the
+# value dwarfs that overhead.  Smaller eligible values stay pickled
+# (and still weigh in the ledger as legacy copies via _sb_eligible)
+_SB_SPLICE_MIN = 1024
+_SB_LEN = struct.Struct("<I")
+
+_zero_copy = True
+
+
+def zero_copy_enabled() -> bool:
+    return _zero_copy
+
+
+def set_zero_copy(on: bool) -> None:
+    global _zero_copy
+    _zero_copy = bool(on)
+
+
+def wire_zero_copy_config(conf) -> None:
+    """Adopt ``ms_zero_copy`` from a ConfigProxy and follow live
+    updates (the transports call this; the switch is process-wide like
+    the instruments kill-switch, and gates only the encode side)."""
+    if "ms_zero_copy" not in conf.schema:
+        return
+    set_zero_copy(bool(conf.get("ms_zero_copy")))
+    conf.add_observer("ms_zero_copy",
+                      lambda _name, v: set_zero_copy(bool(v)))
+
+
+def _sb_eligible(v) -> bool:
+    return isinstance(v, (bytes, bytearray, memoryview)) \
+        and len(v) >= _SB_MIN
+
+
+def _sb_splice(v) -> bool:
+    return isinstance(v, (bytes, bytearray, memoryview)) \
+        and len(v) >= _SB_SPLICE_MIN
+
+
+class _SidebandCodec:
+    """One message type's sideband hooks: ``extract(msg)`` returns
+    ``(header_msg, views)`` or None (nothing to lift — caller falls back
+    to the pickled frame); ``reattach(msg, payloads)`` swaps every
+    SidebandRef in a freshly-unpickled header for its landed payload;
+    ``payload_bytes(msg)`` sizes the eligible payloads (the legacy
+    path's ledger weights)."""
+
+    __slots__ = ("extract", "reattach", "payload_bytes")
+
+    def __init__(self, extract, reattach, payload_bytes):
+        self.extract = extract
+        self.reattach = reattach
+        self.payload_bytes = payload_bytes
+
+
+_SIDEBAND_CODECS: dict[str, _SidebandCodec] = {}
+
+
+def _call_extract_args(call, views: list):
+    """Lift eligible args values; returns a replacement args dict or
+    None.  Never mutates the caller's dict — retries resend the same
+    RpcCall objects, which must keep their real payloads."""
+    repl = None
+    for k, v in call.args.items():
+        if _sb_splice(v):
+            if repl is None:
+                repl = dict(call.args)
+            repl[k] = SidebandRef(len(views))
+            views.append(v if isinstance(v, memoryview) else memoryview(v))
+    return repl
+
+
+def _call_reattach_args(call, payloads: list) -> None:
+    for k, v in call.args.items():
+        if type(v) is SidebandRef:
+            call.args[k] = payloads[v.i]
+
+
+def _rpc_call_extract(msg):
+    views: list = []
+    repl = _call_extract_args(msg, views)
+    if repl is None:
+        return None
+    return RpcCall(msg.rid, msg.method, repl, trace=msg.trace,
+                   session=msg.session, op_class=msg.op_class), views
+
+
+def _rpc_call_payload_bytes(msg) -> int:
+    return sum(len(v) for v in msg.args.values() if _sb_eligible(v))
+
+
+def _rpc_result_extract(msg):
+    if not _sb_splice(msg.value):
+        return None
+    v = msg.value
+    return RpcResult(msg.rid, msg.ok, SidebandRef(0), msg.error,
+                     msg.errno, trace=msg.trace), \
+        [v if isinstance(v, memoryview) else memoryview(v)]
+
+
+def _rpc_result_reattach(msg, payloads) -> None:
+    if type(msg.value) is SidebandRef:
+        msg.value = payloads[msg.value.i]
+
+
+_SIDEBAND_CODECS["RpcCall"] = _SidebandCodec(
+    _rpc_call_extract, _call_reattach_args, _rpc_call_payload_bytes)
+_SIDEBAND_CODECS["RpcResult"] = _SidebandCodec(
+    _rpc_result_extract, _rpc_result_reattach,
+    lambda m: len(m.value) if _sb_eligible(m.value) else 0)
+
+
+def _encode_parts(msg, secret: bytes | None) -> list | None:
+    """Sideband encode: the frame as an ordered list of write buffers
+    (payload views UNJOINED), or None when the message cannot or need
+    not sideband — the caller falls back to :func:`_encode`."""
+    if secret is None or not _zero_copy:
+        return None
+    codec = _SIDEBAND_CODECS.get(type(msg).__name__)
+    if codec is None:
+        return None
+    ex = codec.extract(msg)
+    if ex is None:
+        return None
+    header_msg, views = ex
+    table = _SB_LEN.pack(len(views)) + b"".join(
+        _SB_LEN.pack(len(v)) for v in views)
+    return frame_encode_parts(
+        TAG_MESSAGE,
+        [type(msg).__name__.encode(), pickle.dumps(header_msg),
+         [table, *views]],
+        secret=secret)
+
+
+def _sideband_payloads(seg, staging) -> list:
+    """Land a sideband segment's payloads with ONE copy each: staged
+    into a pooled buffer (views) when ``staging`` is a pool, or
+    materialized to owned bytes otherwise (client completions and the
+    reqid-dedup cache outlive the parser buffer)."""
+    mv = seg if isinstance(seg, memoryview) else memoryview(seg)
+    if len(mv) < _SB_LEN.size:
+        raise WireError("truncated sideband table")
+    (n,) = _SB_LEN.unpack_from(mv, 0)
+    head = _SB_LEN.size * (1 + n)
+    if len(mv) < head:
+        raise WireError("truncated sideband table")
+    lens = [_SB_LEN.unpack_from(mv, _SB_LEN.size * (1 + i))[0]
+            for i in range(n)]
+    body = mv[head:]
+    if sum(lens) != len(body):
+        raise WireError("sideband length mismatch")
+    out: list = []
+    off = 0
+    if staging is not None:
+        base = staging.stage(body)          # THE copy (ledger: staging)
+        for ln in lens:
+            out.append(base[off:off + ln])
+            off += ln
+    else:
+        for ln in lens:
+            b = bytes(body[off:off + ln])
+            off += ln
+            copy_ledger.count_copy("materialize", len(b))
+            out.append(b)
+    return out
+
+
+def _decode(tag: int, segs: list[bytes], *, authed: bool, staging=None):
     # segs may be bytes (FrameParser) or memoryviews into the async
     # stream parser's receive buffer; only the tiny name/handshake
     # segments materialize — the pickle payload decodes in place
-    if tag != TAG_MESSAGE or len(segs) != 2:
+    if tag != TAG_MESSAGE or len(segs) not in (2, 3):
         raise WireError(f"unexpected frame tag {tag}")
     name = bytes(segs[0]).decode()
     klass = _TYPES.get(name)
     if klass is None:
         raise WireError(f"unknown rpc type {name!r}")
     if name in _HANDSHAKE_FIELDS:
+        if len(segs) != 2:
+            raise WireError(f"{name} cannot carry a sideband")
         return _handshake_loads(name, bytes(segs[1]))
     if not authed:
         # pickle is reachable ONLY behind the HMAC (pre-auth unpickling
@@ -266,6 +472,18 @@ def _decode(tag: int, segs: list[bytes], *, authed: bool):
     msg = pickle.loads(segs[1])
     if type(msg) is not klass:
         raise WireError("rpc type name mismatch")
+    codec = _SIDEBAND_CODECS.get(name)
+    if len(segs) == 3:
+        if codec is None:
+            raise WireError(f"{name} cannot carry a sideband")
+        try:
+            codec.reattach(msg, _sideband_payloads(segs[2], staging))
+        except (IndexError, AttributeError, TypeError) as e:
+            raise WireError(f"bad sideband refs in {name}: {e}") from e
+    elif codec is not None and instruments.enabled():
+        pb = codec.payload_bytes(msg)
+        if pb:
+            copy_ledger.count_copy("unpickle", pb)
     return msg
 
 
@@ -574,6 +792,14 @@ class ClusterServer:
 
     def _dispatch(self, ch: Channel, call: RpcCall) -> RpcResult:
         t0 = time.perf_counter()
+        if instruments.enabled():
+            # copy-ledger denominator: request payload bytes reaching
+            # their consumer (the handler) — pairs with the client-side
+            # tally of result payloads at completion
+            served = sum(len(v) for v in call.args.values()
+                         if _sb_eligible(v))
+            if served:
+                copy_ledger.count_served(served)
         # resend dedup by reqid: a session-stamped call already answered
         # returns its FIRST execution's cached result — the property that
         # makes reset/black-hole resends safe for non-idempotent ops

@@ -183,6 +183,34 @@ class RSCodec:
             device_attribution.record_batch(None, t0, host.nbytes)
             return host
 
+    def encode_with_crc(self, data: np.ndarray):
+        """Fused encode + checksum: parity [m, N] uint8 AND the
+        crc32c(0, row) of every row of concat(data, parity) as a
+        [k + m] uint32 array, ONE jitted dispatch (the checksum pass
+        rides the rows the encode just produced instead of a host
+        crc loop over fetched shards).  Seed-free crcs: callers chain
+        them into ceph's running HashInfo semantics with
+        ``ecutil.crc32c_zeros`` (see :meth:`HashInfo.append_crcs`)."""
+        data = np.ascontiguousarray(data, dtype=np.uint8)
+        with trace_span("codec.encode_with_crc", k=self.k, m=self.m,
+                        n=int(data.shape[1]), device=self.device):
+            if self.device == "numpy":
+                from ..backend import ecutil
+                parity = gfref.apply_matrix_fast(self.parity_mat, data)
+                crcs = np.array(
+                    [ecutil.crc32c(0, bytes(r))
+                     for r in np.concatenate([data, parity], axis=0)],
+                    dtype=np.uint32)
+                return parity, crcs
+            self._upload_parity()
+            parity, crcs = rs_kernels.gf_encode_with_crc(
+                self._parity_dev, data, self.variant)
+            t0 = device_attribution.dispatch_mark()
+            parity_h = np.asarray(jax.device_get(parity))
+            crcs_h = np.asarray(jax.device_get(crcs))
+            device_attribution.record_batch(None, t0, parity_h.nbytes)
+            return parity_h, crcs_h
+
     def encode_host(self, data: np.ndarray) -> np.ndarray:
         """Pure-host parity (the exact CPU reference path) REGARDLESS of
         ``self.device`` — the circuit breaker's fallback when the device

@@ -229,3 +229,103 @@ def bitplane_xor_matmul(W, d):
 @traced_jit
 def _xor_apply_xla(W, packets):
     return bitplane_xor_matmul(W, packets)
+
+
+# -- fused crc32c (ISSUE 20 layer c: checksums ride the encode dispatch) -----
+#
+# crc32c is GF(2)-linear in the data bits once the seed is factored out
+# (backend/ecutil.crc32c_zeros), so a row's crc32c(0, row) folds like a
+# reduction: start from per-byte crcs (one 256-entry table gather, the
+# same shape as the codec's lookup path), then log2(n) fold levels where
+# adjacent 2^l-byte blocks combine as  Z_{2^l}(left) ^ right  —  Z_L the
+# 32x32 GF(2) matrix advancing a register through L zero bytes.  Rows
+# pad with zeros on the LEFT: leading zeros are free for a zero-seeded
+# register, so padding changes nothing while keeping every level an
+# exact halving (static shapes, one compilation per (r, n)).  The fold
+# matrices are trace-time constants (lru-cached per level), and the
+# GF(2) matrix application is 32 bit-planes through one integer matmul —
+# the same bitslice trick the encode kernel uses, so the fused
+# encode+crc dispatch keeps everything on the MXU/VPU with no host loop.
+
+@functools.lru_cache(maxsize=1)
+def _crc_t0_dev() -> jax.Array:
+    from ..backend import ecutil
+    # first call may land inside a jit trace; the cache must hold a
+    # CONCRETE array, never that trace's tracer
+    with jax.ensure_compile_time_eval():
+        return jnp.array(ecutil._CRC_TABLES[0], dtype=jnp.uint32)
+
+
+@functools.lru_cache(maxsize=64)
+def _crc_fold_mat_dev(level: int) -> jax.Array:
+    """Bit matrix of Z_{2^level}: M[i, j] = bit j of the image of
+    register bit i."""
+    from ..backend import ecutil
+    op = ecutil.crc32c_zeros_op(1 << level)
+    with jax.ensure_compile_time_eval():
+        return jnp.array([[(op[i] >> j) & 1 for j in range(32)]
+                          for i in range(32)], dtype=jnp.int32)
+
+
+def _crc_apply_fold(crcs: jax.Array, mat: jax.Array) -> jax.Array:
+    """Apply one 32x32 GF(2) fold matrix to a [r, m] uint32 crc array:
+    unpack to bit-planes, one integer matmul, mod 2, repack."""
+    shifts = jnp.arange(32, dtype=jnp.uint32)
+    bits = ((crcs[:, :, None] >> shifts[None, None, :]) & 1).astype(
+        jnp.int32)                                     # [r, m, 32]
+    out_bits = (bits @ mat) & 1                        # [r, m, 32]
+    weights = jnp.left_shift(jnp.uint32(1), shifts)
+    return jnp.sum(out_bits.astype(jnp.uint32) * weights, axis=-1,
+                   dtype=jnp.uint32)
+
+
+def _crc_rows_body(rows: jax.Array, pad: int) -> jax.Array:
+    """Traced body: uint8 [r, n] -> uint32 [r] of crc32c(0, row)."""
+    c = _crc_t0_dev()[rows.astype(jnp.int32)]          # per-byte crcs
+    r, n = rows.shape
+    if pad > n:
+        c = jnp.concatenate(
+            [jnp.zeros((r, pad - n), dtype=jnp.uint32), c], axis=1)
+    level = 0
+    while c.shape[1] > 1:
+        m = _crc_fold_mat_dev(level)                   # trace-time const
+        c = _crc_apply_fold(c[:, 0::2], m) ^ c[:, 1::2]
+        level += 1
+    return c[:, 0]
+
+
+@functools.partial(jax.jit, static_argnames=("pad",))
+def _crc32c_rows_jit(rows, pad):
+    return _crc_rows_body(rows, pad)
+
+
+def crc32c_rows(rows) -> jax.Array:
+    """Device crc32c(seed=0) of each row of a uint8 [r, n] array, in one
+    jitted dispatch.  Seed-chained ceph semantics are the caller's host
+    combine: ``crc32c(seed, row) == crc32c_zeros(seed, n) ^ crc32c_rows(rows)[i]``."""
+    rows = jnp.asarray(rows, dtype=jnp.uint8)
+    n = rows.shape[1]
+    pad = 1 if n <= 1 else 1 << (n - 1).bit_length()
+    return _crc32c_rows_jit(rows, pad)
+
+
+@functools.partial(jax.jit, static_argnames=("variant", "pad"))
+def _gf_encode_with_crc_jit(mat, data, variant, pad):
+    parity = gf_apply(mat, data, variant)
+    rows = jnp.concatenate([data, parity], axis=0)
+    return parity, _crc_rows_body(rows, pad)
+
+
+def gf_encode_with_crc(mat, data, variant: str = "auto"):
+    """The fused encode+checksum dispatch: parity rows AND the
+    crc32c(0, ·) of every row of concat(data, parity), one jit call.
+
+    mat: [m, k] uint8, data: [k, N] uint8 -> (parity [m, N] uint8,
+    crcs [k + m] uint32).  Bitwise-identical to gf_apply + a host
+    crc loop; the checksum pass reuses the device-resident rows the
+    encode just produced instead of a second HBM round-trip."""
+    mat = jnp.asarray(mat, dtype=jnp.uint8)
+    data = jnp.asarray(data, dtype=jnp.uint8)
+    n = data.shape[1]
+    pad = 1 if n <= 1 else 1 << (n - 1).bit_length()
+    return _gf_encode_with_crc_jit(mat, data, variant, pad)

@@ -1,0 +1,9 @@
+"""Fixture: three payload-sized copy shapes on the data path."""
+import pickle
+
+
+def relay(view, payload):
+    body = bytes(view)                     # constructor materialize
+    raw = payload.tobytes()                # ndarray materialize
+    head = pickle.dumps({"p": payload})    # pickler on the data path
+    return body, raw, head

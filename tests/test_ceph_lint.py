@@ -118,6 +118,24 @@ def test_cross_thread_rule_passes_locked_twin():
         {"w.py": _fixture("cross_thread_clean.py")}) == []
 
 
+# -- fixture proof: hot-path copies ------------------------------------------
+
+def test_hot_path_copy_flags_all_three_shapes():
+    found = A.run_rule_on_sources(
+        "hot-path-copy", {"relay.py": _fixture("hot_copy_bad.py")})
+    msgs = sorted(f.message for f in found)
+    assert len(found) == 3, msgs
+    assert any("bytes(view)" in m for m in msgs)
+    assert any("payload.tobytes()" in m for m in msgs)
+    assert any("pickle.dumps" in m for m in msgs)
+
+
+def test_hot_path_copy_passes_ids_and_boundaries():
+    assert A.run_rule_on_sources(
+        "hot-path-copy",
+        {"relay.py": _fixture("hot_copy_clean.py")}) == []
+
+
 # -- fixture proof: jax dispatch purity --------------------------------------
 
 def test_jit_host_sync_flags_direct_and_transitive():
@@ -208,7 +226,7 @@ def test_rule_registry_complete():
                 "blocking-socket", "thread-spawn-site", "bounded-retry",
                 "span-owner", "span-phase", "profiler-confinement",
                 "bare-clock", "counter-help", "percentile-redef",
-                "wire-sizer"):
+                "wire-sizer", "hot-path-copy"):
         assert rid in rules, rid
         assert rules[rid].severity in ("error", "warning")
         assert rules[rid].description

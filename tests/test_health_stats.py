@@ -244,8 +244,8 @@ class TestStatsAggregator:
                 "recovery_queued_pgs", "recovery_active_pgs",
                 "recovery_wire_per_byte",
                 "serving_batch_s", "serving_op_s", "serving_bytes_s",
-                "serving_wire_per_op", "wire_tx_bytes_s",
-                "wire_tx_msgs_s",
+                "serving_wire_per_op", "serving_copies_per_byte",
+                "wire_tx_bytes_s", "wire_tx_msgs_s",
                 "jit_compiles", "jit_cache_hits"}
         finally:
             agg.close()

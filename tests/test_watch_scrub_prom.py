@@ -436,6 +436,53 @@ class TestPrometheus:
         assert 'ceph_tpu_num_devices{collection="device"}' in text
         assert 'ceph_tpu_compile_cache_keys{collection="device"}' in text
 
+    def test_copy_ledger_family_rendered(self):
+        """The payload copy ledger exports `ceph_tpu_copy_bytes{source}`
+        for the WHOLE closed source vocabulary (zero rows included, so
+        dashboards can pin the label set) plus the served/total/ratio
+        state gauges — the zero-copy data path's scrape instrument —
+        and the same quotient surfaces in the stats digest."""
+        from ceph_tpu.common import Context
+        from ceph_tpu.common import copy_ledger
+        from ceph_tpu.mgr.prometheus import render
+        from ceph_tpu.mgr.stats import StatsAggregator
+        led = copy_ledger.ledger()
+        base = led.snapshot()
+        copy_ledger.count_copy("staging", 4096)
+        copy_ledger.count_served(4096)
+        text = render(Context())
+        lines = text.splitlines()
+        vals = {}
+        for line in lines:
+            if line.startswith("ceph_tpu_copy_bytes{"):
+                labels, v = line.split("} ")
+                vals[labels.split('source="')[1].rstrip('"')] = int(v)
+        assert set(vals) == set(copy_ledger.COPY_SOURCES)
+        assert vals["staging"] >= base["copied"]["staging"] + 4096
+        served = [line for line in lines
+                  if 'copy_state{stat="served_bytes"}' in line]
+        assert served
+        assert float(served[0].split("} ")[1]) >= base["served"] + 4096
+        assert any('copy_state{stat="copies_per_byte"}' in line
+                   for line in lines)
+        assert lines.count("# TYPE ceph_tpu_copy_bytes counter") == 1
+        assert lines.count("# TYPE ceph_tpu_copy_state gauge") == 1
+        # the same quotient is the digest's serving-side success metric
+        t = [0.0]
+        agg = StatsAggregator(cct=Context(), name="promcopy-src",
+                              clock=lambda: t[0])
+        try:
+            agg.sample(now=0.0)
+            t[0] = 2.0
+            agg.sample(now=2.0)
+            d = agg.digest()
+            quotient = d["serving"]["bytes_copied_per_byte_served"]
+            assert quotient == led.copies_per_byte()
+            assert agg.digest_flat()["serving_copies_per_byte"] \
+                == quotient
+        finally:
+            agg.close()
+
     def test_span_latency_histograms_rendered(self):
         """The tracer's per-span-name latency distributions surface as
         prometheus histograms with the full _bucket/_sum/_count set."""

@@ -102,6 +102,22 @@ METRIC_PATHS = {
     "serving.async.clients": (("serving", "async", "clients"), True),
     "serving.async.overload.ops_s": (
         ("serving", "async", "overload", "ops_s"), True),
+    # zero-copy data path (ISSUE 20): the fused socket->HBM arms over
+    # the legacy pickle path at bulk payload size.  copies_per_byte is
+    # the claim itself — held to an absolute cap (METRIC_LIMITS), with
+    # the legacy arm's ratio held to an absolute FLOOR so the contrast
+    # the cap is measured against cannot quietly erode (a "legacy" arm
+    # that stops copying is a broken bench, not a better baseline).
+    "serving.zero_copy.copies_per_byte": (
+        ("serving", "zero_copy", "copies_per_byte"), False),
+    "serving.zero_copy.legacy_copies_per_byte": (
+        ("serving", "zero_copy", "legacy_copies_per_byte"), True),
+    "serving.zero_copy.ops_s": (
+        ("serving", "zero_copy", "fused", "ops_s"), True),
+    "serving.zero_copy.p99_ms": (
+        ("serving", "zero_copy", "fused", "p99_ms"), False),
+    "serving.zero_copy.goodput_ratio": (
+        ("serving", "zero_copy", "goodput_ratio"), True),
     # static analysis (ISSUE 15): the ceph-lint trajectory. `new` is
     # held to an absolute zero (METRIC_LIMITS) — any non-baselined
     # finding fails the round; `baselined` is diffed against the
@@ -157,6 +173,15 @@ METRIC_LIMITS = {
     # the ISSUE 14 acceptance floor: the async bench must actually run
     # >= 10k concurrent closed-loop sessions, every artifact, no ref
     "serving.async.clients": (10000, "min"),
+    # the ISSUE 20 acceptance caps: the fused arm moves each served
+    # payload byte at most ~1.3 times end to end (staging + client
+    # materialize + compaction tail), while the legacy pickle arm's
+    # >= 3 copies/byte keeps the contrast honest; the fused arm must
+    # also not LOSE goodput to the copies it saved (1.0 floor with the
+    # wall-clock jitter absorbed by the diff threshold below)
+    "serving.zero_copy.copies_per_byte": (1.3, "max"),
+    "serving.zero_copy.legacy_copies_per_byte": (3.0, "min"),
+    "serving.zero_copy.goodput_ratio": (1.0, "min"),
     # ceph-lint must run clean against the committed baseline in every
     # artifact — a new finding is a bug (or a missing justification),
     # never acceptable drift
@@ -211,6 +236,13 @@ METRIC_THRESHOLDS = {"efficiency.pct_of_peak": 0.30,
                      "serving.async.ops_s": 0.30,
                      "serving.async.p99_ms": 0.50,
                      "serving.async.overload.ops_s": 0.30,
+                     # two closed-loop socket arms on a shared host:
+                     # the wall-clock numbers gate cliffs only — the
+                     # copy ratios are deterministic byte counts and
+                     # keep the default tight diff
+                     "serving.zero_copy.ops_s": 0.30,
+                     "serving.zero_copy.p99_ms": 0.50,
+                     "serving.zero_copy.goodput_ratio": 0.30,
                      # a small integer count: one justified baseline
                      # entry is ~6% at today's size, so diff loosely and
                      # let review argue each justification — the gate
@@ -258,6 +290,11 @@ _BLOCK_DEVICE = {
     "serving.async.p99_ms": ("serving", "device"),
     "serving.async.clients": ("serving", "device"),
     "serving.async.overload.ops_s": ("serving", "device"),
+    "serving.zero_copy.copies_per_byte": ("serving", "device"),
+    "serving.zero_copy.legacy_copies_per_byte": ("serving", "device"),
+    "serving.zero_copy.ops_s": ("serving", "device"),
+    "serving.zero_copy.p99_ms": ("serving", "device"),
+    "serving.zero_copy.goodput_ratio": ("serving", "device"),
     # lint is host-side AST work; the block carries no device marker, so
     # these fall back to the artifact's overall platform
     "lint.new": ("lint", "device"),

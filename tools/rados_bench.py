@@ -267,7 +267,9 @@ def run_mux_bench(n_clients: int = 10000, ops_per_client: int = 2,
                   queue_max: int | None = None,
                   op_threads: int | None = None,
                   timeout_s: float = 120.0,
-                  keys: WorkloadKeys | None = None) -> dict:
+                  keys: WorkloadKeys | None = None,
+                  conf_overrides: dict | None = None,
+                  distinct_payloads: bool = False) -> dict:
     """Closed-loop mux bench: ``n_clients`` logical sessions multiplexed
     over ``n_conns`` TCP connections to an async ClusterServer, each
     running ``ops_per_client`` ping RPCs closed-loop (next op submits
@@ -296,6 +298,10 @@ def run_mux_bench(n_clients: int = 10000, ops_per_client: int = 2,
             overrides["ms_async_dispatch_queue_max"] = queue_max
         if op_threads is not None:
             overrides["ms_async_op_threads"] = op_threads
+        # extra conf keys (e.g. ms_zero_copy arms) ride the same
+        # save/restore cycle; the cluster cct IS the process default
+        # context, so the mux client's config observers see them too
+        overrides.update(conf_overrides or {})
         for k, v in overrides.items():
             saved[k] = conf.get(k)
             conf.set(k, v)
@@ -307,8 +313,21 @@ def run_mux_bench(n_clients: int = 10000, ops_per_client: int = 2,
                             os.path.join(td, KEYRING), n_conns=n_conns)
             mux.connect()
             payload = b"\xab" * payload_bytes
+            # distinct_payloads: a FRESH bytes object per op.  The
+            # default shares ONE payload object across every call in a
+            # batch, which pickle memoizes — the legacy frame then
+            # carries the payload once however many calls ride it, a
+            # wire-volume fiction no real workload gets.  Copy-path
+            # arms (run_zero_copy_pair) need each op to weigh its own
+            # bytes on both serialize paths.
+            # bytes(payload) would return the SAME object — go through
+            # bytearray to force a genuinely fresh one
+            meth = (lambda _p: ("ping",
+                                {"payload": bytes(bytearray(payload))})) \
+                if distinct_payloads else "ping"
             seg = _closed_loop_segment(mux, n_clients, ops_per_client,
-                                       payload, timeout_s, keys=keys)
+                                       payload, timeout_s, keys=keys,
+                                       method=meth)
             ok = seg["finished_in_time"]
             elapsed = seg["elapsed_s"]
             state = seg["state"]
@@ -678,6 +697,54 @@ def run_mux_overload_pair(n_clients: int = 10000,
             "server_shed": overload["server_shed"],
             "completed": overload["completed"],
         },
+    }
+
+
+def run_zero_copy_pair(n_clients: int = 256, ops_per_client: int = 4,
+                       n_conns: int = 8,
+                       payload_bytes: int = 65536) -> dict:
+    """The bench.py ``serving.zero_copy`` block: the same closed-loop
+    mux ping workload twice — the FUSED arm serializing payloads through
+    the raw sideband segment (``ms_zero_copy=true``: one staging copy
+    server-side, one materialize client-side) and the LEGACY arm forced
+    through pickled frames (pickle + segment join on send, unpickle on
+    receive, both directions).  The copy ledger resets around each arm,
+    so each arm's ``copies_per_byte`` is exactly its own bytes-copied /
+    bytes-served ratio — the number the perf gate caps absolutely on the
+    fused arm and floors on the legacy arm (a legacy ratio below ~3
+    would mean the ledger stopped seeing the copies, not that the
+    legacy path got faster)."""
+    from ceph_tpu.common import copy_ledger
+
+    def arm(on: bool) -> dict:
+        led = copy_ledger.ledger()
+        led.reset()
+        r = run_mux_bench(n_clients, ops_per_client, n_conns,
+                          payload_bytes=payload_bytes,
+                          queue_max=max(2 * n_clients, 2048),
+                          conf_overrides={"ms_zero_copy": on},
+                          distinct_payloads=True)
+        snap = led.snapshot()
+        return {"ops_s": r["ops_s"], "p50_ms": r["p50_ms"],
+                "p99_ms": r["p99_ms"], "completed": r["completed"],
+                "finished_in_time": r["finished_in_time"],
+                "copies_per_byte": snap["copies_per_byte"],
+                "copied": snap["copied"],
+                "copied_total": snap["copied_total"],
+                "served": snap["served"]}
+
+    fused = arm(True)
+    legacy = arm(False)
+    return {
+        "payload_bytes": payload_bytes,
+        "clients": n_clients,
+        "ops_per_client": ops_per_client,
+        "fused": fused,
+        "legacy": legacy,
+        "copies_per_byte": fused["copies_per_byte"],
+        "legacy_copies_per_byte": legacy["copies_per_byte"],
+        "goodput_ratio": round(fused["ops_s"] / legacy["ops_s"], 3)
+        if legacy["ops_s"] else 0.0,
     }
 
 
